@@ -1,0 +1,59 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so the
+multi-chip sharding paths run as a mesh without TPU hardware (SURVEY.md §4 test
+plan; same trick as the reference's InMemoryCommunicator multi-worker tests)."""
+
+import os
+
+# Must run before jax initializes its backends (jax may already be *imported*
+# by the environment's sitecustomize, but backends are created lazily).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# If a TPU PJRT plugin was pre-registered by the environment (axon tunnel),
+# drop its factory: initializing it alongside the CPU backend can block on the
+# exclusive device claim, and tests must not touch the real chip anyway.
+try:
+    import jax
+
+    # sitecustomize may have imported jax with JAX_PLATFORMS=axon already
+    # latched into the config; env alone is not enough at this point.
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:  # pragma: no cover - defensive; tests then run on default
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1994)
+
+
+def make_regression(n=500, f=10, rng=None, missing_frac=0.0):
+    rng = rng or np.random.RandomState(0)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    y = X @ w + 0.1 * rng.randn(n).astype(np.float32)
+    if missing_frac > 0:
+        mask = rng.rand(n, f) < missing_frac
+        X = X.copy()
+        X[mask] = np.nan
+    return X, y
+
+
+def make_classification(n=500, f=10, rng=None, n_classes=2):
+    rng = rng or np.random.RandomState(0)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f, n_classes).astype(np.float32)
+    logits = X @ w
+    y = logits.argmax(axis=1).astype(np.float32)
+    return X, y

@@ -1,0 +1,22 @@
+"""xgboost_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch reimplementation of XGBoost 2.0's capabilities (reference
+snapshot: dmlc/xgboost 2.0.0) designed for TPUs: quantized bin matrices in HBM,
+histogram building and split evaluation as fused XLA/Pallas ops on the MXU/VPU,
+row partitioning as static-shape gathers under ``jit``, and the rabit/NCCL
+collective layer replaced by ``jax.lax.psum`` over the ICI/DCN device mesh.
+"""
+
+from .config import config_context, get_config, set_config
+from .context import Context, make_data_mesh
+from .core import Booster, train
+from .data.dmatrix import DataIter, DMatrix, QuantileDMatrix
+from .tree.param import TrainParam
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Booster", "train", "DMatrix", "QuantileDMatrix", "DataIter",
+    "TrainParam", "Context", "make_data_mesh",
+    "config_context", "set_config", "get_config", "__version__",
+]

@@ -1,0 +1,103 @@
+"""GBTree gradient booster — owns the tree list and the boosting step.
+
+Reference: ``GBTree::DoBoost`` / ``BoostNewTrees`` (``src/gbm/gbtree.cc:226-350``):
+one tree per output group per iteration (times ``num_parallel_tree`` for boosted
+random forests, with the learning rate divided accordingly), committed with group
+ids in ``tree_info`` and per-iteration offsets in ``iteration_indptr``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.binned import BinnedMatrix
+from ..registry import BOOSTERS
+from ..tree.grow import TreeGrower
+from ..tree.param import TrainParam
+from ..tree.tree import TreeModel
+
+
+@BOOSTERS.register("gbtree")
+class GBTree:
+    name = "gbtree"
+
+    def __init__(self, tree_param: TrainParam, n_groups: int,
+                 num_parallel_tree: int = 1, hist_method: str = "auto",
+                 axis_name: Optional[str] = None) -> None:
+        self.tree_param = tree_param
+        self.n_groups = n_groups
+        self.num_parallel_tree = num_parallel_tree
+        self.hist_method = hist_method
+        self.axis_name = axis_name
+        self.trees: List[TreeModel] = []
+        self.tree_info: List[int] = []
+        self.iteration_indptr: List[int] = [0]
+        self._grower: Optional[TreeGrower] = None
+
+    # -- training -------------------------------------------------------------
+    def _grower_for(self, binned: BinnedMatrix) -> TreeGrower:
+        if self._grower is None:
+            param = self.tree_param
+            if self.num_parallel_tree > 1:
+                # reference BoostNewTrees: lr /= num_parallel_tree
+                param = param.clone()
+                param.eta = param.eta / self.num_parallel_tree
+            self._grower = TreeGrower(param, binned.max_nbins, binned.cuts,
+                                      hist_method=self.hist_method,
+                                      axis_name=self.axis_name)
+        return self._grower
+
+    def do_boost(self, binned: BinnedMatrix, gpair: jnp.ndarray,
+                 iteration: int, key: jax.Array) -> jnp.ndarray:
+        """gpair: [n, K, 2] -> margin delta [n, K] for the training data."""
+        grower = self._grower_for(binned)
+        n, K = gpair.shape[0], gpair.shape[1]
+        n_real = binned.n_real_bins()
+        deltas = []
+        for k in range(K):
+            delta_k = jnp.zeros((n,), jnp.float32)
+            for p in range(self.num_parallel_tree):
+                tkey = jax.random.fold_in(key, k * self.num_parallel_tree + p)
+                gp = gpair[:, k, :]
+                if self.tree_param.subsample < 1.0:
+                    mask = jax.random.bernoulli(
+                        jax.random.fold_in(tkey, 0x5AB),
+                        self.tree_param.subsample, (n,))
+                    gp = gp * mask[:, None].astype(gp.dtype)
+                grown = grower.grow(binned.bins, gp, n_real, tkey)
+                self.trees.append(grower.to_tree_model(grown))
+                self.tree_info.append(k)
+                delta_k = delta_k + grown.delta
+            deltas.append(delta_k)
+        self.iteration_indptr.append(len(self.trees))
+        return jnp.stack(deltas, axis=1)
+
+    # -- model container ------------------------------------------------------
+    def num_boosted_rounds(self) -> int:
+        return len(self.iteration_indptr) - 1
+
+    def tree_slice(self, begin: int, end: Optional[int] = None):
+        """Trees of iterations [begin, end) (reference model slicing)."""
+        if end is None or end > self.num_boosted_rounds():
+            end = self.num_boosted_rounds()
+        lo, hi = self.iteration_indptr[begin], self.iteration_indptr[end]
+        return self.trees[lo:hi], self.tree_info[lo:hi]
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "num_parallel_tree": self.num_parallel_tree,
+            "trees": [t.to_json() for t in self.trees],
+            "tree_info": list(self.tree_info),
+            "iteration_indptr": list(self.iteration_indptr),
+        }
+
+    def from_json(self, obj: dict) -> None:
+        self.num_parallel_tree = int(obj.get("num_parallel_tree", 1))
+        self.trees = [TreeModel.from_json(t) for t in obj["trees"]]
+        self.tree_info = [int(x) for x in obj["tree_info"]]
+        self.iteration_indptr = [int(x) for x in obj["iteration_indptr"]]

@@ -1,0 +1,203 @@
+"""Training callbacks.
+
+Mirrors the reference Python callback API (``python-package/xgboost/callback.py``):
+``TrainingCallback`` ABC with before/after iteration hooks receiving the shared
+``evals_log`` history, a ``CallbackContainer`` driving them, plus the stock
+``EarlyStopping`` / ``EvaluationMonitor`` / ``LearningRateScheduler`` /
+``TrainingCheckPoint`` implementations.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .logging_utils import console
+
+EvalsLog = Dict[str, Dict[str, List[float]]]
+
+
+class TrainingCallback:
+    def before_training(self, model):
+        return model
+
+    def after_training(self, model):
+        return model
+
+    def before_iteration(self, model, epoch: int, evals_log: EvalsLog) -> bool:
+        return False
+
+    def after_iteration(self, model, epoch: int, evals_log: EvalsLog) -> bool:
+        """Return True to stop training."""
+        return False
+
+
+class CallbackContainer:
+    def __init__(self, callbacks: Sequence[TrainingCallback],
+                 metric: Optional[Callable] = None,
+                 output_margin: bool = True) -> None:
+        self.callbacks = list(callbacks)
+        self.metric = metric
+        self.history: EvalsLog = collections.OrderedDict()
+
+    def before_training(self, model):
+        for cb in self.callbacks:
+            model = cb.before_training(model)
+        return model
+
+    def after_training(self, model):
+        for cb in self.callbacks:
+            model = cb.after_training(model)
+        return model
+
+    def before_iteration(self, model, epoch: int) -> bool:
+        return any(cb.before_iteration(model, epoch, self.history)
+                   for cb in self.callbacks)
+
+    def after_iteration(self, model, epoch: int, evals) -> bool:
+        if evals:
+            msg = model.eval_set(evals, epoch, feval=self.metric)
+            parsed = _parse_eval_str(msg)
+            for data_name, metric_name, score in parsed:
+                self.history.setdefault(
+                    data_name, collections.OrderedDict()).setdefault(
+                        metric_name, []).append(score)
+        return any(cb.after_iteration(model, epoch, self.history)
+                   for cb in self.callbacks)
+
+
+def _parse_eval_str(msg: str):
+    out = []
+    for part in msg.split("\t")[1:]:
+        key, val = part.split(":")
+        data_name, metric_name = key.split("-", 1)
+        out.append((data_name, metric_name, float(val)))
+    return out
+
+
+class EvaluationMonitor(TrainingCallback):
+    """Print the eval line every ``period`` iterations (reference callback.py)."""
+
+    def __init__(self, rank: int = 0, period: int = 1) -> None:
+        self.rank = rank
+        self.period = max(1, period)
+        self._latest: Optional[str] = None
+
+    def after_iteration(self, model, epoch, evals_log) -> bool:
+        if not evals_log:
+            return False
+        msg = f"[{epoch}]"
+        for data, metrics in evals_log.items():
+            for name, log in metrics.items():
+                msg += f"\t{data}-{name}:{log[-1]:.5f}"
+        if (epoch % self.period) == 0:
+            console(msg)
+            self._latest = None
+        else:
+            self._latest = msg
+        return False
+
+    def after_training(self, model):
+        if self._latest is not None:
+            console(self._latest)
+        return model
+
+
+# metrics where larger is better (reference callback.py maximize table)
+_MAXIMIZE_METRICS = ("auc", "aucpr", "pre", "map", "ndcg",
+                     "interval-regression-accuracy")
+
+
+class EarlyStopping(TrainingCallback):
+    def __init__(self, rounds: int, metric_name: Optional[str] = None,
+                 data_name: Optional[str] = None,
+                 maximize: Optional[bool] = None, save_best: bool = False,
+                 min_delta: float = 0.0) -> None:
+        self.rounds = rounds
+        self.metric_name = metric_name
+        self.data_name = data_name
+        self.maximize = maximize
+        self.save_best = save_best
+        self.min_delta = min_delta
+        self.stopping_history: EvalsLog = {}
+        self.best_scores: List[float] = []
+        self.current_rounds = 0
+
+    def before_training(self, model):
+        self.starting_round = model.num_boosted_rounds()
+        return model
+
+    def _is_better(self, new: float, best: float) -> bool:
+        if self.maximize:
+            return new - self.min_delta > best
+        return new + self.min_delta < best
+
+    def after_iteration(self, model, epoch, evals_log) -> bool:
+        if not evals_log:
+            raise ValueError("Must have at least 1 validation dataset for "
+                             "early stopping.")
+        data_name = self.data_name or list(evals_log.keys())[-1]
+        metric_name = self.metric_name or list(evals_log[data_name].keys())[-1]
+        score = evals_log[data_name][metric_name][-1]
+        if self.maximize is None:
+            self.maximize = any(metric_name.startswith(m)
+                                for m in _MAXIMIZE_METRICS)
+        if not self.best_scores:
+            self.best_scores = [score]
+            model.set_attr(best_score=str(score), best_iteration=str(epoch))
+            self.current_rounds = 0
+        elif self._is_better(score, self.best_scores[-1]):
+            self.best_scores.append(score)
+            model.set_attr(best_score=str(score), best_iteration=str(epoch))
+            self.current_rounds = 0
+        else:
+            self.current_rounds += 1
+        return self.current_rounds >= self.rounds
+
+    def after_training(self, model):
+        if self.save_best and model.attr("best_iteration") is not None:
+            best = int(model.attr("best_iteration"))
+            model = model[: best + 1]
+        return model
+
+
+class LearningRateScheduler(TrainingCallback):
+    def __init__(self, learning_rates: Union[Callable[[int], float],
+                                             Sequence[float]]) -> None:
+        if callable(learning_rates):
+            self.fn = learning_rates
+        else:
+            rates = list(learning_rates)
+            self.fn = lambda epoch: rates[epoch]
+
+    def before_iteration(self, model, epoch, evals_log) -> bool:
+        model.set_param("learning_rate", self.fn(epoch))
+        return False
+
+
+class TrainingCheckPoint(TrainingCallback):
+    def __init__(self, directory: str, name: str = "model",
+                 as_pickle: bool = False, interval: int = 100) -> None:
+        self.dir = directory
+        self.name = name
+        self.as_pickle = as_pickle
+        self.interval = max(1, interval)
+        self._epoch = 0
+
+    def after_iteration(self, model, epoch, evals_log) -> bool:
+        if self._epoch == self.interval:
+            path = os.path.join(
+                self.dir,
+                f"{self.name}_{epoch}." + ("pkl" if self.as_pickle else "json"))
+            self._epoch = 0
+            if self.as_pickle:
+                import pickle
+                with open(path, "wb") as fh:
+                    pickle.dump(model, fh)
+            else:
+                model.save_model(path)
+        self._epoch += 1
+        return False

@@ -1,0 +1,54 @@
+"""Global (thread-local) configuration.
+
+Mirrors the reference's ``GlobalConfiguration`` {verbosity, use_rmm}
+(``include/xgboost/global_config.h:17``) and the Python ``config_context`` /
+``set_config`` / ``get_config`` API (``python-package/xgboost/config.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Iterator
+
+from .logging_utils import set_verbosity
+
+_state = threading.local()
+
+_DEFAULTS: Dict[str, Any] = {
+    "verbosity": 1,
+    # TPU analogue of use_rmm: transfer-guard / donation knobs could live here.
+    "nthread": 0,
+}
+
+
+def _cfg() -> Dict[str, Any]:
+    if not hasattr(_state, "cfg"):
+        _state.cfg = dict(_DEFAULTS)
+    return _state.cfg
+
+
+def set_config(**kwargs: Any) -> None:
+    cfg = _cfg()
+    for k, v in kwargs.items():
+        if k not in _DEFAULTS:
+            raise ValueError(f"Unknown global config key: {k}")
+        cfg[k] = v
+    if "verbosity" in kwargs:
+        set_verbosity(int(kwargs["verbosity"]))
+
+
+def get_config() -> Dict[str, Any]:
+    return dict(_cfg())
+
+
+@contextlib.contextmanager
+def config_context(**kwargs: Any) -> Iterator[None]:
+    saved = get_config()
+    try:
+        set_config(**kwargs)
+        yield
+    finally:
+        _cfg().clear()
+        _cfg().update(saved)
+        set_verbosity(int(saved["verbosity"]))

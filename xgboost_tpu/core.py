@@ -1,0 +1,617 @@
+"""Booster (learner) + train loop — the user-facing training orchestrator.
+
+Reference analogues: ``LearnerImpl`` (``src/learner.cc:1263`` UpdateOneIter /
+EvalOneIter / Predict / model IO) and the Python ``Booster`` + ``train()``
+(``python-package/xgboost/core.py:1623``, ``training.py:178``). One Booster owns
+the objective, the gradient booster (tree forest), the base score, and per-DMatrix
+margin caches (the reference's ``PredictionContainer`` version-cache: only trees
+added since the cached version are walked, ``src/gbm/gbtree.cc:506-544``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .boosting.gbtree import GBTree
+from .boosting.predict import ForestPredictor
+from .context import Context
+from .data.dmatrix import DMatrix
+from .logging_utils import console, logger
+from .metric import get_metric
+from .objective import get_objective
+from .tree.param import TrainParam
+from .tree.tree import stack_forest
+
+_VERSION = (0, 1, 0)
+
+# learner-level keys that are not TrainParam fields
+_LEARNER_KEYS = {
+    "objective", "num_class", "base_score", "eval_metric", "booster",
+    "num_parallel_tree", "tree_method", "device", "seed", "random_state",
+    "nthread", "n_jobs", "verbosity", "disable_default_eval_metric",
+    "hist_method", "validate_parameters", "seed_per_iteration",
+    # objective-specific passthroughs
+    "scale_pos_weight", "huber_slope", "tweedie_variance_power",
+    "quantile_alpha", "aft_loss_distribution", "aft_loss_distribution_scale",
+    "lambdarank_pair_method", "lambdarank_num_pair_per_sample",
+    "lambdarank_unbiased", "lambdarank_bias_norm", "ndcg_exp_gain",
+    "max_delta_step",
+}
+
+
+class Booster:
+    """A trained / in-training gradient-boosting model."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 cache: Optional[Sequence[DMatrix]] = None,
+                 model_file: Optional[str] = None) -> None:
+        self.tree_param = TrainParam()
+        self.learner_params: Dict[str, Any] = {
+            "objective": "reg:squarederror", "booster": "gbtree",
+            "num_parallel_tree": 1, "tree_method": "auto", "num_class": 0,
+        }
+        self.ctx = Context()
+        self.attributes_: Dict[str, str] = {}
+        self.feature_names: Optional[List[str]] = None
+        self.feature_types: Optional[List[str]] = None
+        self.obj = None
+        self.gbm: Optional[GBTree] = None
+        self.base_margin_: Optional[np.ndarray] = None  # [K] margin space
+        self._configured = False
+        self._caches: Dict[int, Dict[str, Any]] = {}
+        self._predictor: Optional[ForestPredictor] = None
+        self._predictor_ntrees = -1
+        self._eval_metrics: List = []
+        if params:
+            self.set_param(params)
+        if model_file is not None:
+            self.load_model(model_file)
+
+    # ------------------------------------------------------------------ params
+    def set_param(self, params: Union[Dict[str, Any], str, List[Tuple[str, Any]]],
+                  value: Optional[Any] = None) -> None:
+        if isinstance(params, str):
+            params = {params: value}
+        elif isinstance(params, list):
+            params = dict(params)
+        params = dict(params)
+        if "eval_metric" in params:
+            em = params.pop("eval_metric")
+            names = em if isinstance(em, (list, tuple)) else [em]
+            self.learner_params["eval_metric"] = list(names)
+            self._eval_metrics = [get_metric(n) for n in names]
+        for k in list(params):
+            if k in _LEARNER_KEYS:
+                self.learner_params[k] = params.pop(k)
+        unknown = self.ctx.update_allow_unknown(params)
+        unknown = self.tree_param.update_allow_unknown(unknown)
+        for k in unknown:
+            logger.warning("Unknown parameter: %s", k)
+        # param changes invalidate lazy config (objective/eta may differ)
+        if self._configured and self.obj is not None:
+            new_obj = self.learner_params.get("objective", self.obj.name)
+            if new_obj != self.obj.name:
+                self.obj = get_objective(
+                    new_obj, {k: v for k, v in self.learner_params.items()
+                              if k not in ("objective", "booster")})
+            else:
+                self.obj.configure(
+                    {k: v for k, v in self.learner_params.items()
+                     if k not in ("objective", "booster")})
+            if self.gbm is not None:
+                self.gbm.tree_param = self.tree_param
+                self.gbm._grower = None  # rebind with new params
+
+    # --------------------------------------------------------------- configure
+    def _configure(self, dtrain: Optional[DMatrix]) -> None:
+        if self._configured:
+            return
+        tm = self.learner_params.get("tree_method", "auto")
+        if tm not in ("auto", "hist", "gpu_hist", "tpu_hist"):
+            raise NotImplementedError(
+                f"tree_method={tm} is not implemented yet; use 'hist'")
+        # parameters accepted by TrainParam but not yet wired into the grower
+        # must fail loudly, not silently train without the constraint
+        from .tree.param import parse_monotone_constraints
+        if parse_monotone_constraints(
+                self.tree_param.monotone_constraints, 0) is not None:
+            raise NotImplementedError(
+                "monotone_constraints are not implemented yet")
+        if self.tree_param.interaction_constraints.strip():
+            raise NotImplementedError(
+                "interaction_constraints are not implemented yet")
+        if self.tree_param.grow_policy != "depthwise":
+            raise NotImplementedError(
+                f"grow_policy={self.tree_param.grow_policy} is not "
+                "implemented yet; use 'depthwise'")
+        if self.tree_param.max_leaves != 0:
+            raise NotImplementedError("max_leaves is not implemented yet")
+        obj_name = self.learner_params.get("objective", "reg:squarederror")
+        if self.obj is None or getattr(self.obj, "name", None) != obj_name:
+            self.obj = get_objective(
+                obj_name, {k: v for k, v in self.learner_params.items()
+                           if k not in ("objective", "booster")})
+        info = dtrain.info if dtrain is not None else None
+        n_groups = max(1, self.obj.n_targets(info))
+        if self.gbm is None:
+            self.gbm = GBTree(
+                self.tree_param, n_groups,
+                num_parallel_tree=int(self.learner_params.get(
+                    "num_parallel_tree", 1)),
+                hist_method=self.learner_params.get("hist_method", "auto"))
+        if self.base_margin_ is None:
+            if "base_score" in self.learner_params and \
+                    self.learner_params["base_score"] is not None:
+                bs = float(self.learner_params["base_score"])
+                margin = self.obj.prob_to_margin(np.asarray([bs]))
+                self.base_margin_ = np.full(n_groups, margin,
+                                            dtype=np.float32).reshape(-1)
+                if self.base_margin_.shape[0] != n_groups:
+                    self.base_margin_ = np.full(n_groups, float(margin),
+                                                dtype=np.float32)
+            elif dtrain is not None and dtrain.info.labels is not None:
+                est = np.asarray(self.obj.init_estimation(dtrain.info),
+                                 dtype=np.float32).reshape(-1)
+                if est.shape[0] != n_groups:
+                    est = np.full(n_groups, est[0] if est.size else 0.0,
+                                  np.float32)
+                self.base_margin_ = est
+            else:
+                self.base_margin_ = np.zeros(n_groups, dtype=np.float32)
+        if not self._eval_metrics and not bool(self.learner_params.get(
+                "disable_default_eval_metric", False)):
+            self._eval_metrics = [get_metric(self.obj.default_metric)]
+        if dtrain is not None and self.feature_names is None:
+            self.feature_names = dtrain.info.feature_names
+            self.feature_types = dtrain.info.feature_types
+        self._configured = True
+
+    @property
+    def n_groups(self) -> int:
+        return self.gbm.n_groups if self.gbm is not None else 1
+
+    # ---------------------------------------------------------------- training
+    def _state_of(self, dm: DMatrix, is_train: bool) -> Dict[str, Any]:
+        key = id(dm)
+        if key in self._caches and is_train and (
+                not self._caches[key]["is_train"]
+                or self._caches[key]["binned"] is None):
+            # first seen as eval-only; rebuild as a training entry
+            del self._caches[key]
+        if key not in self._caches:
+            if is_train:
+                binned = dm.binned(self.tree_param.max_bin)
+            else:
+                train_cuts = None
+                for st in self._caches.values():
+                    if st.get("is_train") and st["binned"] is not None:
+                        train_cuts = st["binned"].cuts
+                        break
+                # The binned fast path is only valid against the cuts the
+                # trees were grown with; without them (e.g. a loaded model)
+                # fall back to raw-threshold prediction (binned=None).
+                binned = (dm.binned(self.tree_param.max_bin,
+                                    ref_cuts=train_cuts)
+                          if train_cuts is not None else None)
+            n = dm.num_row()
+            if dm.info.base_margin is not None:
+                bm = np.asarray(dm.info.base_margin,
+                                dtype=np.float32).reshape(n, -1)
+                margin = jnp.asarray(np.broadcast_to(bm, (n, self.n_groups)))
+            else:
+                margin = jnp.broadcast_to(
+                    jnp.asarray(self.base_margin_, dtype=jnp.float32)[None, :],
+                    (n, self.n_groups))
+            self._caches[key] = {"binned": binned, "margin": margin,
+                                 "n_trees": 0, "is_train": is_train, "dm": dm}
+        return self._caches[key]
+
+    def update(self, dtrain: DMatrix, iteration: int,
+               fobj: Optional[Callable] = None) -> None:
+        """One boosting iteration (reference ``XGBoosterUpdateOneIter``)."""
+        self._configure(dtrain)
+        state = self._state_of(dtrain, is_train=True)
+        margin = state["margin"]
+        if fobj is None:
+            gpair = self.obj.get_gradient(margin, dtrain.info, iteration)
+        else:
+            grad, hess = fobj(np.asarray(margin).squeeze(), dtrain)
+            gpair = jnp.stack([jnp.asarray(grad, dtype=jnp.float32).reshape(
+                margin.shape), jnp.asarray(hess, dtype=jnp.float32).reshape(
+                    margin.shape)], axis=-1)
+        key = self.ctx.make_key(iteration)
+        delta = self.gbm.do_boost(state["binned"], gpair, iteration,
+                                  jax.random.fold_in(key, iteration))
+        state["margin"] = margin + delta
+        state["n_trees"] = len(self.gbm.trees)
+
+    def boost(self, dtrain: DMatrix, grad: np.ndarray, hess: np.ndarray) -> None:
+        """Boost with externally computed gradients (reference Booster.boost)."""
+        self._configure(dtrain)
+        state = self._state_of(dtrain, is_train=True)
+        margin = state["margin"]
+        gpair = jnp.stack(
+            [jnp.asarray(grad, dtype=jnp.float32).reshape(margin.shape),
+             jnp.asarray(hess, dtype=jnp.float32).reshape(margin.shape)],
+            axis=-1)
+        it = self.num_boosted_rounds()
+        delta = self.gbm.do_boost(state["binned"], gpair, it,
+                                  jax.random.fold_in(self.ctx.make_key(it), it))
+        state["margin"] = margin + delta
+        state["n_trees"] = len(self.gbm.trees)
+
+    # -------------------------------------------------------------- prediction
+    def _cached_margin(self, dm: DMatrix) -> jnp.ndarray:
+        """Margin with the version-cache trick: walk only trees added since the
+        cache entry was last touched, on the quantized matrix."""
+        self._configure(dm)
+        state = self._state_of(dm, is_train=False)
+        total = len(self.gbm.trees)
+        if state["n_trees"] < total:
+            new_trees = self.gbm.trees[state["n_trees"]:total]
+            new_info = self.gbm.tree_info[state["n_trees"]:total]
+            forest = stack_forest(new_trees)
+            pred = ForestPredictor(forest, np.asarray(new_info), self.n_groups)
+            binned = state["binned"]
+            if binned is not None:
+                delta, _ = pred.margin_binned(
+                    binned.bins, binned.max_nbins - 1,
+                    np.zeros(self.n_groups, np.float32))
+            else:
+                delta, _ = pred.margin(dm.X,
+                                       np.zeros(self.n_groups, np.float32))
+            state["margin"] = state["margin"] + delta
+            state["n_trees"] = total
+        return state["margin"]
+
+    def _full_predictor(self) -> Optional[ForestPredictor]:
+        total = len(self.gbm.trees)
+        if self._predictor is None or self._predictor_ntrees != total:
+            forest = stack_forest(self.gbm.trees)
+            if forest is None:
+                return None
+            self._predictor = ForestPredictor(
+                forest, np.asarray(self.gbm.tree_info), self.n_groups)
+            self._predictor_ntrees = total
+        return self._predictor
+
+    def predict(self, data: DMatrix, output_margin: bool = False,
+                pred_leaf: bool = False, pred_contribs: bool = False,
+                iteration_range: Optional[Tuple[int, int]] = None,
+                strict_shape: bool = False, training: bool = False
+                ) -> np.ndarray:
+        if pred_contribs:
+            raise NotImplementedError(
+                "pred_contribs (SHAP) is not implemented yet")
+        self._configure(data if data.info.labels is not None else None)
+        X = data.X
+        if iteration_range is not None and iteration_range != (0, 0):
+            trees, info = self.gbm.tree_slice(iteration_range[0],
+                                              iteration_range[1])
+            forest = stack_forest(trees)
+            predictor = (ForestPredictor(forest, np.asarray(info),
+                                         self.n_groups)
+                         if forest is not None else None)
+        else:
+            trees = self.gbm.trees
+            predictor = self._full_predictor()
+        base = self.base_margin_ if self.base_margin_ is not None else \
+            np.zeros(self.n_groups, np.float32)
+        if data.info.base_margin is not None:
+            base_rows = np.asarray(data.info.base_margin, np.float32)
+        else:
+            base_rows = None
+        if predictor is None:
+            margin = np.broadcast_to(base[None, :],
+                                     (data.num_row(), self.n_groups)).copy()
+            pos = None
+        else:
+            m, pos = predictor.margin(
+                X, np.zeros(self.n_groups, np.float32))
+            margin = np.asarray(m)
+            if base_rows is not None:
+                margin = margin + base_rows.reshape(margin.shape[0], -1)
+            else:
+                margin = margin + base[None, :]
+        if pred_leaf:
+            if pos is None:
+                return np.zeros((data.num_row(), 0), dtype=np.int32)
+            return self._compact_leaves(np.asarray(pos), trees)
+        out = margin if output_margin else np.asarray(
+            self.obj.pred_transform(jnp.asarray(margin)))
+        if not strict_shape and out.ndim == 2 and out.shape[1] == 1:
+            out = out[:, 0]
+        return out
+
+    def inplace_predict(self, data: Any, iteration_range=None,
+                        predict_type: str = "value", missing: float = np.nan,
+                        base_margin: Any = None, strict_shape: bool = False
+                        ) -> np.ndarray:
+        """Predict straight from a raw array (reference InplacePredict path —
+        no DMatrix quantization needed since raw prediction walks raw
+        thresholds anyway)."""
+        dm = DMatrix(data, missing=missing, base_margin=base_margin)
+        return self.predict(dm, output_margin=(predict_type == "margin"),
+                            iteration_range=iteration_range,
+                            strict_shape=strict_shape)
+
+    def _compact_leaves(self, pos: np.ndarray, trees) -> np.ndarray:
+        out = np.zeros_like(pos)
+        for t, tree in enumerate(trees[:pos.shape[1]]):
+            ids = tree.compact_ids()
+            out[:, t] = np.vectorize(lambda h: ids.get(int(h), 0))(pos[:, t])
+        return out
+
+    # ------------------------------------------------------------------- eval
+    def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,
+                 feval: Optional[Callable] = None,
+                 output_margin: bool = True) -> str:
+        """Evaluate on a list of (DMatrix, name); returns the reference-format
+        line ``[i]\\tname-metric:value...`` (``src/learner.cc:1307-1342``)."""
+        self._configure(None)
+        msg = f"[{iteration}]"
+        for dm, name in evals:
+            margin = self._cached_margin(dm)
+            preds = self.obj.pred_transform(margin)
+            preds_np = np.asarray(preds)
+            if preds_np.ndim == 2 and preds_np.shape[1] == 1:
+                preds_np = preds_np[:, 0]
+            for metric in self._eval_metrics:
+                score = metric(preds_np, dm.info)
+                msg += f"\t{name}-{metric.full_name}:{score:.6f}"
+            if feval is not None:
+                margin_np = np.asarray(margin)
+                if margin_np.ndim == 2 and margin_np.shape[1] == 1:
+                    margin_np = margin_np[:, 0]
+                res = feval(margin_np if output_margin else preds_np, dm)
+                pairs = res if isinstance(res, list) else [res]
+                for mname, val in pairs:
+                    msg += f"\t{name}-{mname}:{val:.6f}"
+        return msg
+
+    # -------------------------------------------------------------- attributes
+    def attr(self, key: str) -> Optional[str]:
+        return self.attributes_.get(key)
+
+    def attributes(self) -> Dict[str, str]:
+        return dict(self.attributes_)
+
+    def set_attr(self, **kwargs: Any) -> None:
+        for k, v in kwargs.items():
+            if v is None:
+                self.attributes_.pop(k, None)
+            else:
+                self.attributes_[k] = str(v)
+
+    @property
+    def best_iteration(self) -> int:
+        b = self.attr("best_iteration")
+        if b is None:
+            return self.num_boosted_rounds() - 1
+        return int(b)
+
+    @property
+    def best_score(self) -> float:
+        return float(self.attr("best_score"))
+
+    def num_boosted_rounds(self) -> int:
+        return self.gbm.num_boosted_rounds() if self.gbm is not None else 0
+
+    def num_features(self) -> int:
+        return len(self.feature_names) if self.feature_names else 0
+
+    # ---------------------------------------------------------------- slicing
+    def __getitem__(self, val: slice) -> "Booster":
+        if not isinstance(val, slice):
+            raise TypeError("Booster slicing requires a slice of iterations")
+        begin = val.start or 0
+        end = val.stop if val.stop is not None else self.num_boosted_rounds()
+        step = val.step if val.step is not None else 1
+        import copy
+        new = copy.copy(self)
+        new.gbm = GBTree(self.tree_param, self.n_groups,
+                         num_parallel_tree=self.gbm.num_parallel_tree)
+        indptr = self.gbm.iteration_indptr
+        new.gbm.trees = []
+        new.gbm.tree_info = []
+        new.gbm.iteration_indptr = [0]
+        for it in range(begin, min(end, self.num_boosted_rounds()), step):
+            lo, hi = indptr[it], indptr[it + 1]
+            new.gbm.trees.extend(self.gbm.trees[lo:hi])
+            new.gbm.tree_info.extend(self.gbm.tree_info[lo:hi])
+            new.gbm.iteration_indptr.append(len(new.gbm.trees))
+        new._caches = {}
+        new._predictor = None
+        new._predictor_ntrees = -1
+        new.attributes_ = dict(self.attributes_)
+        return new
+
+    # ------------------------------------------------------------------- IO
+    def save_model(self, fname: str) -> None:
+        obj = self._model_to_json()
+        if str(fname).endswith(".ubj"):
+            from .utils.ubjson import dump_ubjson
+            with open(fname, "wb") as fh:
+                dump_ubjson(obj, fh)
+        else:
+            with open(fname, "w") as fh:
+                json.dump(obj, fh)
+
+    def save_raw(self, raw_format: str = "ubj") -> bytearray:
+        obj = self._model_to_json()
+        if raw_format == "json":
+            return bytearray(json.dumps(obj).encode())
+        from .utils.ubjson import dumps_ubjson
+        return bytearray(dumps_ubjson(obj))
+
+    def load_model(self, fname: Union[str, bytes, bytearray]) -> None:
+        if isinstance(fname, (bytes, bytearray)):
+            raw = bytes(fname)
+            if raw[:1] in (b"{",):
+                obj = json.loads(raw.decode())
+            else:
+                from .utils.ubjson import loads_ubjson
+                obj = loads_ubjson(raw)
+        elif str(fname).endswith(".ubj"):
+            from .utils.ubjson import load_ubjson
+            with open(fname, "rb") as fh:
+                obj = load_ubjson(fh)
+        else:
+            with open(fname) as fh:
+                obj = json.load(fh)
+        self._model_from_json(obj)
+
+    def _model_to_json(self) -> dict:
+        self._configure(None)
+        return {
+            "version": list(_VERSION),
+            "learner": {
+                "attributes": dict(self.attributes_),
+                "feature_names": self.feature_names or [],
+                "feature_types": self.feature_types or [],
+                "learner_model_param": {
+                    "base_score": (self.base_margin_.tolist()
+                                   if self.base_margin_ is not None else [0.0]),
+                    "num_class": int(self.learner_params.get("num_class", 0)),
+                    "num_target": self.n_groups,
+                },
+                "objective": self.obj.to_json() if self.obj else {},
+                "gradient_booster": self.gbm.to_json() if self.gbm else {},
+            },
+            "config": {
+                "tree_param": self.tree_param.to_json(),
+                "learner_params": {k: v for k, v in self.learner_params.items()
+                                   if _jsonable(v)},
+            },
+        }
+
+    def _model_from_json(self, obj: dict) -> None:
+        learner = obj["learner"]
+        cfg = obj.get("config", {})
+        self.tree_param = TrainParam.from_dict(cfg.get("tree_param", {}))
+        self.learner_params.update(cfg.get("learner_params", {}))
+        self.attributes_ = dict(learner.get("attributes", {}))
+        self.feature_names = learner.get("feature_names") or None
+        self.feature_types = learner.get("feature_types") or None
+        lmp = learner.get("learner_model_param", {})
+        self.base_margin_ = np.asarray(lmp.get("base_score", [0.0]),
+                                       dtype=np.float32).reshape(-1)
+        obj_cfg = learner.get("objective", {})
+        name = obj_cfg.get("name", self.learner_params.get(
+            "objective", "reg:squarederror"))
+        self.learner_params["objective"] = name
+        self.obj = get_objective(name, {k: v for k, v in obj_cfg.items()
+                                        if k != "name"})
+        n_groups = max(1, int(lmp.get("num_target", 1)))
+        gb = learner.get("gradient_booster", {})
+        self.gbm = GBTree(self.tree_param, n_groups)
+        if gb:
+            self.gbm.from_json(gb)
+        em = self.learner_params.get("eval_metric")
+        if em:
+            names = em if isinstance(em, (list, tuple)) else [em]
+            self._eval_metrics = [get_metric(n) for n in names]
+        else:
+            self._eval_metrics = [get_metric(self.obj.default_metric)]
+        self._configured = True
+        self._caches = {}
+        self._predictor = None
+        self._predictor_ntrees = -1
+
+    def __getstate__(self):
+        return {"raw": bytes(self.save_raw("json"))}
+
+    def __setstate__(self, state):
+        self.__init__()
+        self.load_model(state["raw"])
+
+    # ----------------------------------------------------------- importances
+    def get_score(self, fmap: str = "", importance_type: str = "weight"
+                  ) -> Dict[str, float]:
+        """Feature importances (reference ``CalcFeatureScore``,
+        ``src/learner.cc``): weight | gain | total_gain | cover | total_cover."""
+        self._configure(None)
+        scores: Dict[int, float] = {}
+        counts: Dict[int, int] = {}
+        for tree in self.gbm.trees:
+            mask = tree.active & ~tree.is_leaf
+            for h in np.nonzero(mask)[0]:
+                f = int(tree.split_feature[h])
+                counts[f] = counts.get(f, 0) + 1
+                if importance_type in ("gain", "total_gain"):
+                    scores[f] = scores.get(f, 0.0) + float(tree.gain[h])
+                elif importance_type in ("cover", "total_cover"):
+                    scores[f] = scores.get(f, 0.0) + float(tree.sum_hess[h])
+                else:
+                    scores[f] = scores.get(f, 0.0) + 1.0
+        if importance_type in ("gain", "cover"):
+            scores = {f: s / counts[f] for f, s in scores.items()}
+
+        def fname(f: int) -> str:
+            if self.feature_names and f < len(self.feature_names):
+                return self.feature_names[f]
+            return f"f{f}"
+
+        return {fname(f): v for f, v in scores.items()}
+
+
+def _jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def train(params: Dict[str, Any], dtrain: DMatrix,
+          num_boost_round: int = 10,
+          *, evals: Sequence[Tuple[DMatrix, str]] = (),
+          obj: Optional[Callable] = None,
+          feval: Optional[Callable] = None,
+          maximize: Optional[bool] = None,
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None,
+          verbose_eval: Union[bool, int, None] = True,
+          xgb_model: Optional[Union[str, Booster]] = None,
+          callbacks: Optional[Sequence] = None,
+          custom_metric: Optional[Callable] = None) -> Booster:
+    """Train loop (reference ``python-package/xgboost/training.py:178``)."""
+    from .callback import (CallbackContainer, EarlyStopping,
+                           EvaluationMonitor)
+
+    callbacks = list(callbacks) if callbacks else []
+    if verbose_eval:
+        period = 1 if verbose_eval is True else int(verbose_eval)
+        callbacks.append(EvaluationMonitor(period=period))
+    if early_stopping_rounds is not None:
+        callbacks.append(EarlyStopping(rounds=early_stopping_rounds,
+                                       maximize=maximize, save_best=False))
+    metric_fn = custom_metric if custom_metric is not None else feval
+    container = CallbackContainer(callbacks, metric=metric_fn)
+
+    if isinstance(xgb_model, Booster):
+        bst = xgb_model
+        bst.set_param(params)
+    elif xgb_model is not None:
+        bst = Booster(params, model_file=xgb_model)
+    else:
+        bst = Booster(params)
+
+    bst = container.before_training(bst)
+    start = bst.num_boosted_rounds()
+    for i in range(start, start + num_boost_round):
+        if container.before_iteration(bst, i):
+            break
+        bst.update(dtrain, i, fobj=obj)
+        if container.after_iteration(bst, i, list(evals)):
+            break
+    bst = container.after_training(bst)
+
+    if evals_result is not None:
+        evals_result.update(container.history)
+    return bst

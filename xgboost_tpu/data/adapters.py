@@ -1,0 +1,64 @@
+"""Input adapters: any supported format -> dense float32 matrix with NaN missing.
+
+Analogue of the reference's adapter zoo (``src/data/adapter.h:139-560``,
+``src/data/array_interface.h``): numpy arrays, scipy CSR/CSC, pandas DataFrames
+(categorical columns encoded to codes), and python sequences all normalise to one
+dense representation, because the TPU training representation (BinnedMatrix) is
+ELLPACK-dense anyway. Sparse zeros become explicit missing (NaN), matching how
+xgboost treats absent CSR entries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+
+def to_dense(data: Any, missing: float = np.nan,
+             feature_names: Optional[List[str]] = None,
+             feature_types: Optional[List[str]] = None,
+             ) -> Tuple[np.ndarray, Optional[List[str]], Optional[List[str]]]:
+    """Returns (X float32 with NaN missing, feature_names, feature_types)."""
+    # pandas
+    if hasattr(data, "dtypes") and hasattr(data, "columns"):
+        import pandas as pd  # soft dep, baked in
+        names = [str(c) for c in data.columns]
+        types: List[str] = []
+        cols = []
+        for c in data.columns:
+            col = data[c]
+            if isinstance(col.dtype, pd.CategoricalDtype):
+                codes = col.cat.codes.to_numpy().astype(np.float32)
+                codes[codes < 0] = np.nan
+                cols.append(codes)
+                types.append("c")
+            else:
+                arr = col.to_numpy()
+                arr = arr.astype(np.float32)
+                cols.append(arr)
+                types.append("int" if np.issubdtype(col.dtype, np.integer) else "float")
+        X = np.stack(cols, axis=1)
+        return _mask_missing(X, missing), feature_names or names, feature_types or types
+
+    # scipy sparse
+    if hasattr(data, "tocsr") and hasattr(data, "nnz"):
+        csr = data.tocsr()
+        X = np.full(csr.shape, np.nan, dtype=np.float32)
+        indptr, indices, values = csr.indptr, csr.indices, csr.data
+        rows = np.repeat(np.arange(csr.shape[0]), np.diff(indptr))
+        X[rows, indices] = values.astype(np.float32)
+        return X, feature_names, feature_types
+
+    # numpy / lists
+    X = np.asarray(data, dtype=np.float32)
+    if X.ndim == 1:
+        X = X[:, None]
+    return _mask_missing(X, missing), feature_names, feature_types
+
+
+def _mask_missing(X: np.ndarray, missing: float) -> np.ndarray:
+    if missing is not None and not np.isnan(missing):
+        X = X.copy()
+        X[X == missing] = np.nan
+    return X

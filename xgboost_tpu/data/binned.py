@@ -1,0 +1,83 @@
+"""Quantized bin matrix — the device-resident training representation.
+
+TPU-native fusion of the reference's ``GHistIndexMatrix`` (CPU,
+``src/data/gradient_index.h:38``) and ``EllpackPage`` (GPU,
+``src/data/ellpack_page.cuh:21``): a dense ``[n_rows, n_features]`` tensor of
+LOCAL bin indices with a **uniform padded layout** — every feature owns
+``max_nbins`` slots where ``max_nbins = max_f(n_real_bins(f)) + 1`` and the last
+slot (``max_nbins - 1``) is the feature's missing-value bin. Dense layout =
+ELLPACK with row_stride == n_features, which is what the MXU wants; histograms
+become dense ``[nodes, features, max_nbins, 2]`` tensors with no ragged
+addressing. Element dtype picked like ``common::Index``'s u8/u16/u32 dispatch
+(reference ``src/common/hist_util.h:210``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .quantile import HistogramCuts
+
+
+def _dtype_for(max_local_bins: int):
+    if max_local_bins <= np.iinfo(np.uint8).max:
+        return np.uint8
+    if max_local_bins <= np.iinfo(np.uint16).max:
+        return np.uint16
+    return np.int32
+
+
+@dataclass
+class BinnedMatrix:
+    """Quantized feature matrix resident in HBM.
+
+    bins: [n_rows, n_features] local bin indices (device array); value
+          ``max_nbins - 1`` means missing.
+    cuts: ragged host-side cut values (for raw-threshold recovery).
+    """
+
+    bins: jnp.ndarray
+    cuts: HistogramCuts
+    max_nbins: int  # uniform per-feature slot count, incl. trailing missing slot
+
+    @property
+    def n_rows(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.bins.shape[1]
+
+    @property
+    def missing_bin(self) -> int:
+        return self.max_nbins - 1
+
+    def n_real_bins(self) -> jnp.ndarray:
+        """[n_features] int32 count of real (non-missing) bins per feature."""
+        return jnp.asarray(self.cuts.n_real_bins())
+
+    @staticmethod
+    def from_dense(X: np.ndarray, cuts: HistogramCuts, device=None) -> "BinnedMatrix":
+        local = cuts.search_bin(np.asarray(X, dtype=np.float32))
+        max_nbins = int(cuts.n_real_bins().max(initial=0)) + 1
+        local = np.where(local < 0, max_nbins - 1, local)
+        arr = local.astype(_dtype_for(max_nbins - 1))
+        bins = (jax.device_put(arr, device) if device is not None
+                else jnp.asarray(arr))
+        return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins)
+
+    @staticmethod
+    def from_local_bins(local: np.ndarray, cuts: HistogramCuts,
+                        max_nbins: Optional[int] = None, device=None) -> "BinnedMatrix":
+        """Wrap precomputed local bins (missing already mapped to max_nbins-1)."""
+        if max_nbins is None:
+            max_nbins = int(cuts.n_real_bins().max(initial=0)) + 1
+        arr = np.asarray(local).astype(_dtype_for(max_nbins - 1))
+        bins = (jax.device_put(arr, device) if device is not None
+                else jnp.asarray(arr))
+        return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins)

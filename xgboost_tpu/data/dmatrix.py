@@ -1,0 +1,258 @@
+"""DMatrix / QuantileDMatrix — the user-facing data containers.
+
+Analogue of the reference's ``DMatrix`` + ``MetaInfo``
+(``include/xgboost/data.h:48-209,508``) and ``IterativeDMatrix``
+(``src/data/iterative_dmatrix.cc``): metadata (labels, weights, base_margin,
+query groups, feature names/types) rides next to the feature payload; the
+quantized ``BinnedMatrix`` is built lazily at first training touch (the reference
+builds ``GHistIndexMatrix`` on first ``GetBatches`` call) or eagerly in two
+passes for ``QuantileDMatrix`` (pass 1 sketch, pass 2 fill — with ``ref=`` cut
+sharing as in ``GetCutsFromRef``, ``src/data/iterative_dmatrix.cc:54-93``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterator, List, Optional
+
+import numpy as np
+
+from .adapters import to_dense
+from .binned import BinnedMatrix
+from .quantile import FeatureSummary, HistogramCuts, cuts_from_summaries, sketch_matrix
+
+
+@dataclass
+class MetaInfo:
+    """Labels & friends (reference ``MetaInfo``, ``include/xgboost/data.h:48``)."""
+
+    labels: Optional[np.ndarray] = None        # [n] or [n, n_targets]
+    weights: Optional[np.ndarray] = None       # [n] row weights
+    base_margin: Optional[np.ndarray] = None   # [n] or [n, n_groups]
+    group_ptr: Optional[np.ndarray] = None     # [n_query+1] ranking group offsets
+    label_lower_bound: Optional[np.ndarray] = None  # survival AFT
+    label_upper_bound: Optional[np.ndarray] = None
+    feature_names: Optional[List[str]] = None
+    feature_types: Optional[List[str]] = None
+    # 'row' (data-parallel) or 'col' (feature-parallel), reference DataSplitMode
+    data_split_mode: str = "row"
+
+    def validate(self, n_rows: int) -> None:
+        for name in ("labels", "weights", "base_margin",
+                     "label_lower_bound", "label_upper_bound"):
+            v = getattr(self, name)
+            if v is not None and v.shape[0] != n_rows:
+                raise ValueError(
+                    f"{name} has {v.shape[0]} entries, expected {n_rows}")
+        if self.group_ptr is not None and self.group_ptr[-1] != n_rows:
+            raise ValueError("group_ptr must cover all rows")
+
+    def set_group(self, group_sizes: np.ndarray) -> None:
+        self.group_ptr = np.concatenate(
+            [[0], np.cumsum(np.asarray(group_sizes, dtype=np.int64))]).astype(np.int64)
+
+
+class DMatrix:
+    """In-memory data matrix (reference ``SimpleDMatrix``)."""
+
+    def __init__(self, data: Any, label: Any = None, *, weight: Any = None,
+                 base_margin: Any = None, missing: float = np.nan,
+                 feature_names: Optional[List[str]] = None,
+                 feature_types: Optional[List[str]] = None,
+                 group: Any = None, qid: Any = None,
+                 label_lower_bound: Any = None, label_upper_bound: Any = None,
+                 enable_categorical: bool = False) -> None:
+        X, names, types = to_dense(data, missing, feature_names, feature_types)
+        self.X = X
+        self.info = MetaInfo(feature_names=names, feature_types=types)
+        if not enable_categorical and types is not None and "c" in types:
+            raise ValueError(
+                "categorical features present; pass enable_categorical=True")
+        if label is not None:
+            self.info.labels = np.asarray(label, dtype=np.float32)
+        if weight is not None:
+            self.info.weights = np.asarray(weight, dtype=np.float32)
+        if base_margin is not None:
+            self.info.base_margin = np.asarray(base_margin, dtype=np.float32)
+        if label_lower_bound is not None:
+            self.info.label_lower_bound = np.asarray(label_lower_bound, np.float32)
+        if label_upper_bound is not None:
+            self.info.label_upper_bound = np.asarray(label_upper_bound, np.float32)
+        if group is not None:
+            self.info.set_group(np.asarray(group))
+        elif qid is not None:
+            qid = np.asarray(qid)
+            if np.any(qid[1:] < qid[:-1]):
+                raise ValueError("qid must be sorted")
+            _, counts = np.unique(qid, return_counts=True)
+            self.info.set_group(counts)
+        self.info.validate(self.num_row())
+        self._binned: Optional[BinnedMatrix] = None
+        self._binned_max_bin: Optional[int] = None
+
+    # --- shape --------------------------------------------------------------
+    def num_row(self) -> int:
+        return self.X.shape[0]
+
+    def num_col(self) -> int:
+        return self.X.shape[1]
+
+    @property
+    def shape(self):
+        return self.X.shape
+
+    # --- meta setters (reference set_info style) ------------------------------
+    def set_info(self, **kwargs: Any) -> None:
+        for k, v in kwargs.items():
+            if k == "group":
+                self.info.set_group(np.asarray(v))
+            elif k in ("label", "weight", "base_margin"):
+                attr = {"label": "labels", "weight": "weights",
+                        "base_margin": "base_margin"}[k]
+                setattr(self.info, attr, np.asarray(v, dtype=np.float32))
+            else:
+                setattr(self.info, k, v)
+        self.info.validate(self.num_row())
+
+    def get_label(self) -> Optional[np.ndarray]:
+        return self.info.labels
+
+    # --- quantization --------------------------------------------------------
+    def binned(self, max_bin: int = 256,
+               ref_cuts: Optional[HistogramCuts] = None) -> BinnedMatrix:
+        """Lazily build (and cache) the quantized representation. A cached
+        matrix built with different cuts than the requested ``ref_cuts`` is
+        rebuilt — split_bin indices are only meaningful against the cuts the
+        trees were trained with."""
+        stale = (self._binned is None
+                 or (ref_cuts is not None and self._binned.cuts is not ref_cuts)
+                 or (ref_cuts is None and self._binned_max_bin != max_bin))
+        if stale:
+            cuts = ref_cuts if ref_cuts is not None else sketch_matrix(
+                self.X, max_bin, self.info.weights)
+            self._binned = BinnedMatrix.from_dense(self.X, cuts)
+            self._binned_max_bin = max_bin
+        return self._binned
+
+    def slice(self, rindex: np.ndarray) -> "DMatrix":
+        rindex = np.asarray(rindex)
+        out = DMatrix(self.X[rindex])
+        info = self.info
+        out.info = MetaInfo(
+            labels=None if info.labels is None else info.labels[rindex],
+            weights=None if info.weights is None else info.weights[rindex],
+            base_margin=(None if info.base_margin is None
+                         else info.base_margin[rindex]),
+            label_lower_bound=(None if info.label_lower_bound is None
+                               else info.label_lower_bound[rindex]),
+            label_upper_bound=(None if info.label_upper_bound is None
+                               else info.label_upper_bound[rindex]),
+            feature_names=info.feature_names, feature_types=info.feature_types)
+        return out
+
+
+class DataIter:
+    """External-memory data iterator ABC (reference ``DataIter``, core.py:490).
+
+    Subclasses implement ``next(input_data)`` calling ``input_data(data=..,
+    label=.., ...)`` per batch and returning 1, or returning 0 at the end, plus
+    ``reset()``."""
+
+    def __init__(self) -> None:
+        self._batches: List[dict] = []
+
+    def next(self, input_data) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def reset(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def collect(self) -> Iterator[dict]:
+        """Drive the callback protocol and yield raw batch dicts."""
+        self.reset()
+        while True:
+            batches: List[dict] = []
+
+            def input_data(**kwargs: Any) -> None:
+                batches.append(kwargs)
+
+            if not self.next(input_data):
+                break
+            for b in batches:
+                yield b
+        self.reset()
+
+
+class QuantileDMatrix(DMatrix):
+    """Two-pass quantized DMatrix (reference ``IterativeDMatrix``): pass 1
+    sketches cuts across all batches (or reuses ``ref``'s), pass 2 bins each
+    batch; the float matrix is not retained when built from an iterator."""
+
+    def __init__(self, data: Any, label: Any = None, *, max_bin: int = 256,
+                 ref: Optional[DMatrix] = None, missing: float = np.nan,
+                 weight: Any = None, base_margin: Any = None,
+                 feature_names: Optional[List[str]] = None,
+                 feature_types: Optional[List[str]] = None,
+                 group: Any = None, qid: Any = None,
+                 enable_categorical: bool = False) -> None:
+        self.max_bin = max_bin
+        if isinstance(data, DataIter):
+            self._init_from_iter(data, max_bin, ref, missing)
+        else:
+            super().__init__(data, label, weight=weight, base_margin=base_margin,
+                             missing=missing, feature_names=feature_names,
+                             feature_types=feature_types, group=group, qid=qid,
+                             enable_categorical=enable_categorical)
+            ref_cuts = None
+            if ref is not None:
+                ref_cuts = ref.binned(max_bin).cuts
+            self.binned(max_bin, ref_cuts=ref_cuts)
+
+    def _init_from_iter(self, it: DataIter, max_bin: int,
+                        ref: Optional[DMatrix], missing: float) -> None:
+        # pass 1: sketch (or copy ref cuts)
+        raw: List[np.ndarray] = []
+        labels, weights, margins, qids = [], [], [], []
+        for batch in it.collect():
+            X, _, _ = to_dense(batch["data"], missing)
+            raw.append(X)
+            if batch.get("label") is not None:
+                labels.append(np.asarray(batch["label"], dtype=np.float32))
+            if batch.get("weight") is not None:
+                weights.append(np.asarray(batch["weight"], dtype=np.float32))
+            if batch.get("base_margin") is not None:
+                margins.append(np.asarray(batch["base_margin"], dtype=np.float32))
+            if batch.get("qid") is not None:
+                qids.append(np.asarray(batch["qid"]))
+        X = np.concatenate(raw, axis=0) if raw else np.empty((0, 0), np.float32)
+        self.X = X
+        self.info = MetaInfo()
+        if labels:
+            self.info.labels = np.concatenate(labels)
+        if weights:
+            self.info.weights = np.concatenate(weights)
+        if margins:
+            self.info.base_margin = np.concatenate(margins)
+        if qids:
+            q = np.concatenate(qids)
+            _, counts = np.unique(q, return_counts=True)
+            self.info.set_group(counts)
+        self._binned = None
+        self._binned_max_bin = None
+        if ref is not None:
+            cuts = ref.binned(max_bin).cuts
+        else:
+            summaries = None
+            for Xb in raw:
+                batch_s = [FeatureSummary.from_data(Xb[:, f])
+                           for f in range(Xb.shape[1])]
+                if summaries is None:
+                    summaries = batch_s
+                else:
+                    summaries = [a.merge(b).prune(max_bin * 8)
+                                 for a, b in zip(summaries, batch_s)]
+            cuts = cuts_from_summaries(summaries or [], max_bin)
+        # pass 2: fill
+        self._binned = BinnedMatrix.from_dense(X, cuts)
+        self._binned_max_bin = max_bin
+        self.info.validate(self.num_row())

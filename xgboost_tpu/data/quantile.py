@@ -1,0 +1,193 @@
+"""Weighted quantile sketch -> histogram cuts.
+
+TPU-native replacement for the reference's GK-style weighted quantile machinery
+(``src/common/quantile.h:34-1000``, ``src/common/hist_util.cc:32-69``): per-feature
+merge-able weighted summaries (value, total weight) built on host with numpy,
+pruned to ``max_bin`` cut points at evenly spaced weighted ranks. Summaries from
+different row shards merge by concatenate+sort+re-accumulate, which is how the
+distributed sketch sync (``src/common/quantile.cc:147-390`` allgatherv + merge) is
+realised here (see parallel/collective.py).
+
+Cut storage is ragged on host (``values``/``ptrs`` over REAL bins only, exactly
+like ``common::HistogramCuts``); the device-side training layout pads every
+feature to a uniform ``max_nbins`` slot count with a trailing missing-value slot
+(see data/binned.py) so histograms are dense ``[nodes, features, bins]`` tensors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class FeatureSummary:
+    """Merge-able weighted summary of one feature: sorted unique values and the
+    total weight on each (exact when built from in-memory data; a pruned version
+    bounds memory like ``WQSummary::Prune``)."""
+
+    values: np.ndarray   # [k] f64 sorted unique
+    weights: np.ndarray  # [k] f64 total weight per value
+
+    @staticmethod
+    def from_data(col: np.ndarray, weights: Optional[np.ndarray] = None) -> "FeatureSummary":
+        mask = ~np.isnan(col)
+        v = col[mask].astype(np.float64)
+        w = (np.ones_like(v) if weights is None else weights[mask].astype(np.float64))
+        if v.size == 0:
+            return FeatureSummary(np.empty(0), np.empty(0))
+        order = np.argsort(v, kind="stable")
+        v, w = v[order], w[order]
+        uniq, start = np.unique(v, return_index=True)
+        wsum = np.add.reduceat(w, start)
+        return FeatureSummary(uniq, wsum)
+
+    def merge(self, other: "FeatureSummary") -> "FeatureSummary":
+        if self.values.size == 0:
+            return other
+        if other.values.size == 0:
+            return self
+        v = np.concatenate([self.values, other.values])
+        w = np.concatenate([self.weights, other.weights])
+        order = np.argsort(v, kind="stable")
+        v, w = v[order], w[order]
+        uniq, start = np.unique(v, return_index=True)
+        return FeatureSummary(uniq, np.add.reduceat(w, start))
+
+    def prune(self, max_size: int) -> "FeatureSummary":
+        """Keep ~max_size entries at evenly spaced weighted ranks (plus extremes);
+        dropped weight is re-aggregated onto the kept representative at/after it."""
+        k = self.values.size
+        if k <= max_size:
+            return self
+        cum = np.cumsum(self.weights)
+        total = cum[-1]
+        ranks = np.linspace(0.0, total, max_size)
+        idx = np.searchsorted(cum, ranks, side="left")
+        idx = np.unique(np.clip(idx, 0, k - 1))
+        if idx[0] != 0:
+            idx = np.concatenate([[0], idx])
+        if idx[-1] != k - 1:
+            idx = np.concatenate([idx, [k - 1]])
+        seg = np.searchsorted(idx, np.arange(k), side="left")
+        seg = np.clip(seg, 0, idx.size - 1)
+        w = np.bincount(seg, weights=self.weights, minlength=idx.size)
+        return FeatureSummary(self.values[idx], w)
+
+    def to_arrays(self):
+        return self.values, self.weights
+
+
+@dataclass
+class HistogramCuts:
+    """Quantile cut points, the analogue of ``common::HistogramCuts``
+    (reference ``src/common/hist_util.h:37-127``).
+
+    ``values[ptrs[f] + i]`` is the inclusive upper bound of REAL bin ``i`` of
+    feature ``f`` (value v falls in bin i iff values[i-1] < v <= values[i]);
+    ``min_vals[f]`` is below the smallest observed value. Missing values are not
+    represented here — the device layout (binned.py) appends one uniform
+    missing slot per feature.
+    """
+
+    values: np.ndarray    # [total_real_bins] f32
+    ptrs: np.ndarray      # [n_features + 1] int32
+    min_vals: np.ndarray  # [n_features] f32
+    max_bin: int = 256
+
+    @property
+    def n_features(self) -> int:
+        return len(self.ptrs) - 1
+
+    @property
+    def total_bins(self) -> int:
+        return int(self.ptrs[-1])
+
+    def n_bins(self, f: int) -> int:
+        """REAL bins of feature f (no missing slot)."""
+        return int(self.ptrs[f + 1] - self.ptrs[f])
+
+    def n_real_bins(self) -> np.ndarray:
+        return np.diff(self.ptrs).astype(np.int32)
+
+    def search_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized SearchBin over a dense [n, n_features] float matrix ->
+        LOCAL real-bin indices; missing (NaN) -> -1."""
+        n, nf = values.shape
+        out = np.empty((n, nf), dtype=np.int32)
+        for f in range(nf):
+            lo, hi = int(self.ptrs[f]), int(self.ptrs[f + 1])
+            cuts = self.values[lo:hi]
+            col = values[:, f]
+            miss = np.isnan(col)
+            b = np.searchsorted(cuts, col, side="left")
+            b = np.minimum(b, hi - lo - 1)  # clamp overflow into last real bin
+            b[miss] = -1
+            out[:, f] = b
+        return out
+
+    def split_value(self, f: int, local_bin: int) -> float:
+        """Raw-feature threshold of a split at (f, local_bin): x goes left iff
+        x <= split_value."""
+        return float(self.values[int(self.ptrs[f]) + int(local_bin)])
+
+    def to_json(self) -> dict:
+        return {
+            "values": np.asarray(self.values, dtype=np.float64).tolist(),
+            "ptrs": self.ptrs.tolist(),
+            "min_vals": np.asarray(self.min_vals, dtype=np.float64).tolist(),
+            "max_bin": self.max_bin,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "HistogramCuts":
+        return HistogramCuts(
+            values=np.asarray(obj["values"], dtype=np.float32),
+            ptrs=np.asarray(obj["ptrs"], dtype=np.int32),
+            min_vals=np.asarray(obj["min_vals"], dtype=np.float32),
+            max_bin=int(obj.get("max_bin", 256)),
+        )
+
+
+def cuts_from_summaries(summaries: Sequence[FeatureSummary], max_bin: int) -> HistogramCuts:
+    """Build cuts at evenly spaced weighted ranks, mirroring
+    ``HistogramCuts::Build`` semantics (last cut strictly above the max value so
+    every observed value lands in a real bin)."""
+    values: List[np.ndarray] = []
+    ptrs = [0]
+    min_vals = []
+    for s in summaries:
+        if s.values.size == 0:
+            cuts = np.asarray([np.inf], dtype=np.float32)
+            min_vals.append(0.0)
+        else:
+            vmin, vmax = float(s.values[0]), float(s.values[-1])
+            if s.values.size <= max_bin:
+                pts = s.values.astype(np.float64)
+            else:
+                cum = np.cumsum(s.weights)
+                total = cum[-1]
+                ranks = (np.arange(1, max_bin + 1) / max_bin) * total
+                idx = np.searchsorted(cum, ranks, side="left")
+                idx = np.unique(np.clip(idx, 0, s.values.size - 1))
+                pts = s.values[idx].astype(np.float64)
+            last = vmax + (abs(vmax) * 1e-5 + 1e-5)
+            cuts = np.unique(np.concatenate([pts[:-1], [last]])).astype(np.float32)
+            min_vals.append(vmin - (abs(vmin) * 1e-5 + 1e-5))
+        values.append(cuts)
+        ptrs.append(ptrs[-1] + len(cuts))
+    out = (np.concatenate(values) if values
+           else np.empty(0, dtype=np.float32)).astype(np.float32)
+    return HistogramCuts(values=out, ptrs=np.asarray(ptrs, dtype=np.int32),
+                         min_vals=np.asarray(min_vals, dtype=np.float32),
+                         max_bin=max_bin)
+
+
+def sketch_matrix(X: np.ndarray, max_bin: int,
+                  weights: Optional[np.ndarray] = None) -> HistogramCuts:
+    """``SketchOnDMatrix`` analogue (reference ``src/common/hist_util.cc:32-69``)
+    for an in-memory dense matrix with NaN as missing."""
+    summaries = [FeatureSummary.from_data(X[:, f], weights) for f in range(X.shape[1])]
+    return cuts_from_summaries(summaries, max_bin)

@@ -1,0 +1,17 @@
+"""Evaluation metrics.
+
+Analogue of ``Metric`` (reference ``include/xgboost/metric.h:29``;
+implementations ``src/metric/elementwise_metric.cu``, ``multiclass_metric.cu``,
+``auc.cc``). Each metric reduces (preds, info) to a scalar; distributed
+aggregation composes the partial (sum, weight) pair across workers exactly like
+the reference's ``PackedReduceResult`` + ``GlobalRatio``.
+"""
+
+from __future__ import annotations
+
+from .base import Metric, get_metric
+from . import elementwise  # noqa: F401  (registers)
+from . import multiclass  # noqa: F401
+from . import auc  # noqa: F401
+
+__all__ = ["Metric", "get_metric"]

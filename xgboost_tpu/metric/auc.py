@@ -1,0 +1,99 @@
+"""AUC / AUC-PR (reference ``src/metric/auc.cc:378,456``).
+
+Binary ROC-AUC via the rank-sum formulation with weight support; multiclass =
+weighted one-vs-rest average (matching the reference's OVR handling).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+def binary_roc_auc(labels: np.ndarray, preds: np.ndarray,
+                   weights: np.ndarray) -> float:
+    order = np.argsort(-preds, kind="stable")
+    y, p, w = labels[order], preds[order], weights[order]
+    pos_w = np.where(y > 0.5, w, 0.0)
+    neg_w = np.where(y > 0.5, 0.0, w)
+    cum_pos = np.cumsum(pos_w)
+    cum_neg = np.cumsum(neg_w)
+    total_pos, total_neg = cum_pos[-1], cum_neg[-1]
+    if total_pos <= 0 or total_neg <= 0:
+        return float("nan")
+    # group ties: area added per distinct prediction via trapezoid rule
+    boundary = np.concatenate([p[1:] != p[:-1], [True]])
+    tp = cum_pos[boundary]
+    fp = cum_neg[boundary]
+    tp0 = np.concatenate([[0.0], tp[:-1]])
+    fp0 = np.concatenate([[0.0], fp[:-1]])
+    area = np.sum((fp - fp0) * (tp + tp0) / 2.0)
+    return float(area / (total_pos * total_neg))
+
+
+def binary_pr_auc(labels: np.ndarray, preds: np.ndarray,
+                  weights: np.ndarray) -> float:
+    order = np.argsort(-preds, kind="stable")
+    y, p, w = labels[order], preds[order], weights[order]
+    pos_w = np.where(y > 0.5, w, 0.0)
+    neg_w = np.where(y > 0.5, 0.0, w)
+    cum_pos = np.cumsum(pos_w)
+    cum_neg = np.cumsum(neg_w)
+    total_pos = cum_pos[-1]
+    if total_pos <= 0:
+        return float("nan")
+    boundary = np.concatenate([p[1:] != p[:-1], [True]])
+    tp = cum_pos[boundary]
+    fp = cum_neg[boundary]
+    prec = tp / np.maximum(tp + fp, 1e-16)
+    rec = tp / total_pos
+    rec0 = np.concatenate([[0.0], rec[:-1]])
+    return float(np.sum((rec - rec0) * prec))
+
+
+class _AucBase(Metric):
+    maximize = True
+    _fn = staticmethod(binary_roc_auc)
+
+    def __call__(self, preds, info) -> float:
+        y = np.asarray(info.labels, dtype=np.float64).reshape(-1)
+        p = np.asarray(preds, dtype=np.float64)
+        w = self.weights_of(info, len(y))
+        if info.group_ptr is not None and len(info.group_ptr) > 2:
+            # ranking AUC: mean per-query AUC, weighted by query weight
+            ptr = info.group_ptr
+            aucs, ws = [], []
+            for q in range(len(ptr) - 1):
+                s, e = int(ptr[q]), int(ptr[q + 1])
+                if e - s < 2:
+                    continue
+                a = self._fn(y[s:e], p[s:e], np.ones(e - s))
+                if not np.isnan(a):
+                    aucs.append(a)
+                    ws.append(1.0)
+            return float(np.average(aucs, weights=ws)) if aucs else float("nan")
+        if p.ndim == 2 and p.shape[1] > 1:
+            # multiclass OVR, class-weighted like the reference
+            total, wsum = 0.0, 0.0
+            for c in range(p.shape[1]):
+                a = self._fn((y == c).astype(np.float64), p[:, c], w)
+                cw = np.sum(w[y == c])
+                if not np.isnan(a):
+                    total += a * cw
+                    wsum += cw
+            return float(total / wsum) if wsum > 0 else float("nan")
+        return self._fn(y, p, w)
+
+
+@METRICS.register("auc")
+class AUC(_AucBase):
+    name = "auc"
+    _fn = staticmethod(binary_roc_auc)
+
+
+@METRICS.register("aucpr")
+class AUCPR(_AucBase):
+    name = "aucpr"
+    _fn = staticmethod(binary_pr_auc)

@@ -1,0 +1,41 @@
+"""Metric base: named factory with @param suffix parsing (error@t, ndcg@k)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..registry import METRICS
+
+
+class Metric:
+    name: str = ""
+    # True when larger values are better (drives early stopping, reference
+    # callback.py maximize-metric table)
+    maximize: bool = False
+
+    def __init__(self, param: Optional[str] = None) -> None:
+        self.param = param
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.name}@{self.param}" if self.param is not None else self.name
+
+    def __call__(self, preds: np.ndarray, info) -> float:
+        """preds: transformed predictions [n] or [n, k]; info: MetaInfo."""
+        raise NotImplementedError
+
+    @staticmethod
+    def weights_of(info, n: int) -> np.ndarray:
+        if info.weights is not None:
+            return np.asarray(info.weights, dtype=np.float64)
+        return np.ones(n, dtype=np.float64)
+
+
+def get_metric(name: str) -> Metric:
+    if "@" in name:
+        base, param = name.split("@", 1)
+        if base in METRICS:
+            return METRICS.create(base, param)
+    return METRICS.create(name)

@@ -1,0 +1,155 @@
+"""Elementwise metrics (reference ``src/metric/elementwise_metric.cu:379-501``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+def _labels1d(info) -> np.ndarray:
+    y = np.asarray(info.labels, dtype=np.float64)
+    return y.reshape(-1) if y.ndim > 1 and y.shape[1] == 1 else y
+
+
+class _WeightedMean(Metric):
+    def per_row(self, preds: np.ndarray, labels: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def finalize(self, mean: float) -> float:
+        return mean
+
+    def __call__(self, preds, info) -> float:
+        y = _labels1d(info)
+        p = np.asarray(preds, dtype=np.float64).reshape(y.shape)
+        w = self.weights_of(info, len(y))
+        loss = self.per_row(p, y)
+        return float(self.finalize(np.sum(loss * w) / np.sum(w)))
+
+
+@METRICS.register("rmse")
+class RMSE(_WeightedMean):
+    name = "rmse"
+
+    def per_row(self, p, y):
+        return np.square(p - y)
+
+    def finalize(self, mean):
+        return np.sqrt(mean)
+
+
+@METRICS.register("rmsle")
+class RMSLE(_WeightedMean):
+    name = "rmsle"
+
+    def per_row(self, p, y):
+        return np.square(np.log1p(p) - np.log1p(y))
+
+    def finalize(self, mean):
+        return np.sqrt(mean)
+
+
+@METRICS.register("mae")
+class MAE(_WeightedMean):
+    name = "mae"
+
+    def per_row(self, p, y):
+        return np.abs(p - y)
+
+
+@METRICS.register("mape")
+class MAPE(_WeightedMean):
+    name = "mape"
+
+    def per_row(self, p, y):
+        return np.abs((y - p) / np.maximum(np.abs(y), 1e-16))
+
+
+@METRICS.register("mphe")
+class MPHE(_WeightedMean):
+    name = "mphe"
+
+    def per_row(self, p, y):
+        return np.sqrt(1.0 + np.square(p - y)) - 1.0
+
+
+@METRICS.register("logloss")
+class LogLoss(_WeightedMean):
+    name = "logloss"
+
+    def per_row(self, p, y):
+        eps = 1e-16
+        p = np.clip(p, eps, 1.0 - eps)
+        return -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+
+
+@METRICS.register("error")
+class BinaryError(Metric):
+    """error@t: share of |pred > t| != label (default t=0.5)."""
+
+    name = "error"
+
+    def __call__(self, preds, info) -> float:
+        t = float(self.param) if self.param is not None else 0.5
+        y = _labels1d(info)
+        p = np.asarray(preds, dtype=np.float64).reshape(y.shape)
+        w = self.weights_of(info, len(y))
+        wrong = (p > t).astype(np.float64) != (y > 0.5)
+        return float(np.sum(wrong * w) / np.sum(w))
+
+
+@METRICS.register("poisson-nloglik")
+class PoissonNLL(_WeightedMean):
+    name = "poisson-nloglik"
+
+    def per_row(self, p, y):
+        from scipy.special import gammaln
+        p = np.maximum(p, 1e-16)
+        return p - y * np.log(p) + gammaln(y + 1.0)
+
+
+@METRICS.register("gamma-nloglik")
+class GammaNLL(_WeightedMean):
+    name = "gamma-nloglik"
+
+    def per_row(self, p, y):
+        psi = 1.0
+        theta = -1.0 / np.maximum(p, 1e-16)
+        a = psi
+        b = -np.log(-theta)
+        return -((y * theta - b) / a + _gamma_c(y, psi))
+
+
+def _gamma_c(y: np.ndarray, psi: float) -> np.ndarray:
+    from scipy.special import gammaln
+    return (psi - 1.0) / psi * np.log(np.maximum(y, 1e-16)) \
+        - np.log(psi) / psi - gammaln(1.0 / psi)
+
+
+@METRICS.register("gamma-deviance")
+class GammaDeviance(_WeightedMean):
+    name = "gamma-deviance"
+
+    def per_row(self, p, y):
+        eps = 1e-16
+        r = y / np.maximum(p, eps)
+        return 2.0 * (np.maximum(r, eps) - np.log(np.maximum(r, eps)) - 1.0)
+
+    def finalize(self, mean):
+        return mean
+
+
+@METRICS.register("tweedie-nloglik")
+class TweedieNLL(Metric):
+    name = "tweedie-nloglik"
+
+    def __call__(self, preds, info) -> float:
+        rho = float(self.param) if self.param is not None else 1.5
+        y = _labels1d(info)
+        p = np.maximum(np.asarray(preds, dtype=np.float64).reshape(y.shape), 1e-16)
+        w = self.weights_of(info, len(y))
+        a = y * np.power(p, 1.0 - rho) / (1.0 - rho)
+        b = np.power(p, 2.0 - rho) / (2.0 - rho)
+        loss = -a + b
+        return float(np.sum(loss * w) / np.sum(w))

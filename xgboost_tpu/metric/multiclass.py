@@ -1,0 +1,33 @@
+"""Multiclass metrics (reference ``src/metric/multiclass_metric.cu:241-245``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+@METRICS.register("merror")
+class MultiError(Metric):
+    name = "merror"
+
+    def __call__(self, preds, info) -> float:
+        y = np.asarray(info.labels).reshape(-1).astype(np.int64)
+        p = np.asarray(preds)
+        cls = p.argmax(axis=1) if p.ndim == 2 else p.astype(np.int64)
+        w = self.weights_of(info, len(y))
+        return float(np.sum((cls != y) * w) / np.sum(w))
+
+
+@METRICS.register("mlogloss")
+class MultiLogLoss(Metric):
+    name = "mlogloss"
+
+    def __call__(self, preds, info) -> float:
+        y = np.asarray(info.labels).reshape(-1).astype(np.int64)
+        p = np.asarray(preds, dtype=np.float64)
+        eps = 1e-16
+        picked = np.clip(p[np.arange(len(y)), y], eps, 1.0)
+        w = self.weights_of(info, len(y))
+        return float(np.sum(-np.log(picked) * w) / np.sum(w))

@@ -1,0 +1,16 @@
+"""Objective functions.
+
+Analogue of ``ObjFunction`` (reference ``include/xgboost/objective.h:29-134``):
+an objective turns margins into a gradient/hessian tensor, transforms margins to
+predictions, and estimates the initial base score (``InitEstimation`` -> one
+Newton step, reference ``src/tree/fit_stump.cc:25-58``). Gradients are pure jnp
+functions so they jit/fuse and run on whatever device the margins live on.
+"""
+
+from __future__ import annotations
+
+from .base import Objective, get_objective
+from . import regression  # noqa: F401  (registers)
+from . import multiclass  # noqa: F401
+
+__all__ = ["Objective", "get_objective"]

@@ -1,0 +1,86 @@
+"""Objective base class + task descriptor."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import OBJECTIVES
+
+
+@dataclass
+class ObjInfo:
+    """Task descriptor (reference ``include/xgboost/task.h:24-36``)."""
+
+    task: str = "regression"        # regression | binary | classification | ranking | survival
+    const_hess: bool = False
+    zero_hess: bool = False         # adaptive-leaf objectives (mae, quantile)
+
+
+class Objective:
+    """Base objective. Subclasses override gradient/transform hooks.
+
+    Shapes: margins are [n, k] (k = n_targets, 1 for most objectives); the
+    gradient result is [n, k, 2] packing (grad, hess) — the analogue of the
+    reference's ``GradientPair`` matrix (``linalg::Matrix<GradientPair>``).
+    """
+
+    name: str = ""
+    default_metric: str = "rmse"
+    info = ObjInfo()
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None) -> None:
+        self.params: Dict[str, Any] = {}
+        if params:
+            self.configure(params)
+
+    def configure(self, params: Dict[str, Any]) -> None:
+        self.params.update(params)
+
+    # -- shape ---------------------------------------------------------------
+    def n_targets(self, info) -> int:
+        if info is not None and info.labels is not None and info.labels.ndim == 2:
+            return info.labels.shape[1]
+        return 1
+
+    # -- core hooks ----------------------------------------------------------
+    def gradient(self, preds: jnp.ndarray, labels: jnp.ndarray,
+                 iteration: int = 0) -> jnp.ndarray:
+        """preds/labels [n, k] -> [n, k, 2]."""
+        raise NotImplementedError
+
+    def get_gradient(self, preds: jnp.ndarray, info,
+                     iteration: int = 0) -> jnp.ndarray:
+        labels = jnp.asarray(info.labels, dtype=jnp.float32)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        gpair = self.gradient(preds, labels, iteration)
+        if info.weights is not None:
+            w = jnp.asarray(info.weights, dtype=jnp.float32)
+            gpair = gpair * w[:, None, None]
+        return gpair
+
+    def pred_transform(self, margin: jnp.ndarray) -> jnp.ndarray:
+        return margin
+
+    def prob_to_margin(self, prob: np.ndarray) -> np.ndarray:
+        return prob
+
+    def init_estimation(self, info) -> np.ndarray:
+        """One Newton step from margin 0 (reference fit_stump)."""
+        k = self.n_targets(info)
+        zero = jnp.zeros((len(info.labels), k), dtype=jnp.float32)
+        gpair = np.asarray(self.get_gradient(zero, info))
+        g = gpair[..., 0].sum(axis=0)
+        h = gpair[..., 1].sum(axis=0)
+        return np.where(h <= 0, 0.0, -g / np.maximum(h, 1e-10)).astype(np.float32)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, **{k: str(v) for k, v in self.params.items()}}
+
+
+def get_objective(name: str, params: Optional[Dict[str, Any]] = None) -> Objective:
+    return OBJECTIVES.create(name, params)

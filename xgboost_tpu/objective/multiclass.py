@@ -1,0 +1,56 @@
+"""Multiclass softmax objectives (reference ``src/objective/multiclass_obj.cu``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import OBJECTIVES
+from .base import ObjInfo, Objective
+
+
+class _SoftmaxBase(Objective):
+    info = ObjInfo("classification")
+    default_metric = "mlogloss"
+
+    def n_targets(self, info) -> int:
+        nc = int(self.params.get("num_class", 0))
+        if nc < 2:
+            raise ValueError("num_class must be set (>=2) for multi:softmax/softprob")
+        return nc
+
+    def gradient(self, preds, labels, iteration=0):
+        # preds [n, K] margins; labels [n, 1] class ids
+        K = preds.shape[1]
+        p = _softmax(preds)
+        y = labels[:, 0].astype(jnp.int32)
+        onehot = (y[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :])
+        g = p - onehot.astype(jnp.float32)
+        h = jnp.maximum(2.0 * p * (1.0 - p), 1e-16)
+        return jnp.stack([g, h], axis=-1)
+
+    def init_estimation(self, info):
+        return np.zeros(self.n_targets(info), dtype=np.float32)
+
+
+def _softmax(x: jnp.ndarray) -> jnp.ndarray:
+    x = x - jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x)
+    return e / jnp.sum(e, axis=1, keepdims=True)
+
+
+@OBJECTIVES.register("multi:softprob")
+class SoftProb(_SoftmaxBase):
+    name = "multi:softprob"
+
+    def pred_transform(self, margin):
+        return _softmax(margin)
+
+
+@OBJECTIVES.register("multi:softmax")
+class SoftMax(_SoftmaxBase):
+    name = "multi:softmax"
+    default_metric = "merror"
+
+    def pred_transform(self, margin):
+        return jnp.argmax(margin, axis=1).astype(jnp.float32)

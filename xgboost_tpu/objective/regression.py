@@ -1,0 +1,188 @@
+"""Regression / binary objectives.
+
+Gradient formulas mirror the reference ``src/objective/regression_obj.cu:184-763``
+and ``hinge.cu``; each is an elementwise jnp function of (margin, label).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import OBJECTIVES
+from .base import ObjInfo, Objective
+
+
+def _sigmoid(x: jnp.ndarray) -> jnp.ndarray:
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+def _pack(g: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([g, h], axis=-1)
+
+
+@OBJECTIVES.register("reg:squarederror", "reg:linear")
+class SquaredError(Objective):
+    name = "reg:squarederror"
+    default_metric = "rmse"
+    info = ObjInfo("regression", const_hess=True)
+
+    def gradient(self, preds, labels, iteration=0):
+        return _pack(preds - labels, jnp.ones_like(preds))
+
+
+@OBJECTIVES.register("reg:squaredlogerror")
+class SquaredLogError(Objective):
+    name = "reg:squaredlogerror"
+    default_metric = "rmsle"
+
+    def gradient(self, preds, labels, iteration=0):
+        p1 = preds + 1.0
+        r = jnp.log(p1) - jnp.log(labels + 1.0)
+        g = r / p1
+        h = jnp.maximum((1.0 - r) / jnp.square(p1), 1e-6)
+        return _pack(g, h)
+
+
+class _LogisticBase(Objective):
+    """Shared logistic math (reference ``LogisticRegression`` CRTP base)."""
+
+    def gradient(self, preds, labels, iteration=0):
+        p = _sigmoid(preds)
+        g = p - labels
+        h = jnp.maximum(p * (1.0 - p), 1e-16)
+        spw = float(self.params.get("scale_pos_weight", 1.0))
+        if spw != 1.0:
+            w = jnp.where(labels == 1.0, spw, 1.0)
+            g, h = g * w, h * w
+        return _pack(g, h)
+
+    def pred_transform(self, margin):
+        return _sigmoid(margin)
+
+    def prob_to_margin(self, prob):
+        prob = np.clip(prob, 1e-7, 1 - 1e-7)
+        return np.log(prob / (1.0 - prob))
+
+
+@OBJECTIVES.register("binary:logistic")
+class BinaryLogistic(_LogisticBase):
+    name = "binary:logistic"
+    default_metric = "logloss"
+    info = ObjInfo("binary")
+
+
+@OBJECTIVES.register("reg:logistic")
+class RegLogistic(_LogisticBase):
+    name = "reg:logistic"
+    default_metric = "rmse"
+    info = ObjInfo("regression")
+
+
+@OBJECTIVES.register("binary:logitraw")
+class LogitRaw(_LogisticBase):
+    name = "binary:logitraw"
+    default_metric = "logloss"
+    info = ObjInfo("binary")
+
+    def pred_transform(self, margin):
+        return margin  # raw margin output
+
+    def init_estimation(self, info):
+        return np.zeros(1, dtype=np.float32)
+
+
+@OBJECTIVES.register("reg:pseudohubererror")
+class PseudoHuber(Objective):
+    name = "reg:pseudohubererror"
+    default_metric = "mphe"
+
+    def gradient(self, preds, labels, iteration=0):
+        slope = float(self.params.get("huber_slope", 1.0))
+        r = preds - labels
+        scale = 1.0 + jnp.square(r / slope)
+        sqrt_s = jnp.sqrt(scale)
+        g = r / sqrt_s
+        h = 1.0 / (scale * sqrt_s)
+        return _pack(g, h)
+
+
+@OBJECTIVES.register("count:poisson")
+class Poisson(Objective):
+    name = "count:poisson"
+    default_metric = "poisson-nloglik"
+
+    def gradient(self, preds, labels, iteration=0):
+        max_delta = float(self.params.get("max_delta_step", 0.7))
+        e = jnp.exp(preds)
+        g = e - labels
+        h = jnp.exp(preds + max_delta)
+        return _pack(g, h)
+
+    def pred_transform(self, margin):
+        return jnp.exp(margin)
+
+    def prob_to_margin(self, prob):
+        return np.log(np.maximum(prob, 1e-16))
+
+
+@OBJECTIVES.register("reg:gamma")
+class GammaDeviance(Objective):
+    name = "reg:gamma"
+    default_metric = "gamma-nloglik"
+
+    def gradient(self, preds, labels, iteration=0):
+        e = jnp.exp(-preds)
+        g = 1.0 - labels * e
+        h = labels * e
+        return _pack(g, h)
+
+    def pred_transform(self, margin):
+        return jnp.exp(margin)
+
+    def prob_to_margin(self, prob):
+        return np.log(np.maximum(prob, 1e-16))
+
+
+@OBJECTIVES.register("reg:tweedie")
+class Tweedie(Objective):
+    name = "reg:tweedie"
+
+    @property
+    def default_metric(self):  # type: ignore[override]
+        rho = float(self.params.get("tweedie_variance_power", 1.5))
+        return f"tweedie-nloglik@{rho}"
+
+    def gradient(self, preds, labels, iteration=0):
+        rho = float(self.params.get("tweedie_variance_power", 1.5))
+        e1 = jnp.exp((1.0 - rho) * preds)
+        e2 = jnp.exp((2.0 - rho) * preds)
+        g = -labels * e1 + e2
+        h = -labels * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return _pack(g, h)
+
+    def pred_transform(self, margin):
+        return jnp.exp(margin)
+
+    def prob_to_margin(self, prob):
+        return np.log(np.maximum(prob, 1e-16))
+
+
+@OBJECTIVES.register("binary:hinge")
+class Hinge(Objective):
+    name = "binary:hinge"
+    default_metric = "error"
+    info = ObjInfo("binary")
+
+    def gradient(self, preds, labels, iteration=0):
+        y = labels * 2.0 - 1.0  # {0,1} -> {-1,+1}
+        active = preds * y < 1.0
+        g = jnp.where(active, -y, 0.0)
+        h = jnp.where(active, 1.0, 1e-16)
+        return _pack(g, h)
+
+    def pred_transform(self, margin):
+        return (margin > 0.0).astype(jnp.float32)
+
+    def init_estimation(self, info):
+        return np.zeros(1, dtype=np.float32)

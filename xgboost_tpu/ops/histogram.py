@@ -1,0 +1,116 @@
+"""Histogram building — the hottest op (reference ``common::BuildHist``,
+``src/common/hist_util.cc:110-370``; GPU ``SharedMemHistKernel``,
+``src/tree/gpu_hist/histogram.cu:129-311``).
+
+Output layout: dense ``[n_nodes, n_features, max_nbins, 2]`` (g, h) sums over the
+uniform padded bin layout of data/binned.py. Two XLA strategies:
+
+- ``segment``: one flattened ``segment_sum`` over (row, feature) pairs — the
+  scatter-add formulation; efficient on CPU, and what the GPU reference does with
+  atomics.
+- ``onehot``: histogram-as-matmul — rows are tiled into blocks; per block a
+  position/gradient matrix ``P [rows, 2*n_nodes]`` is contracted against
+  per-feature one-hot bin encodings on the MXU. No atomics, deterministic,
+  MXU-shaped: this is the TPU-native formulation (a Pallas-fused variant lives in
+  ops/pallas/).
+
+Unlike the GPU reference there is no ``GradientQuantiser`` fixed-point trick
+(``src/tree/gpu_hist/histogram.cu:55-100``): XLA reductions are deterministic, so
+f32 accumulation already gives run-to-run reproducible histograms.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def build_hist_segment(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
+                       n_nodes: int, max_nbins: int) -> jnp.ndarray:
+    """Scatter-add histogram.
+
+    bins: [n, F] local bin ids (any int dtype), missing at max_nbins-1
+    gpair: [n, 2] f32
+    rel_pos: [n] int32 in [0, n_nodes]; n_nodes means "inactive row" (dumped)
+    -> [n_nodes, F, max_nbins, 2] f32
+    """
+    n, F = bins.shape
+    stride = F * max_nbins
+    seg = (rel_pos.astype(jnp.int32)[:, None] * stride
+           + jnp.arange(F, dtype=jnp.int32)[None, :] * max_nbins
+           + bins.astype(jnp.int32))
+    data = jnp.broadcast_to(gpair[:, None, :], (n, F, 2)).reshape(-1, 2)
+    hist = jax.ops.segment_sum(data, seg.reshape(-1),
+                               num_segments=(n_nodes + 1) * stride)
+    return hist[: n_nodes * stride].reshape(n_nodes, F, max_nbins, 2)
+
+
+def build_hist_onehot(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
+                      n_nodes: int, max_nbins: int,
+                      block_rows: int = 1 << 16) -> jnp.ndarray:
+    """Matmul histogram: for each row block, P[r, node*2+k] = gpair[r, k] when
+    rel_pos[r] == node, then per feature hist_f += onehot(bins_f)^T @ P.
+
+    Rows with rel_pos == n_nodes one-hot to all-zeros and vanish for free.
+    -> [n_nodes, F, max_nbins, 2] f32
+    """
+    n, F = bins.shape
+    pad = (-n) % block_rows
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        gpair = jnp.pad(gpair, ((0, pad), (0, 0)))
+        rel_pos = jnp.pad(rel_pos, (0, pad), constant_values=n_nodes)
+    nb = (n + pad) // block_rows
+    bins_b = bins.reshape(nb, block_rows, F)
+    gpair_b = gpair.reshape(nb, block_rows, 2)
+    pos_b = rel_pos.reshape(nb, block_rows)
+
+    node_ids = jnp.arange(n_nodes, dtype=jnp.int32)
+    bin_ids = jnp.arange(max_nbins, dtype=jnp.int32)
+
+    def block_body(carry, xs):
+        bins_blk, gpair_blk, pos_blk = xs
+        # P: [rows, n_nodes*2]
+        pos_oh = (pos_blk[:, None] == node_ids[None, :]).astype(jnp.float32)
+        P = (pos_oh[:, :, None] * gpair_blk[:, None, :]).reshape(block_rows,
+                                                                 n_nodes * 2)
+
+        def feat_body(_, f):
+            oh = (bins_blk[:, f][:, None] == bin_ids[None, :]).astype(jnp.float32)
+            return None, jnp.dot(oh.T, P, precision=jax.lax.Precision.HIGHEST)
+
+        _, per_feat = jax.lax.scan(feat_body, None, jnp.arange(F))
+        # per_feat: [F, max_nbins, n_nodes*2]
+        return carry + per_feat, None
+
+    init = jnp.zeros((F, max_nbins, n_nodes * 2), dtype=jnp.float32)
+    acc, _ = jax.lax.scan(block_body, init, (bins_b, gpair_b, pos_b))
+    # [F, B, n_nodes, 2] -> [n_nodes, F, B, 2]
+    return acc.reshape(F, max_nbins, n_nodes, 2).transpose(2, 0, 1, 3)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "max_nbins", "method", "block_rows"))
+def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
+               n_nodes: int, max_nbins: int, method: str = "auto",
+               block_rows: int = 1 << 16) -> jnp.ndarray:
+    if method == "auto":
+        method = "segment" if jax.default_backend() == "cpu" else "onehot"
+    if method == "segment":
+        return build_hist_segment(bins, gpair, rel_pos, n_nodes, max_nbins)
+    if method == "onehot":
+        return build_hist_onehot(bins, gpair, rel_pos, n_nodes, max_nbins,
+                                 block_rows=min(block_rows, max(bins.shape[0], 8)))
+    raise ValueError(f"unknown hist method {method}")
+
+
+def subtract_siblings(parent_hist: jnp.ndarray, child_hist: jnp.ndarray,
+                      built_is_left: jnp.ndarray) -> jnp.ndarray:
+    """Sibling subtraction trick (reference ``src/tree/hist/histogram.h:192-207``):
+    given the parent's histogram and ONE built child, the sibling is the
+    difference. Returns [n, ...] histograms for (left, right) stacked."""
+    sibling = parent_hist - child_hist
+    left = jnp.where(built_is_left[:, None, None, None], child_hist, sibling)
+    right = jnp.where(built_is_left[:, None, None, None], sibling, child_hist)
+    return left, right

@@ -1,0 +1,37 @@
+"""Row partitioning — static-shape position updates under jit.
+
+The reference partitions row index ranges in place (CPU ``CommonRowPartitioner``,
+``src/tree/common_row_partitioner.h:86``; GPU ``RowPartitioner`` scatter,
+``src/tree/gpu_hist/row_partitioner.cuh:196``). Dynamic-size row sets don't exist
+under XLA, so the TPU design keeps a dense ``positions [n_rows]`` array of heap
+node ids (root = 0, children of i = 2i+1 / 2i+2) and rewrites it with gathers —
+O(n) per depth, embarrassingly parallel, no sorting needed for training.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def update_positions(bins: jnp.ndarray, positions: jnp.ndarray,
+                     split_feature: jnp.ndarray, split_bin: jnp.ndarray,
+                     default_left: jnp.ndarray, is_split: jnp.ndarray,
+                     missing_bin: int) -> jnp.ndarray:
+    """Advance rows one level down the tree.
+
+    bins: [n, F] local bin ids; positions: [n] current heap node id;
+    split_*: [max_nodes] per-node split info; is_split: [max_nodes] bool
+    (True where the node was just expanded). Rows at non-split nodes stay put.
+    """
+    feat = split_feature[positions]
+    thr = split_bin[positions]
+    dleft = default_left[positions]
+    splitting = is_split[positions]
+    safe_feat = jnp.maximum(feat, 0)
+    b = jnp.take_along_axis(bins, safe_feat[:, None].astype(jnp.int32),
+                            axis=1)[:, 0].astype(jnp.int32)
+    missing = b == missing_bin
+    go_right = jnp.where(missing, ~dleft, b > thr)
+    return jnp.where(splitting,
+                     2 * positions + 1 + go_right.astype(positions.dtype),
+                     positions)

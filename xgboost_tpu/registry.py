@@ -1,0 +1,64 @@
+"""String-keyed component registries.
+
+TPU-native analogue of the dmlc registry mechanism the reference uses for every
+extensible component (``XGBOOST_REGISTER_OBJECTIVE`` et al.; see reference
+``src/tree/updater_quantile_hist.cc:558``, ``src/objective/regression_obj.cu:184``).
+Here a registry is a plain dict from name -> factory, populated by decorators, so
+objectives / metrics / updaters / boosters / predictors stay pluggable by string
+name exactly like the reference's ``dmlc::Registry``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """A named registry mapping string keys to factories."""
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self._entries: Dict[str, Callable[..., T]] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, name: str, *aliases: str) -> Callable[[Callable[..., T]], Callable[..., T]]:
+        def deco(factory: Callable[..., T]) -> Callable[..., T]:
+            if name in self._entries:
+                raise ValueError(f"{self.kind} '{name}' already registered")
+            self._entries[name] = factory
+            for a in aliases:
+                self._aliases[a] = name
+            factory._registry_name = name  # type: ignore[attr-defined]
+            return factory
+
+        return deco
+
+    def resolve(self, name: str) -> str:
+        return self._aliases.get(name, name)
+
+    def __contains__(self, name: str) -> bool:
+        return self.resolve(name) in self._entries
+
+    def create(self, name: str, *args: Any, **kwargs: Any) -> T:
+        key = self.resolve(name)
+        if key not in self._entries:
+            known = ", ".join(sorted(self._entries))
+            raise ValueError(f"Unknown {self.kind}: '{name}'. Known: {known}")
+        return self._entries[key](*args, **kwargs)
+
+    def get(self, name: str) -> Optional[Callable[..., T]]:
+        return self._entries.get(self.resolve(name))
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+
+# Global registries, mirroring the reference's component axes (SURVEY.md §1 table).
+OBJECTIVES: Registry = Registry("objective")
+METRICS: Registry = Registry("metric")
+TREE_UPDATERS: Registry = Registry("tree updater")
+BOOSTERS: Registry = Registry("gradient booster")
+PREDICTORS: Registry = Registry("predictor")
+LINEAR_UPDATERS: Registry = Registry("linear updater")

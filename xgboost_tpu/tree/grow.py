@@ -1,0 +1,196 @@
+"""Depth-wise tree growing under ``jit`` — the TPU hot loop.
+
+Reference call stack being re-designed here: ``QuantileHistMaker::UpdateTree``
+(``src/tree/updater_quantile_hist.cc:54-111``) / GPU ``GPUHistMakerDevice``
+(``src/tree/updater_gpu_hist.cu:679-731``). TPU-native shape: the whole tree is a
+fixed-capacity heap (node i -> children 2i+1/2i+2), one Python loop over depths
+inside a single jitted function (each depth has static shapes: 2^d nodes), and
+per depth exactly four fused stages — build histogram, psum across the mesh's
+data axis, evaluate splits, advance row positions. The only cross-device
+communication is the one histogram psum + root-sum psum per level, matching the
+reference's "one allreduce per node batch" (``src/tree/hist/histogram.h:183-190``).
+
+Feature subsampling follows ``common::ColumnSampler`` nesting
+(bytree ⊃ bylevel ⊃ bynode, ``src/common/random.h:123``) with rank-based
+without-replacement draws from a shared key (all mesh ranks use the same key,
+like the broadcast seed at ``src/tree/updater_gpu_hist.cu:786-789``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import build_hist
+from ..ops.partition import update_positions
+from ..ops.split import evaluate_splits
+from .param import TrainParam, calc_weight
+from .tree import TreeModel
+
+_EPS = 1e-6
+
+
+class GrownTree(NamedTuple):
+    """Device-side tree arrays (heap layout) plus per-row results."""
+
+    split_feature: jnp.ndarray  # [max_nodes] int32
+    split_bin: jnp.ndarray      # [max_nodes] int32
+    default_left: jnp.ndarray   # [max_nodes] bool
+    is_leaf: jnp.ndarray        # [max_nodes] bool
+    active: jnp.ndarray         # [max_nodes] bool
+    leaf_value: jnp.ndarray     # [max_nodes] f32 (eta applied)
+    node_sum: jnp.ndarray       # [max_nodes, 2] f32
+    gain: jnp.ndarray           # [max_nodes] f32
+    positions: jnp.ndarray      # [n_rows] int32 final heap leaf per row
+    delta: jnp.ndarray          # [n_rows] f32 leaf value per row (margin update)
+
+
+def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
+                     frac: float) -> jnp.ndarray:
+    """Without-replacement draw of ceil(frac * |base|) features from base_mask."""
+    if frac >= 1.0:
+        return base_mask
+    F = base_mask.shape[0]
+    u = jax.random.uniform(key, (F,))
+    u = jnp.where(base_mask, u, jnp.inf)
+    count = jnp.sum(base_mask.astype(jnp.int32))
+    k = jnp.clip(jnp.ceil(frac * count).astype(jnp.int32), 1, F)
+    thr = jnp.sort(u)[k - 1]
+    return base_mask & (u <= thr)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("param", "max_nbins", "hist_method", "axis_name"))
+def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
+          tree_mask: jnp.ndarray, key: jax.Array, *, param: TrainParam,
+          max_nbins: int, hist_method: str = "auto",
+          axis_name: Optional[str] = None) -> GrownTree:
+    n, F = bins.shape
+    max_depth = param.max_depth
+    max_nodes = 2 ** (max_depth + 1) - 1
+    missing_bin = max_nbins - 1
+
+    def allreduce(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    split_feature = jnp.full((max_nodes,), -1, jnp.int32)
+    split_bin = jnp.zeros((max_nodes,), jnp.int32)
+    default_left = jnp.zeros((max_nodes,), bool)
+    is_leaf = jnp.ones((max_nodes,), bool)
+    active = jnp.zeros((max_nodes,), bool).at[0].set(True)
+    gain = jnp.zeros((max_nodes,), jnp.float32)
+    node_sum = jnp.zeros((max_nodes, 2), jnp.float32)
+    root_sum = allreduce(jnp.sum(gpair, axis=0))
+    node_sum = node_sum.at[0].set(root_sum)
+    positions = jnp.zeros((n,), jnp.int32)
+
+    for depth in range(max_depth):
+        lo = 2 ** depth - 1
+        n_level = 2 ** depth
+        idx = lo + jnp.arange(n_level)
+
+        in_level = (positions >= lo) & (positions < lo + n_level)
+        rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
+        hist = build_hist(bins, gpair, rel, n_level, max_nbins,
+                          method=hist_method)
+        hist = allreduce(hist)
+
+        level_key = jax.random.fold_in(key, depth)
+        level_mask = _sample_features(level_key, tree_mask,
+                                      param.colsample_bylevel)
+        if param.colsample_bynode < 1.0:
+            node_keys = jax.random.split(jax.random.fold_in(level_key, 1),
+                                         n_level)
+            fmask = jax.vmap(
+                lambda k: _sample_features(k, level_mask,
+                                           param.colsample_bynode))(node_keys)
+        else:
+            fmask = level_mask[None, :]
+
+        parent_sum = node_sum[lo:lo + n_level]
+        res = evaluate_splits(hist, parent_sum, n_real_bins, param,
+                              feature_mask=fmask)
+
+        # a node exists at this level iff its parent split; it expands unless
+        # the best gain fails the gamma / kRtEps test (reference prune rule).
+        can_split = (active[lo:lo + n_level]
+                     & (res.gain > max(param.gamma, _EPS))
+                     & jnp.isfinite(res.gain))
+
+        split_feature = split_feature.at[idx].set(
+            jnp.where(can_split, res.feature, -1))
+        split_bin = split_bin.at[idx].set(jnp.where(can_split, res.bin, 0))
+        default_left = default_left.at[idx].set(can_split & res.default_left)
+        is_leaf = is_leaf.at[idx].set(~can_split)
+        gain = gain.at[idx].set(jnp.where(can_split, res.gain, 0.0))
+
+        li, ri = 2 * idx + 1, 2 * idx + 2
+        active = active.at[li].set(can_split).at[ri].set(can_split)
+        zero2 = jnp.zeros_like(res.left_sum)
+        node_sum = node_sum.at[li].set(
+            jnp.where(can_split[:, None], res.left_sum, zero2))
+        node_sum = node_sum.at[ri].set(
+            jnp.where(can_split[:, None], res.right_sum, zero2))
+
+        is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(can_split)
+        positions = update_positions(bins, positions, split_feature, split_bin,
+                                     default_left, is_split_full, missing_bin)
+
+    w = calc_weight(node_sum[:, 0], node_sum[:, 1], param) * param.eta
+    leaf_value = jnp.where(active & is_leaf, w, 0.0).astype(jnp.float32)
+    delta = leaf_value[positions]
+    return GrownTree(split_feature=split_feature, split_bin=split_bin,
+                     default_left=default_left, is_leaf=is_leaf, active=active,
+                     leaf_value=leaf_value, node_sum=node_sum, gain=gain,
+                     positions=positions, delta=delta)
+
+
+class TreeGrower:
+    """Host-side wrapper: sampling keys, colsample_bytree, device->TreeModel."""
+
+    def __init__(self, param: TrainParam, max_nbins: int, cuts,
+                 hist_method: str = "auto",
+                 axis_name: Optional[str] = None) -> None:
+        self.param = param
+        self.max_nbins = max_nbins
+        self.cuts = cuts
+        self.hist_method = hist_method
+        self.axis_name = axis_name
+
+    def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
+             n_real_bins: jnp.ndarray, key: jax.Array) -> GrownTree:
+        F = bins.shape[1]
+        tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
+                                     jnp.ones((F,), bool),
+                                     self.param.colsample_bytree)
+        return _grow(bins, gpair, n_real_bins, tree_mask,
+                     jax.random.fold_in(key, 0x5EED), param=self.param,
+                     max_nbins=self.max_nbins, hist_method=self.hist_method,
+                     axis_name=self.axis_name)
+
+    def to_tree_model(self, g: GrownTree) -> TreeModel:
+        """Pull device arrays to host and attach raw split thresholds."""
+        sf = np.asarray(g.split_feature)
+        sb = np.asarray(g.split_bin)
+        ptrs = self.cuts.ptrs
+        vals = self.cuts.values
+        split_value = np.zeros(sf.shape, np.float32)
+        mask = sf >= 0
+        gb = ptrs[np.maximum(sf, 0)] + sb
+        split_value[mask] = vals[np.clip(gb[mask], 0, len(vals) - 1)]
+        return TreeModel(
+            split_feature=sf,
+            split_bin=sb,
+            split_value=split_value,
+            default_left=np.asarray(g.default_left),
+            is_leaf=np.asarray(g.is_leaf),
+            active=np.asarray(g.active),
+            leaf_value=np.asarray(g.leaf_value),
+            sum_hess=np.asarray(g.node_sum[:, 1]),
+            gain=np.asarray(g.gain),
+        )

@@ -1,0 +1,104 @@
+"""Tree training hyper-parameters + split-gain math.
+
+Mirrors the reference's ``TrainParam`` (``src/tree/param.h:28-594``) field set and
+its ``CalcGain`` / ``CalcWeight`` / ``ThresholdL1`` formulas, expressed as jnp ops
+so they fuse into the split-evaluation kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..params import Parameter, hashable, param_field
+
+
+@hashable
+@dataclass
+class TrainParam(Parameter):
+    # learning
+    eta: float = param_field(0.3, aliases=("learning_rate",), lower=0.0)
+    gamma: float = param_field(0.0, aliases=("min_split_loss",), lower=0.0)
+    max_depth: int = param_field(6, lower=0)
+    max_leaves: int = param_field(0, lower=0)
+    max_bin: int = param_field(256, lower=2)
+    grow_policy: str = param_field("depthwise")  # depthwise | lossguide
+    min_child_weight: float = param_field(1.0, lower=0.0)
+    reg_lambda: float = param_field(1.0, aliases=("lambda",), lower=0.0)
+    reg_alpha: float = param_field(0.0, aliases=("alpha",), lower=0.0)
+    max_delta_step: float = param_field(0.0, lower=0.0)
+    # sampling
+    subsample: float = param_field(1.0, lower=0.0, upper=1.0)
+    sampling_method: str = param_field("uniform")
+    colsample_bytree: float = param_field(1.0, lower=0.0, upper=1.0)
+    colsample_bylevel: float = param_field(1.0, lower=0.0, upper=1.0)
+    colsample_bynode: float = param_field(1.0, lower=0.0, upper=1.0)
+    # constraints
+    monotone_constraints: str = param_field("()")
+    interaction_constraints: str = param_field("")
+    # categorical
+    max_cat_to_onehot: int = param_field(4, lower=1)
+    max_cat_threshold: int = param_field(64, lower=1)
+    # misc
+    sparse_threshold: float = param_field(0.2)
+    refresh_leaf: bool = param_field(True)
+    process_type: str = param_field("default")
+
+    def max_nodes(self) -> int:
+        """Heap capacity for depth-wise growth."""
+        return 2 ** (self.max_depth + 1) - 1
+
+    def need_prune(self, loss_chg: float) -> bool:
+        return loss_chg < self.gamma
+
+
+# --- split-gain math (reference src/tree/param.h:243-330) --------------------
+
+def threshold_l1(g: jnp.ndarray, alpha: float) -> jnp.ndarray:
+    if alpha == 0.0:
+        return g
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+
+
+def calc_weight(g: jnp.ndarray, h: jnp.ndarray, p: TrainParam) -> jnp.ndarray:
+    """Optimal leaf weight -ThresholdL1(G)/(H+lambda), clipped by max_delta_step."""
+    w = -threshold_l1(g, p.reg_alpha) / (h + p.reg_lambda)
+    w = jnp.where(h <= 0.0, 0.0, w)
+    if p.max_delta_step != 0.0:
+        w = jnp.clip(w, -p.max_delta_step, p.max_delta_step)
+    return w
+
+
+def calc_gain_given_weight(g: jnp.ndarray, h: jnp.ndarray, w: jnp.ndarray,
+                           p: TrainParam) -> jnp.ndarray:
+    """-(2*G*w + (H+lambda)*w^2) — used when max_delta_step clips the weight."""
+    return -(2.0 * g * w + (h + p.reg_lambda) * jnp.square(w))
+
+
+def calc_gain(g: jnp.ndarray, h: jnp.ndarray, p: TrainParam) -> jnp.ndarray:
+    """Structure score Sqr(ThresholdL1(G))/(H+lambda); zero for empty nodes."""
+    if p.max_delta_step == 0.0:
+        gain = jnp.square(threshold_l1(g, p.reg_alpha)) / (h + p.reg_lambda)
+    else:
+        gain = calc_gain_given_weight(g, h, calc_weight(g, h, p), p)
+    return jnp.where(h <= 0.0, 0.0, gain)
+
+
+def parse_monotone_constraints(spec: Any, n_features: int) -> Optional[list]:
+    """'(1,-1,0,...)' or list -> per-feature ints; None when unconstrained."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip().strip("()")
+        if not s:
+            return None
+        vals = [int(x) for x in s.split(",") if x.strip()]
+    else:
+        vals = [int(x) for x in spec]
+    if not any(vals):
+        return None
+    if len(vals) < n_features:
+        vals = vals + [0] * (n_features - len(vals))
+    return vals[:n_features]

@@ -1,0 +1,189 @@
+"""Tree model container.
+
+The reference's ``RegTree`` (``include/xgboost/tree_model.h:158``) is a pointer-y
+node array; the TPU-native model is a struct-of-arrays in **heap layout** (node i
+has children 2i+1 / 2i+2, root 0) so a whole forest stacks into rectangular
+tensors for batched, gather-only inference. Conversion to the reference's
+compact node numbering happens only at serialization/dump time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class TreeModel:
+    """One regression tree in heap layout (host copy; numpy)."""
+
+    split_feature: np.ndarray   # [max_nodes] int32, -1 where leaf / absent
+    split_bin: np.ndarray       # [max_nodes] int32 local bin threshold
+    split_value: np.ndarray     # [max_nodes] f32 raw threshold (x <= v -> left)
+    default_left: np.ndarray    # [max_nodes] bool
+    is_leaf: np.ndarray         # [max_nodes] bool
+    active: np.ndarray          # [max_nodes] bool — node exists in the tree
+    leaf_value: np.ndarray      # [max_nodes] f32 (learning rate already applied)
+    sum_hess: np.ndarray        # [max_nodes] f32 cover
+    gain: np.ndarray            # [max_nodes] f32 split loss_chg (0 at leaves)
+
+    @property
+    def max_nodes(self) -> int:
+        return len(self.is_leaf)
+
+    @property
+    def max_depth(self) -> int:
+        return int(np.log2(self.max_nodes + 1)) - 1
+
+    def num_nodes(self) -> int:
+        return int(self.active.sum())
+
+    def num_leaves(self) -> int:
+        return int((self.active & self.is_leaf).sum())
+
+    # --- compact (reference RegTree-style) numbering -------------------------
+    def compact_ids(self) -> Dict[int, int]:
+        """heap id -> BFS compact id over active nodes (root=0), matching the
+        reference's node allocation order for depth-wise growth."""
+        ids: Dict[int, int] = {}
+        queue = [0]
+        while queue:
+            h = queue.pop(0)
+            if not self.active[h]:
+                continue
+            ids[h] = len(ids)
+            if not self.is_leaf[h]:
+                queue.extend((2 * h + 1, 2 * h + 2))
+        return ids
+
+    def to_json(self) -> dict:
+        ids = self.compact_ids()
+        inv = {c: h for h, c in ids.items()}
+        n = len(ids)
+        left = np.full(n, -1, np.int32)
+        right = np.full(n, -1, np.int32)
+        parent = np.full(n, -1, np.int32)
+        feat = np.zeros(n, np.int32)
+        cond = np.zeros(n, np.float64)
+        dleft = np.zeros(n, bool)
+        leaf = np.zeros(n, bool)
+        value = np.zeros(n, np.float64)
+        hess = np.zeros(n, np.float64)
+        gain = np.zeros(n, np.float64)
+        for c in range(n):
+            h = inv[c]
+            leaf[c] = self.is_leaf[h]
+            hess[c] = self.sum_hess[h]
+            if leaf[c]:
+                value[c] = self.leaf_value[h]
+            else:
+                feat[c] = self.split_feature[h]
+                cond[c] = self.split_value[h]
+                dleft[c] = self.default_left[h]
+                gain[c] = self.gain[h]
+                left[c] = ids[2 * h + 1]
+                right[c] = ids[2 * h + 2]
+                parent[ids[2 * h + 1]] = c
+                parent[ids[2 * h + 2]] = c
+        return {
+            "left_children": left.tolist(),
+            "right_children": right.tolist(),
+            "parents": parent.tolist(),
+            "split_indices": feat.tolist(),
+            "split_conditions": [float(v) if lf else float(s)
+                                 for v, s, lf in zip(value, cond, leaf)],
+            "default_left": [int(d) for d in dleft],
+            "loss_changes": gain.tolist(),
+            "sum_hessian": hess.tolist(),
+            "split_bins": [int(self.split_bin[inv[c]]) for c in range(n)],
+            "heap_depth": self.max_depth,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "TreeModel":
+        left = np.asarray(obj["left_children"], np.int32)
+        right = np.asarray(obj["right_children"], np.int32)
+        n = len(left)
+        depth = int(obj.get("heap_depth", _depth_of(left, right)))
+        max_nodes = 2 ** (depth + 1) - 1
+        t = TreeModel.empty(max_nodes)
+        conds = obj["split_conditions"]
+        feats = obj["split_indices"]
+        dlefts = obj["default_left"]
+        gains = obj.get("loss_changes", [0.0] * n)
+        hesses = obj.get("sum_hessian", [0.0] * n)
+        sbins = obj.get("split_bins", [0] * n)
+
+        def fill(c: int, h: int) -> None:
+            t.active[h] = True
+            t.sum_hess[h] = hesses[c]
+            if left[c] < 0:
+                t.is_leaf[h] = True
+                t.leaf_value[h] = conds[c]
+            else:
+                t.is_leaf[h] = False
+                t.split_feature[h] = feats[c]
+                t.split_value[h] = conds[c]
+                t.split_bin[h] = sbins[c]
+                t.default_left[h] = bool(dlefts[c])
+                t.gain[h] = gains[c]
+                fill(int(left[c]), 2 * h + 1)
+                fill(int(right[c]), 2 * h + 2)
+
+        if n:
+            fill(0, 0)
+        return t
+
+    @staticmethod
+    def empty(max_nodes: int) -> "TreeModel":
+        return TreeModel(
+            split_feature=np.full(max_nodes, -1, np.int32),
+            split_bin=np.zeros(max_nodes, np.int32),
+            split_value=np.zeros(max_nodes, np.float32),
+            default_left=np.zeros(max_nodes, bool),
+            is_leaf=np.ones(max_nodes, bool),
+            active=np.zeros(max_nodes, bool),
+            leaf_value=np.zeros(max_nodes, np.float32),
+            sum_hess=np.zeros(max_nodes, np.float32),
+            gain=np.zeros(max_nodes, np.float32),
+        )
+
+    def resize(self, max_nodes: int) -> "TreeModel":
+        """Pad heap arrays to a larger capacity (for stacking into a forest)."""
+        if max_nodes == self.max_nodes:
+            return self
+        out = TreeModel.empty(max_nodes)
+        k = min(max_nodes, self.max_nodes)
+        for name in ("split_feature", "split_bin", "split_value", "default_left",
+                     "is_leaf", "active", "leaf_value", "sum_hess", "gain"):
+            getattr(out, name)[:k] = getattr(self, name)[:k]
+        return out
+
+
+def _depth_of(left: np.ndarray, right: np.ndarray) -> int:
+    depth = [0] * len(left)
+    best = 0
+    for c in range(len(left)):
+        if left[c] >= 0:
+            depth[left[c]] = depth[right[c]] = depth[c] + 1
+            best = max(best, depth[c] + 1)
+    return best
+
+
+def stack_forest(trees: List[TreeModel]) -> Optional[Dict[str, np.ndarray]]:
+    """Stack per-tree heap arrays into [n_trees, max_nodes] tensors for the
+    batched predictor."""
+    if not trees:
+        return None
+    cap = max(t.max_nodes for t in trees)
+    trees = [t.resize(cap) for t in trees]
+    return {
+        "split_feature": np.stack([t.split_feature for t in trees]),
+        "split_value": np.stack([t.split_value for t in trees]),
+        "split_bin": np.stack([t.split_bin for t in trees]),
+        "default_left": np.stack([t.default_left for t in trees]),
+        "is_leaf": np.stack([t.is_leaf for t in trees]),
+        "leaf_value": np.stack([t.leaf_value for t in trees]),
+    }
