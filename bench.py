@@ -1,0 +1,97 @@
+"""Benchmark: boosting throughput on HIGGS-like synthetic data.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors BASELINE.md row 2 (binary:logistic, depth 6+, hist): synthetic
+HIGGS-shaped data (dense f32, 28 features). ``vs_baseline`` is measured on this
+machine against sklearn's HistGradientBoostingClassifier — the closest
+available stand-in for the reference CPU ``hist`` implementation (the reference
+publishes no numbers in-repo and its C++ build is not present here); >1.0 means
+we boost more rounds/second than the CPU hist baseline.
+
+Env knobs: BENCH_ROWS (default 1e6), BENCH_ROUNDS (default 20),
+BENCH_SKIP_BASELINE=1 to reuse the last stored baseline time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+COLS = 28
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", 20))
+DEPTH = 6
+BASELINE_CACHE = os.path.join(os.path.dirname(__file__),
+                              ".bench_baseline.json")
+
+
+def make_data(n, f, seed=42):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    y = (X @ w + rng.randn(n).astype(np.float32) > 0).astype(np.float32)
+    return X, y
+
+
+def bench_ours(X, y):
+    import xgboost_tpu as xgb
+
+    params = {"objective": "binary:logistic", "max_depth": DEPTH,
+              "eta": 0.1, "max_bin": 256}
+    dm = xgb.DMatrix(X, label=y)
+    # warm-up: binning + compile
+    xgb.train(params, dm, 2, verbose_eval=False)
+    t0 = time.perf_counter()
+    bst = xgb.train(params, dm, ROUNDS, verbose_eval=False)
+    elapsed = time.perf_counter() - t0
+    preds = bst.predict(dm)
+    from xgboost_tpu.metric.auc import binary_roc_auc
+    auc = binary_roc_auc(y.astype(np.float64), preds.astype(np.float64),
+                         np.ones(len(y)))
+    return ROUNDS / elapsed, auc
+
+
+def bench_sklearn(X, y):
+    if os.environ.get("BENCH_SKIP_BASELINE") == "1" and \
+            os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as fh:
+            return json.load(fh)["rounds_per_sec"]
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    clf = HistGradientBoostingClassifier(
+        max_iter=ROUNDS, max_depth=DEPTH, max_leaf_nodes=2 ** DEPTH,
+        learning_rate=0.1, max_bins=255, early_stopping=False,
+        validation_fraction=None)
+    t0 = time.perf_counter()
+    clf.fit(X, y)
+    elapsed = time.perf_counter() - t0
+    rps = ROUNDS / elapsed
+    try:
+        with open(BASELINE_CACHE, "w") as fh:
+            json.dump({"rounds_per_sec": rps, "rows": ROWS}, fh)
+    except OSError:
+        pass
+    return rps
+
+
+def main():
+    X, y = make_data(ROWS, COLS)
+    ours_rps, auc = bench_ours(X, y)
+    base_rps = bench_sklearn(X, y)
+    print(json.dumps({
+        "metric": f"boost_rounds_per_sec_{ROWS}x{COLS}_depth{DEPTH}",
+        "value": round(ours_rps, 4),
+        "unit": "rounds/s",
+        "vs_baseline": round(ours_rps / base_rps, 4),
+    }))
+    print(f"# auc={auc:.4f} baseline(sklearn-hist)={base_rps:.3f} rounds/s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,12 +27,12 @@ class GBTree:
 
     def __init__(self, tree_param: TrainParam, n_groups: int,
                  num_parallel_tree: int = 1, hist_method: str = "auto",
-                 axis_name: Optional[str] = None) -> None:
+                 mesh=None) -> None:
         self.tree_param = tree_param
         self.n_groups = n_groups
         self.num_parallel_tree = num_parallel_tree
         self.hist_method = hist_method
-        self.axis_name = axis_name
+        self.mesh = mesh
         self.trees: List[TreeModel] = []
         self.tree_info: List[int] = []
         self.iteration_indptr: List[int] = [0]
@@ -48,7 +48,7 @@ class GBTree:
                 param.eta = param.eta / self.num_parallel_tree
             self._grower = TreeGrower(param, binned.max_nbins, binned.cuts,
                                       hist_method=self.hist_method,
-                                      axis_name=self.axis_name)
+                                      mesh=self.mesh)
         return self._grower
 
     def do_boost(self, binned: BinnedMatrix, gpair: jnp.ndarray,

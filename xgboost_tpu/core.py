@@ -80,6 +80,10 @@ class Booster:
         elif isinstance(params, list):
             params = dict(params)
         params = dict(params)
+        if "mesh" in params:
+            mesh = params.pop("mesh")
+            if mesh is not None:
+                self.ctx = self.ctx.with_mesh(mesh)
         if "eval_metric" in params:
             em = params.pop("eval_metric")
             names = em if isinstance(em, (list, tuple)) else [em]
@@ -143,7 +147,8 @@ class Booster:
                 self.tree_param, n_groups,
                 num_parallel_tree=int(self.learner_params.get(
                     "num_parallel_tree", 1)),
-                hist_method=self.learner_params.get("hist_method", "auto"))
+                hist_method=self.learner_params.get("hist_method", "auto"),
+                mesh=self.ctx.mesh)
         if self.base_margin_ is None:
             if "base_score" in self.learner_params and \
                     self.learner_params["base_score"] is not None:
@@ -186,6 +191,8 @@ class Booster:
         if key not in self._caches:
             if is_train:
                 binned = dm.binned(self.tree_param.max_bin)
+                if self.ctx.mesh is not None:
+                    return self._make_sharded_train_state(key, dm, binned)
             else:
                 train_cuts = None
                 for st in self._caches.values():
@@ -208,7 +215,67 @@ class Booster:
                     jnp.asarray(self.base_margin_, dtype=jnp.float32)[None, :],
                     (n, self.n_groups))
             self._caches[key] = {"binned": binned, "margin": margin,
-                                 "n_trees": 0, "is_train": is_train, "dm": dm}
+                                 "n_trees": 0, "is_train": is_train, "dm": dm,
+                                 "info": dm.info, "n_valid": n}
+        return self._caches[key]
+
+    def _make_sharded_train_state(self, key: int, dm: DMatrix,
+                                  binned) -> Dict[str, Any]:
+        """Shard the quantized matrix / margin over the mesh ``data`` axis,
+        padding rows to a multiple of the axis size. Padded rows carry weight 0
+        so gradients vanish (the reference's row shards are simply unequal;
+        static XLA shapes want equal shards instead)."""
+        import jax.sharding as jsh
+
+        from .context import DATA_AXIS
+        from .data.binned import BinnedMatrix
+        from .data.dmatrix import MetaInfo
+
+        mesh = self.ctx.mesh
+        world = mesh.shape.get(DATA_AXIS, 1)
+        n = dm.num_row()
+        n_pad = ((n + world - 1) // world) * world
+        pad = n_pad - n
+        bins_np = np.asarray(binned.bins)
+        if pad:
+            fill = np.full((pad, bins_np.shape[1]), binned.missing_bin,
+                           dtype=bins_np.dtype)
+            bins_np = np.concatenate([bins_np, fill], axis=0)
+        sharding = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS, None))
+        bins_dev = jax.device_put(bins_np, sharding)
+        binned_p = BinnedMatrix(bins=bins_dev, cuts=binned.cuts,
+                                max_nbins=binned.max_nbins)
+
+        info = dm.info
+        labels = info.labels if info.labels is not None else np.zeros(n)
+        labels = np.asarray(labels, dtype=np.float32)
+        lab2 = labels.reshape(n, -1)
+        weights = (np.asarray(info.weights, np.float32)
+                   if info.weights is not None else np.ones(n, np.float32))
+        if pad:
+            lab2 = np.concatenate([lab2, np.zeros((pad, lab2.shape[1]),
+                                                  np.float32)])
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        info_p = MetaInfo(
+            labels=lab2 if labels.ndim == 2 else lab2[:, 0],
+            weights=weights, group_ptr=info.group_ptr,
+            label_lower_bound=info.label_lower_bound,
+            label_upper_bound=info.label_upper_bound,
+            feature_names=info.feature_names, feature_types=info.feature_types)
+
+        if info.base_margin is not None:
+            bm = np.asarray(info.base_margin, np.float32).reshape(n, -1)
+            bm = np.broadcast_to(bm, (n, self.n_groups)).copy()
+        else:
+            bm = np.broadcast_to(self.base_margin_[None, :],
+                                 (n, self.n_groups)).copy()
+        if pad:
+            bm = np.concatenate([bm, np.zeros((pad, self.n_groups),
+                                              np.float32)])
+        margin = jax.device_put(bm, sharding)
+        self._caches[key] = {"binned": binned_p, "margin": margin,
+                             "n_trees": 0, "is_train": True, "dm": dm,
+                             "info": info_p, "n_valid": n}
         return self._caches[key]
 
     def update(self, dtrain: DMatrix, iteration: int,
@@ -218,7 +285,7 @@ class Booster:
         state = self._state_of(dtrain, is_train=True)
         margin = state["margin"]
         if fobj is None:
-            gpair = self.obj.get_gradient(margin, dtrain.info, iteration)
+            gpair = self.obj.get_gradient(margin, state["info"], iteration)
         else:
             grad, hess = fobj(np.asarray(margin).squeeze(), dtrain)
             gpair = jnp.stack([jnp.asarray(grad, dtype=jnp.float32).reshape(
@@ -358,14 +425,14 @@ class Booster:
         for dm, name in evals:
             margin = self._cached_margin(dm)
             preds = self.obj.pred_transform(margin)
-            preds_np = np.asarray(preds)
+            preds_np = np.asarray(preds)[: dm.num_row()]
             if preds_np.ndim == 2 and preds_np.shape[1] == 1:
                 preds_np = preds_np[:, 0]
             for metric in self._eval_metrics:
                 score = metric(preds_np, dm.info)
                 msg += f"\t{name}-{metric.full_name}:{score:.6f}"
             if feval is not None:
-                margin_np = np.asarray(margin)
+                margin_np = np.asarray(margin)[: dm.num_row()]
                 if margin_np.ndim == 2 and margin_np.shape[1] == 1:
                     margin_np = margin_np[:, 0]
                 res = feval(margin_np if output_margin else preds_np, dm)

@@ -1,0 +1,249 @@
+"""Host-level communicator abstraction.
+
+The reference routes every cross-worker exchange through a pluggable
+``Communicator`` (rabit sockets / NCCL / gRPC-federated / in-memory;
+``src/collective/communicator.h:72``, ``communicator-inl.h``). On TPU the
+*device* collectives are ``jax.lax.psum``/``all_gather`` inside the jitted
+training step (see tree/grow.py) — this module covers the remaining HOST-side
+exchanges the reference does over rabit:
+
+- quantile-sketch merge across row shards (``src/common/quantile.cc:147-390``)
+- small-object broadcast (column-sample seed, serialized trees)
+- metric partial aggregation for data not on device
+
+Backends: ``NoOpCommunicator`` (single process, reference
+``noop_communicator.h``), ``InMemoryCommunicator`` (N threads in one process,
+reference ``in_memory_communicator.h`` — the unit-test workhorse), and
+``JaxProcessCommunicator`` (multi-host via ``jax.experimental.multihost_utils``,
+the analogue of rabit-over-tracker where ``jax.distributed.initialize`` plays
+the tracker role).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Communicator:
+    """Interface: world topology + host-level collectives."""
+
+    def get_rank(self) -> int:
+        raise NotImplementedError
+
+    def get_world_size(self) -> int:
+        raise NotImplementedError
+
+    def is_distributed(self) -> bool:
+        return self.get_world_size() > 1
+
+    def allreduce(self, values: np.ndarray, op: str = "sum") -> np.ndarray:
+        raise NotImplementedError
+
+    def allgather_objects(self, obj: Any) -> List[Any]:
+        raise NotImplementedError
+
+    def broadcast(self, obj: Any, root: int = 0) -> Any:
+        return self.allgather_objects(obj)[root]
+
+
+class NoOpCommunicator(Communicator):
+    def get_rank(self) -> int:
+        return 0
+
+    def get_world_size(self) -> int:
+        return 1
+
+    def allreduce(self, values: np.ndarray, op: str = "sum") -> np.ndarray:
+        return values
+
+    def allgather_objects(self, obj: Any) -> List[Any]:
+        return [obj]
+
+
+class _InMemoryGroup:
+    """Shared rendezvous state for one in-process world."""
+
+    def __init__(self, world_size: int) -> None:
+        self.world_size = world_size
+        self.barrier = threading.Barrier(world_size)
+        self.slots: List[Any] = [None] * world_size
+        self.lock = threading.Lock()
+
+    def exchange(self, rank: int, obj: Any) -> List[Any]:
+        self.slots[rank] = obj
+        self.barrier.wait()
+        out = list(self.slots)
+        self.barrier.wait()  # don't let a fast rank overwrite next round
+        return out
+
+
+class InMemoryCommunicator(Communicator):
+    """N in-process 'workers' on threads — drives the same code paths as a real
+    multi-host run without a cluster (SURVEY.md §4 multi-worker testing)."""
+
+    def __init__(self, group: _InMemoryGroup, rank: int) -> None:
+        self._group = group
+        self._rank = rank
+
+    @staticmethod
+    def make_world(world_size: int) -> List["InMemoryCommunicator"]:
+        group = _InMemoryGroup(world_size)
+        return [InMemoryCommunicator(group, r) for r in range(world_size)]
+
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._group.world_size
+
+    def allreduce(self, values: np.ndarray, op: str = "sum") -> np.ndarray:
+        parts = self._group.exchange(self._rank, np.asarray(values))
+        stacked = np.stack(parts)
+        if op == "sum":
+            return stacked.sum(axis=0)
+        if op == "max":
+            return stacked.max(axis=0)
+        if op == "min":
+            return stacked.min(axis=0)
+        if op == "bitwise_or":
+            out = parts[0].copy()
+            for p in parts[1:]:
+                out |= p
+            return out
+        raise ValueError(f"unknown op {op}")
+
+    def allgather_objects(self, obj: Any) -> List[Any]:
+        return self._group.exchange(self._rank, obj)
+
+
+class JaxProcessCommunicator(Communicator):
+    """Multi-controller JAX backend: one rank per host process
+    (``jax.distributed.initialize`` is the tracker analogue)."""
+
+    def __init__(self) -> None:
+        import jax
+
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world
+
+    def allreduce(self, values: np.ndarray, op: str = "sum") -> np.ndarray:
+        if self._world == 1:
+            return values
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(values))
+        if op == "sum":
+            return gathered.sum(axis=0)
+        if op == "max":
+            return gathered.max(axis=0)
+        if op == "min":
+            return gathered.min(axis=0)
+        raise ValueError(f"unknown op {op}")
+
+    def allgather_objects(self, obj: Any) -> List[Any]:
+        if self._world == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        return list(multihost_utils.process_allgather(obj, tiled=False))
+
+
+# --- global communicator (reference collective::Init / CommunicatorContext) --
+
+_comm: Communicator = NoOpCommunicator()
+_comm_tls = threading.local()
+
+
+def init(communicator: str = "noop", **kwargs: Any) -> None:
+    """Initialize the process-global communicator by name (reference
+    ``Communicator::Init``; names mirror CommunicatorType)."""
+    global _comm
+    if communicator in ("noop", "none"):
+        _comm = NoOpCommunicator()
+    elif communicator in ("jax", "rabit"):  # rabit name kept for API parity
+        _comm = JaxProcessCommunicator()
+    else:
+        raise ValueError(f"unknown communicator type: {communicator}")
+
+
+def finalize() -> None:
+    global _comm
+    _comm = NoOpCommunicator()
+
+
+def set_thread_local_communicator(comm: Optional[Communicator]) -> None:
+    _comm_tls.value = comm
+
+
+def get_communicator() -> Communicator:
+    tl = getattr(_comm_tls, "value", None)
+    return tl if tl is not None else _comm
+
+
+def get_rank() -> int:
+    return get_communicator().get_rank()
+
+
+def get_world_size() -> int:
+    return get_communicator().get_world_size()
+
+
+def is_distributed() -> bool:
+    return get_communicator().is_distributed()
+
+
+class CommunicatorContext:
+    """``with CommunicatorContext(...)`` — reference
+    ``python-package/xgboost/collective.py`` context manager."""
+
+    def __init__(self, communicator: Optional[Communicator] = None,
+                 **init_kwargs: Any) -> None:
+        self._explicit = communicator
+        self._init_kwargs = init_kwargs
+
+    def __enter__(self) -> Communicator:
+        if self._explicit is not None:
+            set_thread_local_communicator(self._explicit)
+            return self._explicit
+        init(**(self._init_kwargs or {"communicator": "jax"}))
+        return get_communicator()
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._explicit is not None:
+            set_thread_local_communicator(None)
+        else:
+            finalize()
+
+
+def distributed_sketch(X_local: np.ndarray, max_bin: int,
+                       weights: Optional[np.ndarray] = None,
+                       comm: Optional[Communicator] = None):
+    """Build global quantile cuts from row shards: local summaries ->
+    allgather -> merge -> prune (reference ``GatherSketchInfo`` + ``AllReduce``
+    in ``src/common/quantile.cc:147-276``)."""
+    from ..data.quantile import FeatureSummary, cuts_from_summaries
+
+    comm = comm or get_communicator()
+    local = [FeatureSummary.from_data(X_local[:, f], weights)
+             for f in range(X_local.shape[1])]
+    if not comm.is_distributed():
+        return cuts_from_summaries(local, max_bin)
+    payload = [(s.values, s.weights) for s in local]
+    gathered = comm.allgather_objects(payload)
+    merged = local
+    for rank, remote in enumerate(gathered):
+        if rank == comm.get_rank():
+            continue
+        merged = [a.merge(FeatureSummary(np.asarray(v), np.asarray(w)))
+                  for a, (v, w) in zip(merged, remote)]
+    merged = [s.prune(max_bin * 8) for s in merged]
+    return cuts_from_summaries(merged, max_bin)

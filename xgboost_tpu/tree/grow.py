@@ -151,16 +151,21 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
 
 
 class TreeGrower:
-    """Host-side wrapper: sampling keys, colsample_bytree, device->TreeModel."""
+    """Host-side wrapper: sampling keys, colsample_bytree, device->TreeModel.
+
+    With ``mesh`` set, the whole grow step runs under ``shard_map`` over the
+    mesh's ``data`` axis: rows are sharded, tree arrays replicate, and the
+    in-step ``psum`` is the reference's histogram allreduce."""
 
     def __init__(self, param: TrainParam, max_nbins: int, cuts,
                  hist_method: str = "auto",
-                 axis_name: Optional[str] = None) -> None:
+                 mesh: Optional[jax.sharding.Mesh] = None) -> None:
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
         self.hist_method = hist_method
-        self.axis_name = axis_name
+        self.mesh = mesh
+        self._sharded_fn = None
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
              n_real_bins: jnp.ndarray, key: jax.Array) -> GrownTree:
@@ -168,10 +173,35 @@ class TreeGrower:
         tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
                                      jnp.ones((F,), bool),
                                      self.param.colsample_bytree)
-        return _grow(bins, gpair, n_real_bins, tree_mask,
-                     jax.random.fold_in(key, 0x5EED), param=self.param,
-                     max_nbins=self.max_nbins, hist_method=self.hist_method,
-                     axis_name=self.axis_name)
+        key = jax.random.fold_in(key, 0x5EED)
+        if self.mesh is None:
+            return _grow(bins, gpair, n_real_bins, tree_mask, key,
+                         param=self.param, max_nbins=self.max_nbins,
+                         hist_method=self.hist_method, axis_name=None)
+        return self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+
+    def _sharded(self, bins, gpair, n_real_bins, tree_mask, key) -> GrownTree:
+        from ..context import DATA_AXIS
+
+        if self._sharded_fn is None:
+            P = jax.sharding.PartitionSpec
+
+            def inner(b, g, nr, tm, k):
+                return _grow(b, g, nr, tm, k, param=self.param,
+                             max_nbins=self.max_nbins,
+                             hist_method=self.hist_method,
+                             axis_name=DATA_AXIS)
+
+            out_specs = GrownTree(
+                split_feature=P(), split_bin=P(), default_left=P(),
+                is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
+                gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS))
+            self._sharded_fn = jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P(),
+                          P()),
+                out_specs=out_specs))
+        return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
 
     def to_tree_model(self, g: GrownTree) -> TreeModel:
         """Pull device arrays to host and attach raw split thresholds."""
