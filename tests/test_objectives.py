@@ -1,0 +1,214 @@
+"""Objective family tests: gradients sanity + end-to-end training quality.
+
+Modeled on the reference's CheckObjFunction-style tests (tests/cpp/objective/*)
+plus training-convergence checks per family.
+"""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.objective import get_objective
+
+from conftest import make_regression
+
+
+class _Info:
+    def __init__(self, labels, weights=None, **kw):
+        self.labels = np.asarray(labels, dtype=np.float32)
+        self.weights = weights
+        self.group_ptr = kw.get("group_ptr")
+        self.label_lower_bound = kw.get("label_lower_bound")
+        self.label_upper_bound = kw.get("label_upper_bound")
+
+
+def _grad(name, preds, labels, params=None, **kw):
+    obj = get_objective(name, params or {})
+    info = _Info(labels, **kw)
+    preds = np.asarray(preds, dtype=np.float32).reshape(len(labels), -1)
+    out = np.asarray(obj.get_gradient(preds, info))
+    return out[..., 0], out[..., 1]
+
+
+def test_squarederror_gradients():
+    g, h = _grad("reg:squarederror", [0.5, 1.0], [1.0, 1.0])
+    np.testing.assert_allclose(g.ravel(), [-0.5, 0.0])
+    np.testing.assert_allclose(h.ravel(), [1.0, 1.0])
+
+
+def test_logistic_gradients():
+    # at margin 0: p=0.5 -> g = 0.5 - y, h = 0.25
+    g, h = _grad("binary:logistic", [0.0, 0.0], [0.0, 1.0])
+    np.testing.assert_allclose(g.ravel(), [0.5, -0.5])
+    np.testing.assert_allclose(h.ravel(), [0.25, 0.25], rtol=1e-5)
+
+
+def test_poisson_gradients():
+    g, h = _grad("count:poisson", [0.0], [2.0])
+    np.testing.assert_allclose(g.ravel(), [-1.0])  # exp(0) - 2
+    assert h.ravel()[0] > 1.0  # exp(0 + max_delta_step)
+
+
+def test_softprob_gradients_sum_zero():
+    g, h = _grad("multi:softprob", np.zeros((4, 3)), [0, 1, 2, 0],
+                 params={"num_class": 3})
+    np.testing.assert_allclose(g.sum(axis=1), 0.0, atol=1e-6)
+    assert (h > 0).all()
+
+
+def test_absoluteerror_training_median():
+    # asymmetric noise: MAE fit should track the median, not the mean
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.randn(n, 4).astype(np.float32)
+    base = X[:, 0] * 2.0
+    noise = np.where(rng.rand(n) < 0.9, 0.0, 50.0)  # big one-sided outliers
+    y = base + noise
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train({"objective": "reg:absoluteerror", "max_depth": 4,
+                     "eta": 0.3}, dm, 30, evals=[(dm, "train")],
+                    evals_result=res, verbose_eval=False)
+    assert res["train"]["mae"][-1] < res["train"]["mae"][0]
+    preds = bst.predict(dm)
+    # median regression ignores the outliers: predictions near base signal
+    assert np.median(np.abs(preds - base)) < 2.0
+
+
+def test_quantile_training_coverage():
+    rng = np.random.RandomState(1)
+    n = 3000
+    X = rng.randn(n, 3).astype(np.float32)
+    y = X[:, 0] + rng.randn(n)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:quantileerror", "quantile_alpha": 0.9,
+                     "max_depth": 4, "eta": 0.3}, dm, 30, verbose_eval=False)
+    preds = bst.predict(dm)
+    coverage = float((y <= preds).mean())
+    assert 0.82 < coverage < 0.97, coverage
+
+
+def test_multi_quantile_targets():
+    rng = np.random.RandomState(2)
+    X = rng.randn(1000, 3).astype(np.float32)
+    y = X[:, 0] + rng.randn(1000)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:quantileerror",
+                     "quantile_alpha": [0.1, 0.5, 0.9], "max_depth": 3},
+                    dm, 20, verbose_eval=False)
+    preds = bst.predict(dm)
+    assert preds.shape == (1000, 3)
+    # quantile ordering should mostly hold
+    frac_ordered = float(((preds[:, 0] <= preds[:, 1])
+                          & (preds[:, 1] <= preds[:, 2])).mean())
+    assert frac_ordered > 0.7
+
+
+def test_aft_training():
+    rng = np.random.RandomState(3)
+    n = 1500
+    X = rng.randn(n, 4).astype(np.float32)
+    t = np.exp(0.5 * X[:, 0] + 0.1 * rng.randn(n))
+    censored = rng.rand(n) < 0.3
+    lower = t.copy()
+    upper = np.where(censored, np.inf, t)
+    dm = xgb.DMatrix(X, label=lower, label_lower_bound=lower,
+                     label_upper_bound=upper)
+    res = {}
+    bst = xgb.train({"objective": "survival:aft",
+                     "aft_loss_distribution": "normal",
+                     "aft_loss_distribution_scale": 1.0,
+                     "max_depth": 3, "eta": 0.2}, dm, 25,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    nll = res["train"]["aft-nloglik"]
+    assert nll[-1] < nll[0]
+    preds = bst.predict(dm)  # predicted survival time
+    corr = np.corrcoef(np.log(preds), np.log(t))[0, 1]
+    assert corr > 0.5, corr
+
+
+@pytest.mark.parametrize("dist", ["logistic", "extreme"])
+def test_aft_distributions_finite(dist):
+    rng = np.random.RandomState(4)
+    X = rng.randn(300, 3).astype(np.float32)
+    t = np.exp(X[:, 0])
+    dm = xgb.DMatrix(X, label=t, label_lower_bound=t, label_upper_bound=t)
+    bst = xgb.train({"objective": "survival:aft",
+                     "aft_loss_distribution": dist, "max_depth": 3},
+                    dm, 5, verbose_eval=False)
+    assert np.isfinite(bst.predict(dm)).all()
+
+
+def test_cox_training():
+    rng = np.random.RandomState(5)
+    n = 1200
+    X = rng.randn(n, 4).astype(np.float32)
+    hazard = np.exp(X[:, 0])
+    t = rng.exponential(1.0 / hazard)
+    censored = rng.rand(n) < 0.2
+    y = np.where(censored, -t, t).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train({"objective": "survival:cox", "max_depth": 3,
+                     "eta": 0.2}, dm, 20, evals=[(dm, "train")],
+                    evals_result=res, verbose_eval=False)
+    assert res["train"]["cox-nloglik"][-1] < res["train"]["cox-nloglik"][0]
+    # higher predicted hazard should correlate with shorter survival
+    hr = bst.predict(dm)
+    corr = np.corrcoef(np.log(hr), X[:, 0])[0, 1]
+    assert corr > 0.6, corr
+
+
+def _make_ltr(n_query=30, docs=20, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n_query * docs, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    score = X @ w + 0.5 * rng.randn(n_query * docs)
+    # graded relevance 0-3 by within-query quartile
+    y = np.zeros(n_query * docs, dtype=np.float32)
+    for q in range(n_query):
+        s = score[q * docs:(q + 1) * docs]
+        y[q * docs:(q + 1) * docs] = np.digitize(
+            s, np.quantile(s, [0.5, 0.75, 0.9]))
+    qid = np.repeat(np.arange(n_query), docs)
+    return X, y, qid
+
+
+@pytest.mark.parametrize("obj", ["rank:ndcg", "rank:pairwise", "rank:map"])
+def test_lambdarank_training(obj):
+    X, y, qid = _make_ltr(seed=6)
+    ylab = (y > 0).astype(np.float32) if obj == "rank:map" else y
+    dm = xgb.DMatrix(X, label=ylab, qid=qid)
+    res = {}
+    xgb.train({"objective": obj, "max_depth": 3, "eta": 0.3,
+               "eval_metric": ["ndcg@5"]},
+              dm, 20, evals=[(dm, "train")], evals_result=res,
+              verbose_eval=False)
+    hist = res["train"]["ndcg@5"]
+    assert hist[-1] > hist[0], hist
+    assert hist[-1] > 0.8
+
+
+def test_ndcg_metric_perfect_ranking():
+    from xgboost_tpu.metric import get_metric
+
+    info = _Info([3.0, 2.0, 1.0, 0.0],
+                 group_ptr=np.asarray([0, 4], dtype=np.int64))
+    m = get_metric("ndcg")
+    assert m(np.asarray([4.0, 3.0, 2.0, 1.0]), info) == pytest.approx(1.0)
+    worst = m(np.asarray([1.0, 2.0, 3.0, 4.0]), info)
+    assert worst < 1.0
+
+
+def test_weighted_training():
+    X, y = make_regression(600, 5)
+    w = np.ones(600, dtype=np.float32)
+    w[:300] = 10.0
+    dm = xgb.DMatrix(X, label=y, weight=w)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 3}, dm, 10,
+                    verbose_eval=False)
+    p = bst.predict(dm)
+    hi = np.mean((p[:300] - y[:300]) ** 2)
+    lo = np.mean((p[300:] - y[300:]) ** 2)
+    assert hi < lo  # heavily weighted rows fit better

@@ -52,11 +52,18 @@ class GBTree:
         return self._grower
 
     def do_boost(self, binned: BinnedMatrix, gpair: jnp.ndarray,
-                 iteration: int, key: jax.Array) -> jnp.ndarray:
-        """gpair: [n, K, 2] -> margin delta [n, K] for the training data."""
+                 iteration: int, key: jax.Array, obj=None, margin=None,
+                 info=None) -> jnp.ndarray:
+        """gpair: [n, K, 2] -> margin delta [n, K] for the training data.
+
+        ``obj``/``margin``/``info`` enable the adaptive-leaf hook
+        (``GBTree::UpdateTreeLeaf``, reference ``src/gbm/gbtree.cc:201``):
+        leaf values are replaced by per-leaf residual quantiles using the
+        grower's row positions."""
         grower = self._grower_for(binned)
         n, K = gpair.shape[0], gpair.shape[1]
         n_real = binned.n_real_bins()
+        adaptive = obj is not None and hasattr(obj, "update_tree_leaf")
         deltas = []
         for k in range(K):
             delta_k = jnp.zeros((n,), jnp.float32)
@@ -69,9 +76,20 @@ class GBTree:
                         self.tree_param.subsample, (n,))
                     gp = gp * mask[:, None].astype(gp.dtype)
                 grown = grower.grow(binned.bins, gp, n_real, tkey)
-                self.trees.append(grower.to_tree_model(grown))
+                tree = grower.to_tree_model(grown)
+                if adaptive:
+                    pos = np.asarray(grown.positions)
+                    alphas = obj.alphas() if hasattr(obj, "alphas") else [0.5]
+                    obj.update_tree_leaf(
+                        tree, pos, np.asarray(margin[:, k]), info,
+                        grower.param.eta, alpha=alphas[min(k,
+                                                           len(alphas) - 1)])
+                    delta_k = delta_k + jnp.asarray(
+                        tree.leaf_value[pos], dtype=jnp.float32)
+                else:
+                    delta_k = delta_k + grown.delta
+                self.trees.append(tree)
                 self.tree_info.append(k)
-                delta_k = delta_k + grown.delta
             deltas.append(delta_k)
         self.iteration_indptr.append(len(self.trees))
         return jnp.stack(deltas, axis=1)

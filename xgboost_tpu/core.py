@@ -252,15 +252,19 @@ class Booster:
         lab2 = labels.reshape(n, -1)
         weights = (np.asarray(info.weights, np.float32)
                    if info.weights is not None else np.ones(n, np.float32))
+        lb, ub = info.label_lower_bound, info.label_upper_bound
         if pad:
             lab2 = np.concatenate([lab2, np.zeros((pad, lab2.shape[1]),
                                                   np.float32)])
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+            if lb is not None:
+                lb = np.concatenate([lb, np.ones(pad, np.float32)])
+            if ub is not None:
+                ub = np.concatenate([ub, np.ones(pad, np.float32)])
         info_p = MetaInfo(
             labels=lab2 if labels.ndim == 2 else lab2[:, 0],
             weights=weights, group_ptr=info.group_ptr,
-            label_lower_bound=info.label_lower_bound,
-            label_upper_bound=info.label_upper_bound,
+            label_lower_bound=lb, label_upper_bound=ub,
             feature_names=info.feature_names, feature_types=info.feature_types)
 
         if info.base_margin is not None:
@@ -293,7 +297,9 @@ class Booster:
                     margin.shape)], axis=-1)
         key = self.ctx.make_key(iteration)
         delta = self.gbm.do_boost(state["binned"], gpair, iteration,
-                                  jax.random.fold_in(key, iteration))
+                                  jax.random.fold_in(key, iteration),
+                                  obj=self.obj, margin=margin,
+                                  info=state["info"])
         state["margin"] = margin + delta
         state["n_trees"] = len(self.gbm.trees)
 

@@ -13,5 +13,7 @@ from .base import Metric, get_metric
 from . import elementwise  # noqa: F401  (registers)
 from . import multiclass  # noqa: F401
 from . import auc  # noqa: F401
+from . import rank_metric  # noqa: F401
+from . import survival_metric  # noqa: F401
 
 __all__ = ["Metric", "get_metric"]

@@ -1,0 +1,114 @@
+"""Ranking metrics: ndcg@k, map@k, pre@k, ams@k.
+
+Reference ``src/metric/rank_metric.cc:224-486``. All are per-query means
+(weighted by per-query weight when provided).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+def _per_query(info, preds):
+    y = np.asarray(info.labels, dtype=np.float64).reshape(-1)
+    s = np.asarray(preds, dtype=np.float64).reshape(-1)
+    if info.group_ptr is None:
+        ptr = np.asarray([0, len(y)], dtype=np.int64)
+    else:
+        ptr = np.asarray(info.group_ptr, dtype=np.int64)
+    w = info.weights
+    if w is not None and len(w) != len(ptr) - 1:
+        w = None  # per-row weights not meaningful for query means
+    for q in range(len(ptr) - 1):
+        a, b = int(ptr[q]), int(ptr[q + 1])
+        if b - a == 0:
+            continue
+        yield y[a:b], s[a:b], (1.0 if w is None else float(w[q]))
+
+
+class _TopKMetric(Metric):
+    maximize = True
+    default_k = 0  # 0 = all
+
+    @property
+    def k(self) -> int:
+        if self.param is None or self.param in ("", "-"):
+            return self.default_k
+        return int(str(self.param).rstrip("-"))
+
+    def query_score(self, y: np.ndarray, order: np.ndarray, k: int) -> float:
+        raise NotImplementedError
+
+    def __call__(self, preds, info) -> float:
+        total, wsum = 0.0, 0.0
+        for y, s, w in _per_query(info, preds):
+            k = self.k if self.k > 0 else len(y)
+            order = np.argsort(-s, kind="stable")
+            total += self.query_score(y, order, min(k, len(y))) * w
+            wsum += w
+        return float(total / wsum) if wsum else float("nan")
+
+
+def dcg_at(y_sorted: np.ndarray, k: int, exp_gain: bool = True) -> float:
+    g = (np.power(2.0, y_sorted[:k]) - 1.0) if exp_gain else y_sorted[:k]
+    return float(np.sum(g / np.log2(np.arange(2, k + 2))))
+
+
+@METRICS.register("ndcg")
+class NDCG(_TopKMetric):
+    name = "ndcg"
+
+    def query_score(self, y, order, k):
+        dcg = dcg_at(y[order], k)
+        ideal = dcg_at(np.sort(y)[::-1], k)
+        if ideal <= 0.0:
+            return 1.0  # reference scores all-irrelevant queries as 1
+        return dcg / ideal
+
+
+@METRICS.register("map")
+class MAP(_TopKMetric):
+    name = "map"
+
+    def query_score(self, y, order, k):
+        rel = (y[order] > 0).astype(np.float64)
+        hits = np.cumsum(rel)
+        prec = np.where(rel[:k] > 0, hits[:k] / (np.arange(k) + 1.0), 0.0)
+        n_rel = rel.sum()
+        if n_rel == 0:
+            return 1.0
+        return float(prec.sum() / min(n_rel, k))
+
+
+@METRICS.register("pre")
+class PrecisionAt(_TopKMetric):
+    name = "pre"
+
+    def query_score(self, y, order, k):
+        return float((y[order][:k] > 0).mean()) if k else 0.0
+
+
+@METRICS.register("ams")
+class AMS(Metric):
+    """Approximate median significance at threshold fraction k%
+    (reference ``EvalAMS``)."""
+
+    name = "ams"
+    maximize = True
+
+    def __call__(self, preds, info) -> float:
+        ratio = float(self.param) if self.param is not None else 0.15
+        y = np.asarray(info.labels, dtype=np.float64).reshape(-1)
+        p = np.asarray(preds, dtype=np.float64).reshape(-1)
+        w = self.weights_of(info, len(y))
+        order = np.argsort(-p, kind="stable")
+        ntop = max(1, int(ratio * len(y)))
+        sel = order[:ntop]
+        s = float(np.sum(w[sel] * (y[sel] > 0.5)))
+        b = float(np.sum(w[sel] * (y[sel] <= 0.5)))
+        br = 10.0
+        return float(np.sqrt(2.0 * ((s + b + br)
+                                    * np.log(1.0 + s / (b + br)) - s)))

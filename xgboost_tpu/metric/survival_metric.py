@@ -1,0 +1,103 @@
+"""Survival metrics: aft-nloglik, cox-nloglik, interval-regression-accuracy,
+plus the quantile (pinball) metric for reg:quantileerror.
+
+Reference ``src/metric/survival_metric.cu:275-279``, ``elementwise_metric.cu``
+(quantile at :501) and Cox nloglik in ``rank_metric``-adjacent code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+_EPS = 1e-12
+
+
+@METRICS.register("aft-nloglik")
+class AFTNegLogLik(Metric):
+    name = "aft-nloglik"
+
+    def __call__(self, preds, info) -> float:
+        from scipy.stats import logistic, norm
+
+        # preds arrive as exp(margin) (pred_transform); recover margin
+        mu = np.log(np.maximum(np.asarray(preds, np.float64).reshape(-1),
+                               _EPS))
+        lo = np.asarray(info.label_lower_bound, np.float64)
+        hi = np.asarray(info.label_upper_bound, np.float64)
+        sigma = 1.0
+        dist = norm
+
+        def cdf(z):
+            return dist.cdf(z)
+
+        def pdf(z):
+            return dist.pdf(z)
+
+        z_lo = (np.log(np.maximum(lo, _EPS)) - mu) / sigma
+        z_hi = np.where(np.isfinite(hi),
+                        (np.log(np.maximum(hi, _EPS)) - mu) / sigma, np.inf)
+        uncensored = np.isfinite(hi) & (np.abs(hi - lo) < 1e-30)
+        L = np.where(
+            uncensored,
+            pdf(z_lo) / (sigma * np.maximum(lo, _EPS)),
+            np.where(np.isfinite(hi), cdf(z_hi), 1.0)
+            - np.where(lo > 0, cdf(z_lo), 0.0))
+        w = self.weights_of(info, len(mu))
+        nll = -np.log(np.maximum(L, _EPS))
+        return float(np.sum(nll * w) / np.sum(w))
+
+
+@METRICS.register("cox-nloglik")
+class CoxNegLogLik(Metric):
+    name = "cox-nloglik"
+
+    def __call__(self, preds, info) -> float:
+        y = np.asarray(info.labels, np.float64).reshape(-1)
+        # preds arrive as exp(margin)
+        m = np.log(np.maximum(np.asarray(preds, np.float64).reshape(-1),
+                              _EPS))
+        order = np.argsort(np.abs(y), kind="stable")
+        ys, ms = y[order], m[order]
+        exp_m = np.exp(ms - ms.max())
+        S = np.cumsum(exp_m[::-1])[::-1]
+        event = ys > 0
+        ll = np.sum(np.where(event,
+                             (ms - ms.max()) - np.log(np.maximum(S, _EPS)),
+                             0.0))
+        n_event = max(int(event.sum()), 1)
+        return float(-ll / n_event)
+
+
+@METRICS.register("interval-regression-accuracy")
+class IntervalRegressionAccuracy(Metric):
+    name = "interval-regression-accuracy"
+    maximize = True
+
+    def __call__(self, preds, info) -> float:
+        t = np.asarray(preds, np.float64).reshape(-1)  # exp(margin) = time
+        lo = np.asarray(info.label_lower_bound, np.float64)
+        hi = np.asarray(info.label_upper_bound, np.float64)
+        ok = (t >= lo) & ((~np.isfinite(hi)) | (t <= hi))
+        w = self.weights_of(info, len(t))
+        return float(np.sum(ok * w) / np.sum(w))
+
+
+@METRICS.register("quantile")
+class QuantileLoss(Metric):
+    """Mean pinball loss; alpha from @param or 0.5."""
+
+    name = "quantile"
+
+    def __call__(self, preds, info) -> float:
+        alpha = float(self.param) if self.param is not None else 0.5
+        y = np.asarray(info.labels, np.float64).reshape(-1)
+        p = np.asarray(preds, np.float64)
+        if p.ndim == 2:
+            p = p.mean(axis=1) if p.shape[1] > 1 else p[:, 0]
+        err = y - p
+        loss = np.where(err >= 0, alpha * err, (alpha - 1.0) * err)
+        w = self.weights_of(info, len(y))
+        return float(np.sum(loss * w) / np.sum(w))

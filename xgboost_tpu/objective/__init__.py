@@ -12,5 +12,8 @@ from __future__ import annotations
 from .base import Objective, get_objective
 from . import regression  # noqa: F401  (registers)
 from . import multiclass  # noqa: F401
+from . import adaptive  # noqa: F401
+from . import survival  # noqa: F401
+from . import ranking  # noqa: F401
 
 __all__ = ["Objective", "get_objective"]

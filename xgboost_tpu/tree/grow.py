@@ -214,13 +214,13 @@ class TreeGrower:
         gb = ptrs[np.maximum(sf, 0)] + sb
         split_value[mask] = vals[np.clip(gb[mask], 0, len(vals) - 1)]
         return TreeModel(
-            split_feature=sf,
-            split_bin=sb,
+            split_feature=np.array(sf),
+            split_bin=np.array(sb),
             split_value=split_value,
-            default_left=np.asarray(g.default_left),
-            is_leaf=np.asarray(g.is_leaf),
-            active=np.asarray(g.active),
-            leaf_value=np.asarray(g.leaf_value),
-            sum_hess=np.asarray(g.node_sum[:, 1]),
-            gain=np.asarray(g.gain),
+            default_left=np.array(g.default_left),
+            is_leaf=np.array(g.is_leaf),
+            active=np.array(g.active),
+            leaf_value=np.array(g.leaf_value),
+            sum_hess=np.array(g.node_sum[:, 1]),
+            gain=np.array(g.gain),
         )
