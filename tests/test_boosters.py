@@ -1,0 +1,108 @@
+"""DART and GBLinear booster tests (reference tests/python/test_basic_models.py
+dart section + gblinear tests)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+from conftest import make_classification, make_regression
+
+
+def test_dart_trains_and_differs_from_gbtree():
+    X, y = make_regression(800, 8)
+    dm = xgb.DMatrix(X, label=y)
+    res_d = {}
+    bst_d = xgb.train({"booster": "dart", "objective": "reg:squarederror",
+                       "rate_drop": 0.5, "max_depth": 4, "eta": 0.3},
+                      dm, 15, evals=[(dm, "train")], evals_result=res_d,
+                      verbose_eval=False)
+    assert res_d["train"]["rmse"][-1] < res_d["train"]["rmse"][0]
+    # dropout + rescale means weights differ from plain gbtree
+    w = bst_d.gbm.tree_weights()
+    assert w is not None and (w < 1.0).any()
+
+
+def test_dart_no_drop_equals_gbtree():
+    X, y = make_regression(500, 6)
+    params = {"objective": "reg:squarederror", "max_depth": 3, "eta": 0.3,
+              "seed": 7}
+    b1 = xgb.train({**params, "booster": "gbtree"},
+                   xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    b2 = xgb.train({**params, "booster": "dart", "rate_drop": 0.0,
+                    "skip_drop": 1.0},
+                   xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    dm = xgb.DMatrix(X)
+    np.testing.assert_allclose(b1.predict(dm), b2.predict(dm), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_dart_save_load(tmp_path):
+    X, y = make_classification(400, 6)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"booster": "dart", "objective": "binary:logistic",
+                     "rate_drop": 0.3, "max_depth": 3}, dm, 8,
+                    verbose_eval=False)
+    p1 = bst.predict(dm)
+    path = str(tmp_path / "dart.json")
+    bst.save_model(path)
+    bst2 = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(p1, bst2.predict(dm), rtol=1e-5)
+
+
+@pytest.mark.parametrize("updater", ["shotgun", "coord_descent"])
+def test_gblinear_recovers_linear_model(updater):
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 6).astype(np.float32)
+    w_true = np.asarray([1.0, -2.0, 0.5, 0.0, 3.0, -0.5], np.float32)
+    y = X @ w_true + 0.01 * rng.randn(2000).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train({"booster": "gblinear", "updater": updater,
+                     "objective": "reg:squarederror", "eta": 0.7},
+                    dm, 50, evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    assert res["train"]["rmse"][-1] < 0.1
+    W = np.asarray(bst.gbm.W)[:, 0]
+    np.testing.assert_allclose(W, w_true, atol=0.1)
+
+
+def test_gblinear_l1_sparsity():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 10).astype(np.float32)
+    w_true = np.zeros(10, np.float32)
+    w_true[:2] = [2.0, -3.0]
+    y = X @ w_true + 0.05 * rng.randn(1500).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                     "alpha": 2.0, "eta": 0.5}, dm, 40, verbose_eval=False)
+    W = np.asarray(bst.gbm.W)[:, 0]
+    # irrelevant coefficients should be (near-)zeroed by L1
+    assert np.abs(W[2:]).max() < np.abs(W[:2]).min() * 0.2
+
+
+def test_gblinear_classification_and_io(tmp_path):
+    X, y = make_classification(800, 5)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"booster": "gblinear", "objective": "binary:logistic",
+                     "eta": 0.5, "eval_metric": "auc"}, dm, 30,
+                    verbose_eval=False)
+    p = bst.predict(dm)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, p) > 0.9
+    path = str(tmp_path / "lin.json")
+    bst.save_model(path)
+    bst2 = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(p, bst2.predict(dm), rtol=1e-5)
+    scores = bst.get_score()
+    assert scores
+
+
+def test_gblinear_missing_as_zero():
+    X, y = make_regression(300, 4)
+    Xm = X.copy()
+    Xm[::5, 2] = np.nan
+    dm = xgb.DMatrix(Xm, label=y)
+    bst = xgb.train({"booster": "gblinear", "objective": "reg:squarederror"},
+                    dm, 5, verbose_eval=False)
+    assert np.isfinite(bst.predict(dm)).all()

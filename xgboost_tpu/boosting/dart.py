@@ -1,0 +1,119 @@
+"""DART booster — gradient boosting with tree dropout.
+
+Reference ``src/gbm/gbtree.cc:664-900``: per iteration a subset of existing
+trees is dropped (uniform or weighted, ``rate_drop``/``one_drop``/``skip_drop``),
+gradients are computed against the margin WITHOUT the dropped trees, and after
+the new tree is committed both it and the dropped trees are rescaled by the
+normalization rule ('tree': new=1/(k+lr), dropped*=k/(k+lr); 'forest':
+new=1/(1+lr), dropped*=1/(1+lr)). DART never uses the incremental prediction
+cache (reference predicts without cache) — margins are recomputed per step.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import BOOSTERS
+from .gbtree import GBTree
+
+
+@BOOSTERS.register("dart")
+class Dart(GBTree):
+    name = "dart"
+    supports_margin_cache = False
+
+    def __init__(self, *args, **kwargs) -> None:
+        self.rate_drop = float(kwargs.pop("rate_drop", 0.0))
+        self.one_drop = bool(kwargs.pop("one_drop", False))
+        self.skip_drop = float(kwargs.pop("skip_drop", 0.0))
+        self.sample_type = str(kwargs.pop("sample_type", "uniform"))
+        self.normalize_type = str(kwargs.pop("normalize_type", "tree"))
+        super().__init__(*args, **kwargs)
+        self.weight_drop: List[float] = []
+        self._dropped: List[int] = []
+        self._rng = np.random.RandomState(0)
+
+    def configure(self, params: dict) -> None:
+        for k in ("rate_drop", "skip_drop"):
+            if k in params:
+                setattr(self, k, float(params[k]))
+        if "one_drop" in params:
+            self.one_drop = str(params["one_drop"]).lower() in ("1", "true")
+        for k in ("sample_type", "normalize_type"):
+            if k in params:
+                setattr(self, k, str(params[k]))
+
+    def tree_weights(self):
+        if not self.weight_drop:
+            return None
+        return np.asarray(self.weight_drop, dtype=np.float32)
+
+    # -- dropout --------------------------------------------------------------
+    def _select_drop(self) -> List[int]:
+        """DropTrees (reference gbtree.cc:664): choose trees to mute this
+        iteration."""
+        n = len(self.trees)
+        if n == 0 or self._rng.rand() < self.skip_drop:
+            return []
+        if self.sample_type == "weighted":
+            w = np.asarray(self.weight_drop, dtype=np.float64)
+            p = w / w.sum() if w.sum() > 0 else None
+            k = max(1, int(self.rate_drop * n)) if (
+                self.one_drop or self.rate_drop > 0) else 0
+            if k == 0:
+                return []
+            idx = self._rng.choice(n, size=min(k, n), replace=False, p=p)
+            return sorted(int(i) for i in idx)
+        mask = self._rng.rand(n) < self.rate_drop
+        idx = list(np.nonzero(mask)[0])
+        if not idx and self.one_drop:
+            idx = [int(self._rng.randint(n))]
+        return [int(i) for i in idx]
+
+    def training_margin(self, state: dict) -> jnp.ndarray:
+        self._dropped = self._select_drop()
+        if not self._dropped:
+            return state["margin"]
+        # margin without dropped trees = base + Σ_{t∉D} w_t tree_t
+        saved = list(self.weight_drop)
+        for t in self._dropped:
+            self.weight_drop[t] = 0.0
+        margin = self.compute_margin(state)
+        self.weight_drop = saved
+        return margin
+
+    def do_boost(self, state, gpair, iteration, key, obj=None, margin=None):
+        start = len(self.trees)
+        delta = super().do_boost(state, gpair, iteration, key, obj=obj,
+                                 margin=margin)
+        n_new = len(self.trees) - start
+        k = len(self._dropped)
+        lr = self.tree_param.eta
+        if k == 0:
+            new_w = 1.0
+        elif self.normalize_type == "forest":
+            new_w = 1.0 / (1.0 + lr)
+            for t in self._dropped:
+                self.weight_drop[t] *= 1.0 / (1.0 + lr)
+        else:  # tree
+            new_w = 1.0 / (k + lr)
+            for t in self._dropped:
+                self.weight_drop[t] *= k / (k + lr)
+        self.weight_drop.extend([new_w] * n_new)
+        self._dropped = []
+        return delta  # caller recomputes margin (supports_margin_cache=False)
+
+    # -- serialization --------------------------------------------------------
+    def to_json(self) -> dict:
+        obj = super().to_json()
+        obj["name"] = "dart"
+        obj["weight_drop"] = list(self.weight_drop)
+        return obj
+
+    def from_json(self, obj: dict) -> None:
+        super().from_json(obj)
+        self.weight_drop = [float(w) for w in obj.get(
+            "weight_drop", [1.0] * len(self.trees))]

@@ -51,15 +51,17 @@ class GBTree:
                                       mesh=self.mesh)
         return self._grower
 
-    def do_boost(self, binned: BinnedMatrix, gpair: jnp.ndarray,
-                 iteration: int, key: jax.Array, obj=None, margin=None,
-                 info=None) -> jnp.ndarray:
+    def do_boost(self, state: dict, gpair: jnp.ndarray,
+                 iteration: int, key: jax.Array, obj=None,
+                 margin=None) -> jnp.ndarray:
         """gpair: [n, K, 2] -> margin delta [n, K] for the training data.
 
-        ``obj``/``margin``/``info`` enable the adaptive-leaf hook
+        ``obj``/``margin`` enable the adaptive-leaf hook
         (``GBTree::UpdateTreeLeaf``, reference ``src/gbm/gbtree.cc:201``):
         leaf values are replaced by per-leaf residual quantiles using the
         grower's row positions."""
+        binned = state["binned"]
+        info = state["info"]
         grower = self._grower_for(binned)
         n, K = gpair.shape[0], gpair.shape[1]
         n_real = binned.n_real_bins()
@@ -93,6 +95,88 @@ class GBTree:
             deltas.append(delta_k)
         self.iteration_indptr.append(len(self.trees))
         return jnp.stack(deltas, axis=1)
+
+    # -- prediction interface (used by core.Booster) --------------------------
+    supports_margin_cache = True
+
+    def version(self) -> int:
+        """Monotone counter identifying the current model contents."""
+        return len(self.trees)
+
+    def training_margin(self, state: dict) -> jnp.ndarray:
+        """Margin to compute gradients against (DART overrides: drop trees)."""
+        return state["margin"]
+
+    def compute_margin(self, state: dict) -> jnp.ndarray:
+        """Full margin recompute for a cache state (non-incremental path)."""
+        if state.get("binned") is not None:
+            delta = self.margin_delta_binned(state["binned"], 0,
+                                             len(self.trees))
+            return state["base"] + delta
+        m, _, _ = self.predict_margin(state["dm"].X,
+                                      np.zeros(self.n_groups, np.float32))
+        return state["base"] + jnp.asarray(m)
+
+    def margin_delta_raw(self, X, tree_lo: int, tree_hi: int):
+        pred = self._predictor(tree_lo, tree_hi)
+        if pred is None:
+            return 0.0
+        delta, _ = pred.margin(X, np.zeros(self.n_groups, np.float32))
+        return delta
+
+    def tree_weights(self) -> Optional[np.ndarray]:
+        return None
+
+    def _predictor(self, lo: int, hi: int):
+        from ..tree.tree import stack_forest
+        from .predict import ForestPredictor
+
+        trees = self.trees[lo:hi]
+        forest = stack_forest(trees)
+        if forest is None:
+            return None
+        w = self.tree_weights()
+        return ForestPredictor(forest, np.asarray(self.tree_info[lo:hi]),
+                               self.n_groups,
+                               tree_weights=None if w is None else w[lo:hi])
+
+    def predict_margin(self, X, base, iteration_range=None):
+        """-> (margin [n, K], leaf heap positions [n, T] or None, trees)."""
+        if iteration_range is not None and iteration_range != (0, 0):
+            b, e = iteration_range
+            e = min(e if e else self.num_boosted_rounds(),
+                    self.num_boosted_rounds())
+            lo, hi = self.iteration_indptr[b], self.iteration_indptr[e]
+        else:
+            lo, hi = 0, len(self.trees)
+        pred = self._predictor(lo, hi)
+        n = X.shape[0]
+        if pred is None:
+            return (np.broadcast_to(np.asarray(base, np.float32)[None, :],
+                                    (n, self.n_groups)).copy(), None,
+                    self.trees[lo:hi])
+        m, pos = pred.margin(X, np.asarray(base, np.float32))
+        return np.asarray(m), pos, self.trees[lo:hi]
+
+    def margin_delta_binned(self, binned, tree_lo: int, tree_hi: int):
+        """Margin contribution of trees [tree_lo, tree_hi) on quantized data
+        (the prediction-cache increment)."""
+        pred = self._predictor(tree_lo, tree_hi)
+        if pred is None:
+            return 0.0
+        delta, _ = pred.margin_binned(binned.bins, binned.max_nbins - 1,
+                                      np.zeros(self.n_groups, np.float32))
+        return delta
+
+    def full_margin_binned(self, binned, base):
+        pred = self._predictor(0, len(self.trees))
+        n = binned.bins.shape[0]
+        if pred is None:
+            return jnp.broadcast_to(
+                jnp.asarray(base, jnp.float32)[None, :], (n, self.n_groups))
+        m, _ = pred.margin_binned(binned.bins, binned.max_nbins - 1,
+                                  np.asarray(base, np.float32))
+        return m
 
     # -- model container ------------------------------------------------------
     def num_boosted_rounds(self) -> int:

@@ -17,15 +17,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .boosting.dart import Dart
+from .boosting.gblinear import GBLinear
 from .boosting.gbtree import GBTree
-from .boosting.predict import ForestPredictor
 from .context import Context
 from .data.dmatrix import DMatrix
 from .logging_utils import console, logger
 from .metric import get_metric
 from .objective import get_objective
 from .tree.param import TrainParam
-from .tree.tree import stack_forest
 
 _VERSION = (0, 1, 0)
 
@@ -41,6 +41,10 @@ _LEARNER_KEYS = {
     "lambdarank_pair_method", "lambdarank_num_pair_per_sample",
     "lambdarank_unbiased", "lambdarank_bias_norm", "ndcg_exp_gain",
     "max_delta_step",
+    # dart
+    "rate_drop", "one_drop", "skip_drop", "sample_type", "normalize_type",
+    # gblinear
+    "updater", "feature_selector", "top_k",
 }
 
 
@@ -64,9 +68,8 @@ class Booster:
         self.base_margin_: Optional[np.ndarray] = None  # [K] margin space
         self._configured = False
         self._caches: Dict[int, Dict[str, Any]] = {}
-        self._predictor: Optional[ForestPredictor] = None
-        self._predictor_ntrees = -1
         self._eval_metrics: List = []
+        self._explicit_params: set = set()
         if params:
             self.set_param(params)
         if model_file is not None:
@@ -80,6 +83,7 @@ class Booster:
         elif isinstance(params, list):
             params = dict(params)
         params = dict(params)
+        self._explicit_params.update(params.keys())
         if "mesh" in params:
             mesh = params.pop("mesh")
             if mesh is not None:
@@ -143,12 +147,7 @@ class Booster:
         info = dtrain.info if dtrain is not None else None
         n_groups = max(1, self.obj.n_targets(info))
         if self.gbm is None:
-            self.gbm = GBTree(
-                self.tree_param, n_groups,
-                num_parallel_tree=int(self.learner_params.get(
-                    "num_parallel_tree", 1)),
-                hist_method=self.learner_params.get("hist_method", "auto"),
-                mesh=self.ctx.mesh)
+            self.gbm = self._make_booster(n_groups)
         if self.base_margin_ is None:
             if "base_score" in self.learner_params and \
                     self.learner_params["base_score"] is not None:
@@ -175,6 +174,33 @@ class Booster:
             self.feature_names = dtrain.info.feature_names
             self.feature_types = dtrain.info.feature_types
         self._configured = True
+
+    def _make_booster(self, n_groups: int):
+        name = self.learner_params.get("booster", "gbtree")
+        if name == "gblinear":
+            # reference gblinear defaults: lambda/alpha 0 unless set by user
+            lam = self.tree_param.reg_lambda if (
+                {"lambda", "reg_lambda"} & self._explicit_params) else 0.0
+            alpha = self.tree_param.reg_alpha if (
+                {"alpha", "reg_alpha"} & self._explicit_params) else 0.0
+            return GBLinear(
+                n_groups,
+                updater=self.learner_params.get("updater", "shotgun"),
+                reg_lambda=lam, reg_alpha=alpha, eta=self.tree_param.eta,
+                feature_selector=self.learner_params.get(
+                    "feature_selector", "cyclic"))
+        kwargs = dict(
+            num_parallel_tree=int(self.learner_params.get(
+                "num_parallel_tree", 1)),
+            hist_method=self.learner_params.get("hist_method", "auto"),
+            mesh=self.ctx.mesh)
+        if name == "dart":
+            gbm = Dart(self.tree_param, n_groups, **kwargs)
+            gbm.configure(self.learner_params)
+            return gbm
+        if name != "gbtree":
+            raise ValueError(f"unknown booster: {name}")
+        return GBTree(self.tree_param, n_groups, **kwargs)
 
     @property
     def n_groups(self) -> int:
@@ -215,7 +241,8 @@ class Booster:
                     jnp.asarray(self.base_margin_, dtype=jnp.float32)[None, :],
                     (n, self.n_groups))
             self._caches[key] = {"binned": binned, "margin": margin,
-                                 "n_trees": 0, "is_train": is_train, "dm": dm,
+                                 "base": margin, "n_trees": 0,
+                                 "is_train": is_train, "dm": dm,
                                  "info": dm.info, "n_valid": n}
         return self._caches[key]
 
@@ -278,8 +305,8 @@ class Booster:
                                               np.float32)])
         margin = jax.device_put(bm, sharding)
         self._caches[key] = {"binned": binned_p, "margin": margin,
-                             "n_trees": 0, "is_train": True, "dm": dm,
-                             "info": info_p, "n_valid": n}
+                             "base": margin, "n_trees": 0, "is_train": True,
+                             "dm": dm, "info": info_p, "n_valid": n}
         return self._caches[key]
 
     def update(self, dtrain: DMatrix, iteration: int,
@@ -287,7 +314,7 @@ class Booster:
         """One boosting iteration (reference ``XGBoosterUpdateOneIter``)."""
         self._configure(dtrain)
         state = self._state_of(dtrain, is_train=True)
-        margin = state["margin"]
+        margin = self.gbm.training_margin(state)
         if fobj is None:
             gpair = self.obj.get_gradient(margin, state["info"], iteration)
         else:
@@ -296,12 +323,14 @@ class Booster:
                 margin.shape), jnp.asarray(hess, dtype=jnp.float32).reshape(
                     margin.shape)], axis=-1)
         key = self.ctx.make_key(iteration)
-        delta = self.gbm.do_boost(state["binned"], gpair, iteration,
+        delta = self.gbm.do_boost(state, gpair, iteration,
                                   jax.random.fold_in(key, iteration),
-                                  obj=self.obj, margin=margin,
-                                  info=state["info"])
-        state["margin"] = margin + delta
-        state["n_trees"] = len(self.gbm.trees)
+                                  obj=self.obj, margin=margin)
+        if self.gbm.supports_margin_cache:
+            state["margin"] = state["margin"] + delta
+        else:
+            state["margin"] = self.gbm.compute_margin(state)
+        state["n_trees"] = self.gbm.version()
 
     def boost(self, dtrain: DMatrix, grad: np.ndarray, hess: np.ndarray) -> None:
         """Boost with externally computed gradients (reference Booster.boost)."""
@@ -313,45 +342,35 @@ class Booster:
              jnp.asarray(hess, dtype=jnp.float32).reshape(margin.shape)],
             axis=-1)
         it = self.num_boosted_rounds()
-        delta = self.gbm.do_boost(state["binned"], gpair, it,
+        delta = self.gbm.do_boost(state, gpair, it,
                                   jax.random.fold_in(self.ctx.make_key(it), it))
-        state["margin"] = margin + delta
-        state["n_trees"] = len(self.gbm.trees)
+        if self.gbm.supports_margin_cache:
+            state["margin"] = state["margin"] + delta
+        else:
+            state["margin"] = self.gbm.compute_margin(state)
+        state["n_trees"] = self.gbm.version()
 
     # -------------------------------------------------------------- prediction
     def _cached_margin(self, dm: DMatrix) -> jnp.ndarray:
-        """Margin with the version-cache trick: walk only trees added since the
-        cache entry was last touched, on the quantized matrix."""
+        """Margin with the version-cache trick: walk only trees added since
+        the cache entry was last touched, on the quantized matrix. Boosters
+        whose old-tree contributions change over time (DART scaling, linear
+        weights) recompute from scratch instead."""
         self._configure(dm)
         state = self._state_of(dm, is_train=False)
-        total = len(self.gbm.trees)
-        if state["n_trees"] < total:
-            new_trees = self.gbm.trees[state["n_trees"]:total]
-            new_info = self.gbm.tree_info[state["n_trees"]:total]
-            forest = stack_forest(new_trees)
-            pred = ForestPredictor(forest, np.asarray(new_info), self.n_groups)
-            binned = state["binned"]
-            if binned is not None:
-                delta, _ = pred.margin_binned(
-                    binned.bins, binned.max_nbins - 1,
-                    np.zeros(self.n_groups, np.float32))
-            else:
-                delta, _ = pred.margin(dm.X,
-                                       np.zeros(self.n_groups, np.float32))
-            state["margin"] = state["margin"] + delta
-            state["n_trees"] = total
+        total = self.gbm.version()
+        if state["n_trees"] == total:
+            return state["margin"]
+        if not self.gbm.supports_margin_cache:
+            state["margin"] = self.gbm.compute_margin(state)
+        elif state["binned"] is not None:
+            state["margin"] = state["margin"] + self.gbm.margin_delta_binned(
+                state["binned"], state["n_trees"], total)
+        else:
+            state["margin"] = state["margin"] + self.gbm.margin_delta_raw(
+                dm.X, state["n_trees"], total)
+        state["n_trees"] = total
         return state["margin"]
-
-    def _full_predictor(self) -> Optional[ForestPredictor]:
-        total = len(self.gbm.trees)
-        if self._predictor is None or self._predictor_ntrees != total:
-            forest = stack_forest(self.gbm.trees)
-            if forest is None:
-                return None
-            self._predictor = ForestPredictor(
-                forest, np.asarray(self.gbm.tree_info), self.n_groups)
-            self._predictor_ntrees = total
-        return self._predictor
 
     def predict(self, data: DMatrix, output_margin: bool = False,
                 pred_leaf: bool = False, pred_contribs: bool = False,
@@ -363,34 +382,17 @@ class Booster:
                 "pred_contribs (SHAP) is not implemented yet")
         self._configure(data if data.info.labels is not None else None)
         X = data.X
-        if iteration_range is not None and iteration_range != (0, 0):
-            trees, info = self.gbm.tree_slice(iteration_range[0],
-                                              iteration_range[1])
-            forest = stack_forest(trees)
-            predictor = (ForestPredictor(forest, np.asarray(info),
-                                         self.n_groups)
-                         if forest is not None else None)
-        else:
-            trees = self.gbm.trees
-            predictor = self._full_predictor()
         base = self.base_margin_ if self.base_margin_ is not None else \
             np.zeros(self.n_groups, np.float32)
+        m, pos, trees = self.gbm.predict_margin(
+            X, np.zeros(self.n_groups, np.float32),
+            iteration_range=iteration_range)
+        margin = np.asarray(m)
         if data.info.base_margin is not None:
             base_rows = np.asarray(data.info.base_margin, np.float32)
+            margin = margin + base_rows.reshape(margin.shape[0], -1)
         else:
-            base_rows = None
-        if predictor is None:
-            margin = np.broadcast_to(base[None, :],
-                                     (data.num_row(), self.n_groups)).copy()
-            pos = None
-        else:
-            m, pos = predictor.margin(
-                X, np.zeros(self.n_groups, np.float32))
-            margin = np.asarray(m)
-            if base_rows is not None:
-                margin = margin + base_rows.reshape(margin.shape[0], -1)
-            else:
-                margin = margin + base[None, :]
+            margin = margin + base[None, :]
         if pred_leaf:
             if pos is None:
                 return np.zeros((data.num_row(), 0), dtype=np.int32)
@@ -482,6 +484,8 @@ class Booster:
     def __getitem__(self, val: slice) -> "Booster":
         if not isinstance(val, slice):
             raise TypeError("Booster slicing requires a slice of iterations")
+        if not isinstance(self.gbm, GBTree):
+            raise NotImplementedError("only tree boosters support slicing")
         begin = val.start or 0
         end = val.stop if val.stop is not None else self.num_boosted_rounds()
         step = val.step if val.step is not None else 1
@@ -499,8 +503,6 @@ class Booster:
             new.gbm.tree_info.extend(self.gbm.tree_info[lo:hi])
             new.gbm.iteration_indptr.append(len(new.gbm.trees))
         new._caches = {}
-        new._predictor = None
-        new._predictor_ntrees = -1
         new.attributes_ = dict(self.attributes_)
         return new
 
@@ -582,7 +584,9 @@ class Booster:
                                         if k != "name"})
         n_groups = max(1, int(lmp.get("num_target", 1)))
         gb = learner.get("gradient_booster", {})
-        self.gbm = GBTree(self.tree_param, n_groups)
+        self.learner_params["booster"] = gb.get("name", "gbtree") if gb \
+            else self.learner_params.get("booster", "gbtree")
+        self.gbm = self._make_booster(n_groups)
         if gb:
             self.gbm.from_json(gb)
         em = self.learner_params.get("eval_metric")
@@ -593,8 +597,6 @@ class Booster:
             self._eval_metrics = [get_metric(self.obj.default_metric)]
         self._configured = True
         self._caches = {}
-        self._predictor = None
-        self._predictor_ntrees = -1
 
     def __getstate__(self):
         return {"raw": bytes(self.save_raw("json"))}
@@ -609,6 +611,11 @@ class Booster:
         """Feature importances (reference ``CalcFeatureScore``,
         ``src/learner.cc``): weight | gain | total_gain | cover | total_cover."""
         self._configure(None)
+        if isinstance(self.gbm, GBLinear):
+            coefs = self.gbm.feature_scores()
+            return {(self.feature_names[f] if self.feature_names
+                     and f < len(self.feature_names) else f"f{f}"): float(v)
+                    for f, v in enumerate(coefs) if v != 0.0}
         scores: Dict[int, float] = {}
         counts: Dict[int, int] = {}
         for tree in self.gbm.trees:
