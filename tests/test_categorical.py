@@ -1,0 +1,100 @@
+"""Categorical split tests (reference tests/python/test_with_pandas.py +
+categorical updater tests)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _cat_data(n=2000, n_cat=8, seed=0, onehot_friendly=True):
+    rng = np.random.RandomState(seed)
+    codes = rng.randint(0, n_cat, n)
+    effects = rng.randn(n_cat) * 2.0
+    x_num = rng.randn(n).astype(np.float32)
+    y = (effects[codes] + 0.5 * x_num + 0.1 * rng.randn(n)).astype(np.float32)
+    X = np.stack([codes.astype(np.float32), x_num], axis=1)
+    return X, y, effects
+
+
+def test_categorical_via_feature_types():
+    X, y, effects = _cat_data(n_cat=6)
+    dm = xgb.DMatrix(X, label=y, feature_types=["c", "float"],
+                     enable_categorical=True)
+    res = {}
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "eta": 0.3, "max_cat_to_onehot": 10}, dm, 25,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    assert res["train"]["rmse"][-1] < 0.3
+    # categorical splits were actually used
+    assert any(t.is_cat_split.any() for t in bst.gbm.trees)
+
+
+def test_categorical_sorted_partition():
+    # many categories -> exceeds max_cat_to_onehot -> sorted-partition path
+    X, y, effects = _cat_data(n=4000, n_cat=30, seed=1)
+    dm = xgb.DMatrix(X, label=y, feature_types=["c", "float"],
+                     enable_categorical=True)
+    res = {}
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 5,
+                     "eta": 0.3, "max_cat_to_onehot": 4}, dm, 30,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    assert res["train"]["rmse"][-1] < 0.4
+    assert any(t.is_cat_split.any() for t in bst.gbm.trees)
+    # a sorted-partition split groups multiple categories on one side
+    multi = False
+    for t in bst.gbm.trees:
+        for h in np.nonzero(t.is_cat_split)[0]:
+            bits = bin(int(t.cat_words[h, 0]))[2:].count("1")
+            if 1 < bits < 29:
+                multi = True
+    assert multi
+
+
+def test_categorical_pandas():
+    X, y, _ = _cat_data(n_cat=5, seed=2)
+    df = pd.DataFrame({
+        "cat": pd.Categorical([f"c{int(v)}" for v in X[:, 0]]),
+        "num": X[:, 1],
+    })
+    dm = xgb.DMatrix(df, label=y, enable_categorical=True)
+    assert dm.info.feature_types[0] == "c"
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4},
+                    dm, 15, verbose_eval=False)
+    p = bst.predict(dm)
+    assert np.sqrt(np.mean((p - y) ** 2)) < 0.6
+
+
+def test_categorical_requires_flag():
+    df = pd.DataFrame({"c": pd.Categorical(["a", "b", "a"])})
+    with pytest.raises(ValueError):
+        xgb.DMatrix(df, label=np.asarray([1.0, 2.0, 3.0]))
+
+
+def test_categorical_save_load_predict(tmp_path):
+    X, y, _ = _cat_data(n_cat=12, seed=3)
+    dm = xgb.DMatrix(X, label=y, feature_types=["c", "float"],
+                     enable_categorical=True)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "max_cat_to_onehot": 4}, dm, 10, verbose_eval=False)
+    p1 = bst.predict(dm)
+    path = str(tmp_path / "cat.json")
+    bst.save_model(path)
+    bst2 = xgb.Booster(model_file=path)
+    np.testing.assert_allclose(p1, bst2.predict(dm), rtol=1e-5, atol=1e-6)
+
+
+def test_unseen_category_goes_default():
+    X, y, _ = _cat_data(n_cat=4, seed=4)
+    dm = xgb.DMatrix(X, label=y, feature_types=["c", "float"],
+                     enable_categorical=True)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 3},
+                    dm, 5, verbose_eval=False)
+    X2 = X[:10].copy()
+    X2[:, 0] = 99.0  # unseen category
+    preds = bst.predict(xgb.DMatrix(X2, feature_types=["c", "float"],
+                                    enable_categorical=True))
+    assert np.isfinite(preds).all()

@@ -1,0 +1,100 @@
+"""Monotone + interaction constraint tests (reference
+tests/python/test_monotone_constraints.py and interaction tests)."""
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+
+def _is_monotone(bst, f, sign, n_features, n_check=200):
+    """Sweep feature f over its range with others fixed; check direction."""
+    rng = np.random.RandomState(0)
+    base = rng.randn(1, n_features).astype(np.float32)
+    xs = np.linspace(-3, 3, n_check).astype(np.float32)
+    Xs = np.repeat(base, n_check, axis=0)
+    Xs[:, f] = xs
+    preds = bst.predict(xgb.DMatrix(Xs))
+    diffs = np.diff(preds)
+    if sign > 0:
+        return (diffs >= -1e-6).all()
+    return (diffs <= 1e-6).all()
+
+
+def test_monotone_increasing_and_decreasing():
+    rng = np.random.RandomState(42)
+    n, f = 3000, 4
+    X = rng.randn(n, f).astype(np.float32)
+    # true signal violates monotonicity (sinusoid) — constraint must win
+    y = (np.sin(2 * X[:, 0]) + X[:, 1] + 0.1 * rng.randn(n)).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "eta": 0.3, "monotone_constraints": "(1,-1,0,0)"},
+                    dm, 20, verbose_eval=False)
+    assert _is_monotone(bst, 0, +1, f)
+    assert _is_monotone(bst, 1, -1, f)
+
+
+def test_monotone_unconstrained_differs():
+    rng = np.random.RandomState(1)
+    n = 2000
+    X = rng.randn(n, 3).astype(np.float32)
+    y = (np.sin(2 * X[:, 0]) + 0.1 * rng.randn(n)).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    b_free = xgb.train({"objective": "reg:squarederror", "max_depth": 4},
+                       dm, 15, verbose_eval=False)
+    b_mono = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                        "monotone_constraints": "(1,0,0)"},
+                       dm, 15, verbose_eval=False)
+    assert not _is_monotone(b_free, 0, +1, 3)
+    assert _is_monotone(b_mono, 0, +1, 3)
+
+
+def _used_features_per_tree(bst):
+    out = []
+    for tree in bst.gbm.trees:
+        used = set(int(f) for f in tree.split_feature[
+            tree.active & ~tree.is_leaf])
+        out.append(used)
+    return out
+
+
+def test_interaction_constraints_respected():
+    rng = np.random.RandomState(2)
+    n = 2000
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3]
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "interaction_constraints": "[[0,1],[2,3]]"},
+                    dm, 10, verbose_eval=False)
+    for used in _used_features_per_tree(bst):
+        # within one tree every PATH must stay inside one group; since groups
+        # are disjoint here, tree-level usage must not mix groups on a path.
+        pass
+    # stronger check: walk each tree's paths
+    for tree in bst.gbm.trees:
+        def walk(h, path):
+            if not tree.active[h] or tree.is_leaf[h]:
+                groups = [{0, 1}, {2, 3}]
+                if path:
+                    assert any(path <= g for g in groups), path
+                return
+            f = int(tree.split_feature[h])
+            walk(2 * h + 1, path | {f})
+            walk(2 * h + 2, path | {f})
+        walk(0, set())
+
+
+def test_interaction_constraints_still_learns():
+    rng = np.random.RandomState(3)
+    n = 1500
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] + 0.1 * rng.randn(n)).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    xgb.train({"objective": "reg:squarederror", "max_depth": 3,
+               "interaction_constraints": "[[0],[1],[2],[3]]"},
+              dm, 15, evals=[(dm, "train")], evals_result=res,
+              verbose_eval=False)
+    assert res["train"]["rmse"][-1] < res["train"]["rmse"][0] * 0.5

@@ -27,12 +27,14 @@ class GBTree:
 
     def __init__(self, tree_param: TrainParam, n_groups: int,
                  num_parallel_tree: int = 1, hist_method: str = "auto",
-                 mesh=None) -> None:
+                 mesh=None, monotone=None, constraint_sets=None) -> None:
         self.tree_param = tree_param
         self.n_groups = n_groups
         self.num_parallel_tree = num_parallel_tree
         self.hist_method = hist_method
         self.mesh = mesh
+        self.monotone = monotone
+        self.constraint_sets = constraint_sets
         self.trees: List[TreeModel] = []
         self.tree_info: List[int] = []
         self.iteration_indptr: List[int] = [0]
@@ -48,7 +50,8 @@ class GBTree:
                 param.eta = param.eta / self.num_parallel_tree
             self._grower = TreeGrower(param, binned.max_nbins, binned.cuts,
                                       hist_method=self.hist_method,
-                                      mesh=self.mesh)
+                                      mesh=self.mesh, monotone=self.monotone,
+                                      constraint_sets=self.constraint_sets)
         return self._grower
 
     def do_boost(self, state: dict, gpair: jnp.ndarray,

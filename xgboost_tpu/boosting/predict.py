@@ -5,6 +5,9 @@ GPU one-thread-per-row ``src/predictor/gpu_predictor.cu:285-320``). The TPU-nati
 predictor is a *level-synchronous* walk: positions for ALL (row, tree) pairs
 advance one depth per step via gathers — no divergence, static shapes, and the
 final per-group reduction is a [rows, trees] x [trees, groups] matmul on the MXU.
+Categorical nodes route by membership in a packed uint32 left-set bitmask
+(reference ``CategoricalSplitMatrix`` + ``Decision``); unseen / out-of-range
+category codes follow the missing direction.
 """
 
 from __future__ import annotations
@@ -17,12 +20,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _bit_is_left(code: jnp.ndarray, words_flat: jnp.ndarray,
+                 gi: jnp.ndarray, n_words: int) -> jnp.ndarray:
+    """code: [n,T] category; words_flat: [T*M, W]; gi: [n,T] node gather ids
+    -> True when code is in the node's left set."""
+    widx = jnp.clip(code // 32, 0, n_words - 1)
+    words = words_flat[gi]                     # [n,T,W]
+    word = jnp.take_along_axis(words, widx[..., None].astype(jnp.int32),
+                               axis=2)[..., 0]
+    bit = (word >> (code % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return bit == 1
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_margin(split_feature: jnp.ndarray, split_value: jnp.ndarray,
                     default_left: jnp.ndarray, is_leaf: jnp.ndarray,
                     leaf_value: jnp.ndarray, tree_weight: jnp.ndarray,
                     group_onehot: jnp.ndarray, X: jnp.ndarray,
-                    base: jnp.ndarray, max_depth: int):
+                    base: jnp.ndarray, max_depth: int,
+                    is_cat_split: Optional[jnp.ndarray] = None,
+                    cat_words: Optional[jnp.ndarray] = None):
     """-> (margin [n, G], leaf_pos [n, T] heap ids)."""
     n = X.shape[0]
     T, M = split_feature.shape
@@ -32,12 +49,26 @@ def _predict_margin(split_feature: jnp.ndarray, split_value: jnp.ndarray,
     sv = split_value.reshape(-1)
     dl = default_left.reshape(-1)
     lf = is_leaf.reshape(-1)
+    if cat_words is not None:
+        ics = is_cat_split.reshape(-1)
+        cw = cat_words.reshape(T * M, -1)
+        n_words = cat_words.shape[-1]
+        n_cats = n_words * 32
 
     for _ in range(max_depth):
         gi = tofs + pos
         feat = sf[gi]
         x = jnp.take_along_axis(X, jnp.maximum(feat, 0), axis=1)
-        go_right = jnp.where(jnp.isnan(x), ~dl[gi], x > sv[gi])
+        go_right = x > sv[gi]
+        missing = jnp.isnan(x)
+        if cat_words is not None:
+            code = jnp.where(missing, -1, x).astype(jnp.int32)
+            in_range = (code >= 0) & (code < n_cats)
+            left = _bit_is_left(jnp.maximum(code, 0), cw, gi, n_words)
+            cat_node = ics[gi]
+            go_right = jnp.where(cat_node, ~left, go_right)
+            missing = missing | (cat_node & ~in_range)
+        go_right = jnp.where(missing, ~dl[gi], go_right)
         pos = jnp.where(lf[gi], pos, 2 * pos + 1 + go_right.astype(jnp.int32))
 
     leaf = leaf_value.reshape(-1)[tofs + pos] * tree_weight[None, :]
@@ -51,8 +82,13 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
                            default_left: jnp.ndarray, is_leaf: jnp.ndarray,
                            leaf_value: jnp.ndarray, tree_weight: jnp.ndarray,
                            group_onehot: jnp.ndarray, bins: jnp.ndarray,
-                           base: jnp.ndarray, max_depth: int, missing_bin: int):
-    """Same walk over the quantized matrix (training-data fast path)."""
+                           base: jnp.ndarray, max_depth: int,
+                           missing_bin: int,
+                           is_cat_split: Optional[jnp.ndarray] = None,
+                           cat_words: Optional[jnp.ndarray] = None):
+    """Same walk over the quantized matrix (training-data fast path). For
+    categorical features local bin == category code, so the same bitmask test
+    applies."""
     n = bins.shape[0]
     T, M = split_feature.shape
     pos = jnp.zeros((n, T), jnp.int32)
@@ -61,6 +97,10 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
     sb = split_bin.reshape(-1)
     dl = default_left.reshape(-1)
     lf = is_leaf.reshape(-1)
+    if cat_words is not None:
+        ics = is_cat_split.reshape(-1)
+        cw = cat_words.reshape(T * M, -1)
+        n_words = cat_words.shape[-1]
 
     for _ in range(max_depth):
         gi = tofs + pos
@@ -68,7 +108,11 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
         b = jnp.take_along_axis(bins, jnp.maximum(feat, 0).astype(jnp.int32),
                                 axis=1).astype(jnp.int32)
         miss = b == missing_bin
-        go_right = jnp.where(miss, ~dl[gi], b > sb[gi])
+        go_right = b > sb[gi]
+        if cat_words is not None:
+            left = _bit_is_left(b, cw, gi, n_words)
+            go_right = jnp.where(ics[gi], ~left, go_right)
+        go_right = jnp.where(miss, ~dl[gi], go_right)
         pos = jnp.where(lf[gi], pos, 2 * pos + 1 + go_right.astype(jnp.int32))
 
     leaf = leaf_value.reshape(-1)[tofs + pos] * tree_weight[None, :]
@@ -86,27 +130,36 @@ class ForestPredictor:
         self.max_depth = int(np.log2(self.max_nodes + 1)) - 1
         self.n_groups = n_groups
         self.dev = {k: jnp.asarray(v) for k, v in forest.items()}
+        self.has_cat = "cat_words" in forest
         w = np.ones(self.n_trees) if tree_weights is None else tree_weights
         self.tree_weight = jnp.asarray(w, dtype=jnp.float32)
         onehot = np.zeros((self.n_trees, n_groups), dtype=np.float32)
         onehot[np.arange(self.n_trees), np.asarray(tree_info)] = 1.0
         self.group_onehot = jnp.asarray(onehot)
 
+    def _cat_args(self):
+        if self.has_cat:
+            return self.dev["is_cat_split"], self.dev["cat_words"]
+        return None, None
+
     def margin(self, X: jnp.ndarray, base: np.ndarray):
+        ics, cw = self._cat_args()
         m, pos = _predict_margin(
             self.dev["split_feature"], self.dev["split_value"],
             self.dev["default_left"], self.dev["is_leaf"],
             self.dev["leaf_value"], self.tree_weight, self.group_onehot,
             jnp.asarray(X, dtype=jnp.float32),
-            jnp.asarray(base, dtype=jnp.float32), self.max_depth)
+            jnp.asarray(base, dtype=jnp.float32), self.max_depth,
+            ics, cw)
         return m, pos
 
     def margin_binned(self, bins: jnp.ndarray, missing_bin: int,
                       base: np.ndarray):
+        ics, cw = self._cat_args()
         m, pos = _predict_margin_binned(
             self.dev["split_feature"], self.dev["split_bin"],
             self.dev["default_left"], self.dev["is_leaf"],
             self.dev["leaf_value"], self.tree_weight, self.group_onehot,
             bins, jnp.asarray(base, dtype=jnp.float32), self.max_depth,
-            missing_bin)
+            missing_bin, ics, cw)
         return m, pos

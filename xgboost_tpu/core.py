@@ -123,16 +123,6 @@ class Booster:
         if tm not in ("auto", "hist", "gpu_hist", "tpu_hist"):
             raise NotImplementedError(
                 f"tree_method={tm} is not implemented yet; use 'hist'")
-        # parameters accepted by TrainParam but not yet wired into the grower
-        # must fail loudly, not silently train without the constraint
-        from .tree.param import parse_monotone_constraints
-        if parse_monotone_constraints(
-                self.tree_param.monotone_constraints, 0) is not None:
-            raise NotImplementedError(
-                "monotone_constraints are not implemented yet")
-        if self.tree_param.interaction_constraints.strip():
-            raise NotImplementedError(
-                "interaction_constraints are not implemented yet")
         if self.tree_param.grow_policy != "depthwise":
             raise NotImplementedError(
                 f"grow_policy={self.tree_param.grow_policy} is not "
@@ -147,7 +137,8 @@ class Booster:
         info = dtrain.info if dtrain is not None else None
         n_groups = max(1, self.obj.n_targets(info))
         if self.gbm is None:
-            self.gbm = self._make_booster(n_groups)
+            self.gbm = self._make_booster(
+                n_groups, dtrain.num_col() if dtrain is not None else 0)
         if self.base_margin_ is None:
             if "base_score" in self.learner_params and \
                     self.learner_params["base_score"] is not None:
@@ -175,7 +166,7 @@ class Booster:
             self.feature_types = dtrain.info.feature_types
         self._configured = True
 
-    def _make_booster(self, n_groups: int):
+    def _make_booster(self, n_groups: int, n_features: int = 0):
         name = self.learner_params.get("booster", "gbtree")
         if name == "gblinear":
             # reference gblinear defaults: lambda/alpha 0 unless set by user
@@ -189,11 +180,21 @@ class Booster:
                 reg_lambda=lam, reg_alpha=alpha, eta=self.tree_param.eta,
                 feature_selector=self.learner_params.get(
                     "feature_selector", "cyclic"))
+        from .tree.param import (parse_interaction_constraints,
+                                 parse_monotone_constraints)
+
+        nf = n_features or (len(self.feature_names)
+                            if self.feature_names else 0)
+        mono = parse_monotone_constraints(
+            self.tree_param.monotone_constraints, nf)
+        ics = parse_interaction_constraints(
+            self.tree_param.interaction_constraints or None, nf,
+            self.feature_names)
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
             hist_method=self.learner_params.get("hist_method", "auto"),
-            mesh=self.ctx.mesh)
+            mesh=self.ctx.mesh, monotone=mono, constraint_sets=ics)
         if name == "dart":
             gbm = Dart(self.tree_param, n_groups, **kwargs)
             gbm.configure(self.learner_params)

@@ -129,7 +129,8 @@ class DMatrix:
                  or (ref_cuts is None and self._binned_max_bin != max_bin))
         if stale:
             cuts = ref_cuts if ref_cuts is not None else sketch_matrix(
-                self.X, max_bin, self.info.weights)
+                self.X, max_bin, self.info.weights,
+                self.info.feature_types)
             self._binned = BinnedMatrix.from_dense(self.X, cuts)
             self._binned_max_bin = max_bin
         return self._binned

@@ -96,6 +96,7 @@ class HistogramCuts:
     ptrs: np.ndarray      # [n_features + 1] int32
     min_vals: np.ndarray  # [n_features] f32
     max_bin: int = 256
+    feature_types: Optional[list] = None  # 'c' marks categorical features
 
     @property
     def n_features(self) -> int:
@@ -133,12 +134,18 @@ class HistogramCuts:
         x <= split_value."""
         return float(self.values[int(self.ptrs[f]) + int(local_bin)])
 
+    def is_cat(self) -> np.ndarray:
+        if not self.feature_types:
+            return np.zeros(self.n_features, dtype=bool)
+        return np.asarray([t == "c" for t in self.feature_types])
+
     def to_json(self) -> dict:
         return {
             "values": np.asarray(self.values, dtype=np.float64).tolist(),
             "ptrs": self.ptrs.tolist(),
             "min_vals": np.asarray(self.min_vals, dtype=np.float64).tolist(),
             "max_bin": self.max_bin,
+            "feature_types": self.feature_types,
         }
 
     @staticmethod
@@ -148,17 +155,29 @@ class HistogramCuts:
             ptrs=np.asarray(obj["ptrs"], dtype=np.int32),
             min_vals=np.asarray(obj["min_vals"], dtype=np.float32),
             max_bin=int(obj.get("max_bin", 256)),
+            feature_types=obj.get("feature_types"),
         )
 
 
-def cuts_from_summaries(summaries: Sequence[FeatureSummary], max_bin: int) -> HistogramCuts:
+def cuts_from_summaries(summaries: Sequence[FeatureSummary], max_bin: int,
+                        feature_types: Optional[List[str]] = None
+                        ) -> HistogramCuts:
     """Build cuts at evenly spaced weighted ranks, mirroring
     ``HistogramCuts::Build`` semantics (last cut strictly above the max value so
-    every observed value lands in a real bin)."""
+    every observed value lands in a real bin). Categorical features ('c' in
+    feature_types) get one bin per category code: bin i == category i."""
     values: List[np.ndarray] = []
     ptrs = [0]
     min_vals = []
-    for s in summaries:
+    for f, s in enumerate(summaries):
+        if feature_types is not None and f < len(feature_types) \
+                and feature_types[f] == "c":
+            n_cat = int(s.values.max()) + 1 if s.values.size else 1
+            cuts = np.arange(n_cat, dtype=np.float32)
+            min_vals.append(-0.5)
+            values.append(cuts)
+            ptrs.append(ptrs[-1] + len(cuts))
+            continue
         if s.values.size == 0:
             cuts = np.asarray([np.inf], dtype=np.float32)
             min_vals.append(0.0)
@@ -182,12 +201,13 @@ def cuts_from_summaries(summaries: Sequence[FeatureSummary], max_bin: int) -> Hi
            else np.empty(0, dtype=np.float32)).astype(np.float32)
     return HistogramCuts(values=out, ptrs=np.asarray(ptrs, dtype=np.int32),
                          min_vals=np.asarray(min_vals, dtype=np.float32),
-                         max_bin=max_bin)
+                         max_bin=max_bin, feature_types=feature_types)
 
 
 def sketch_matrix(X: np.ndarray, max_bin: int,
-                  weights: Optional[np.ndarray] = None) -> HistogramCuts:
+                  weights: Optional[np.ndarray] = None,
+                  feature_types: Optional[List[str]] = None) -> HistogramCuts:
     """``SketchOnDMatrix`` analogue (reference ``src/common/hist_util.cc:32-69``)
     for an in-memory dense matrix with NaN as missing."""
     summaries = [FeatureSummary.from_data(X[:, f], weights) for f in range(X.shape[1])]
-    return cuts_from_summaries(summaries, max_bin)
+    return cuts_from_summaries(summaries, max_bin, feature_types)

@@ -10,18 +10,35 @@ O(n) per depth, embarrassingly parallel, no sorting needed for training.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
+
+
+def cat_goes_right(b: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """b: [n] bin/category ids; words: [n, W] uint32 left-set bitmasks ->
+    True when the category is NOT in the left set."""
+    W = words.shape[1]
+    widx = jnp.clip(b // 32, 0, W - 1)
+    word = jnp.take_along_axis(words, widx[:, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+    bit = (word >> (b % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return bit == 0
 
 
 def update_positions(bins: jnp.ndarray, positions: jnp.ndarray,
                      split_feature: jnp.ndarray, split_bin: jnp.ndarray,
                      default_left: jnp.ndarray, is_split: jnp.ndarray,
-                     missing_bin: int) -> jnp.ndarray:
+                     missing_bin: int,
+                     is_cat_split: Optional[jnp.ndarray] = None,
+                     cat_words: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Advance rows one level down the tree.
 
     bins: [n, F] local bin ids; positions: [n] current heap node id;
     split_*: [max_nodes] per-node split info; is_split: [max_nodes] bool
     (True where the node was just expanded). Rows at non-split nodes stay put.
+    Categorical nodes route by left-set bitmask membership instead of the
+    threshold comparison (reference ``CategoricalSplitMatrix`` decision).
     """
     feat = split_feature[positions]
     thr = split_bin[positions]
@@ -31,7 +48,12 @@ def update_positions(bins: jnp.ndarray, positions: jnp.ndarray,
     b = jnp.take_along_axis(bins, safe_feat[:, None].astype(jnp.int32),
                             axis=1)[:, 0].astype(jnp.int32)
     missing = b == missing_bin
-    go_right = jnp.where(missing, ~dleft, b > thr)
+    go_right = b > thr
+    if is_cat_split is not None:
+        node_words = cat_words[positions]                 # [n, W]
+        go_right = jnp.where(is_cat_split[positions],
+                             cat_goes_right(b, node_words), go_right)
+    go_right = jnp.where(missing, ~dleft, go_right)
     return jnp.where(splitting,
                      2 * positions + 1 + go_right.astype(positions.dtype),
                      positions)

@@ -1,13 +1,17 @@
 """Split evaluation — vectorized enumeration over (node, feature, bin, direction).
 
 Reference: ``HistEvaluator::EnumerateSplit`` forward/backward scans
-(``src/tree/hist/evaluate_splits.h:218``) and the GPU block-scan + ArgMax version
-(``src/tree/gpu_hist/evaluate_splits.cu:47-130``). TPU formulation: because the
-histogram carries an explicit per-feature missing slot (data/binned.py), both
-missing directions come from ONE cumulative sum — ``left = cumsum(present)`` for
-missing-right and ``left + missing`` for missing-left — instead of two scans.
-Everything is a dense [nodes, features, bins, 2-dirs] gain tensor followed by a
-flat argmax per node: pure VPU work that XLA fuses.
+(``src/tree/hist/evaluate_splits.h:218``), one-hot categorical (``:69``),
+sorted-partition categorical (``EnumeratePart:146``), and the GPU block-scan +
+ArgMax version (``src/tree/gpu_hist/evaluate_splits.cu:47-130``). TPU
+formulation: because the histogram carries an explicit per-feature missing slot
+(data/binned.py), both missing directions come from ONE cumulative sum —
+``left = cumsum(present)`` for missing-right and ``left + missing`` for
+missing-left — instead of two scans. Categorical features reuse the same dense
+[nodes, features, bins, dirs] gain tensor: one-hot treats each category as the
+right child; sorted-partition sorts categories by g/(h+lambda) and scans
+prefixes (the winning prefix is packed into a uint32 bitmask in-kernel).
+Everything ends in a flat argmax per node: pure VPU work that XLA fuses.
 """
 
 from __future__ import annotations
@@ -21,6 +25,14 @@ from ..tree.param import TrainParam, calc_gain
 _EPS = 1e-6  # reference kRtEps
 
 
+class CatInfo(NamedTuple):
+    """Categorical feature descriptors (bitmask word count is derived from the
+    bin count where needed, keeping this a plain array pytree)."""
+
+    is_cat: jnp.ndarray     # [F] bool
+    is_onehot: jnp.ndarray  # [F] bool — cat with n_real <= max_cat_to_onehot
+
+
 class SplitResult(NamedTuple):
     gain: jnp.ndarray          # [N] loss_chg of best split (-inf if none valid)
     feature: jnp.ndarray       # [N] int32
@@ -28,34 +40,105 @@ class SplitResult(NamedTuple):
     default_left: jnp.ndarray  # [N] bool — direction for missing values
     left_sum: jnp.ndarray      # [N, 2]
     right_sum: jnp.ndarray     # [N, 2]
+    is_cat: jnp.ndarray        # [N] bool — categorical split chosen
+    cat_words: jnp.ndarray     # [N, W] uint32 — categories going LEFT
+
+
+def _pack_mask(mask: jnp.ndarray, n_words: int) -> jnp.ndarray:
+    """[N, B-1] bool -> [N, W] uint32 little-endian bit words."""
+    N, nb = mask.shape
+    pad = n_words * 32 - nb
+    if pad:
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    m = mask.reshape(N, n_words, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, None]
+    return jnp.sum(m * weights, axis=2, dtype=jnp.uint32)
 
 
 def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                     n_real_bins: jnp.ndarray, param: TrainParam,
-                    feature_mask: Optional[jnp.ndarray] = None) -> SplitResult:
+                    feature_mask: Optional[jnp.ndarray] = None,
+                    monotone: Optional[jnp.ndarray] = None,
+                    node_lower: Optional[jnp.ndarray] = None,
+                    node_upper: Optional[jnp.ndarray] = None,
+                    cat: Optional[CatInfo] = None) -> SplitResult:
     """hist: [N, F, B, 2] with missing mass in slot B-1; parent_sum: [N, 2];
     n_real_bins: [F]; feature_mask: [F] or [N, F] bool (colsample /
-    interaction constraints), True = usable."""
+    interaction constraints), True = usable.
+
+    With ``monotone`` ([F] in {-1,0,1}) set, gains are computed from child
+    weights clamped into the node's [node_lower, node_upper] interval and
+    sign-violating splits are rejected (reference ``TreeEvaluator``,
+    ``src/tree/split_evaluator.h:28``)."""
     N, F, B, _ = hist.shape
     present = hist[:, :, : B - 1, :]                      # [N,F,B-1,2]
     miss = hist[:, :, B - 1, :]                           # [N,F,2]
     cum = jnp.cumsum(present, axis=2)                     # left sums, missing->right
     parent = parent_sum[:, None, None, :]
+    bins_idx = jnp.arange(B - 1, dtype=jnp.int32)
 
     # dir 0 = missing right (default_left=False), dir 1 = missing left
     left = jnp.stack([cum, cum + miss[:, :, None, :]], axis=3)  # [N,F,B-1,2dir,2]
+    base_valid = bins_idx[None, :, None] < n_real_bins[:, None, None]  # [F,B-1,1]
+    base_valid = jnp.broadcast_to(base_valid[None], (N, F, B - 1, 2))
+
+    if cat is not None:
+        ic4 = cat.is_cat[None, :, None, None]          # vs [N,F,B-1,2dir]
+        ic5 = cat.is_cat[None, :, None, None, None]    # vs [N,F,B-1,2dir,2]
+        oh4 = cat.is_onehot[None, :, None, None]
+        oh5 = cat.is_onehot[None, :, None, None, None]
+        # sorted-partition order: categories ascending by g/(h+lambda)
+        # (reference evaluator sorts by weight, evaluate_splits.h:146)
+        ratio = present[..., 0] / (present[..., 1] + param.reg_lambda + 1e-10)
+        empty = present[..., 1] <= 0.0
+        ratio = jnp.where(empty, jnp.inf, ratio)  # empty cats sort last
+        order = jnp.argsort(ratio, axis=2)                       # [N,F,B-1]
+        ranks = jnp.argsort(order, axis=2).astype(jnp.int32)
+        sorted_hist = jnp.take_along_axis(present, order[..., None], axis=2)
+        cums = jnp.cumsum(sorted_hist, axis=2)
+        left_sorted = jnp.stack([cums, cums + miss[:, :, None, :]], axis=3)
+        # one-hot: right child = {category c}; missing follows the default
+        # direction: dir 0 -> left = parent - hist[c] - miss (missing right),
+        # dir 1 -> left = parent - hist[c] (missing left)
+        left_oh = jnp.stack(
+            [parent - miss[:, :, None, :] - present, parent - present],
+            axis=3)
+        left = jnp.where(ic5, jnp.where(oh5, left_oh, left_sorted), left)
+        # validity: sorted prefixes capped by max_cat_threshold
+        cat_valid = jnp.where(
+            oh4, base_valid,
+            base_valid & (bins_idx[None, None, :, None]
+                          < param.max_cat_threshold))
+        base_valid = jnp.where(ic4, cat_valid, base_valid)
+
     right = parent[..., None, :] - left
 
     lg, lh = left[..., 0], left[..., 1]
     rg, rh = right[..., 0], right[..., 1]
-    pgain = calc_gain(parent_sum[:, 0], parent_sum[:, 1], param)  # [N]
-    loss_chg = (calc_gain(lg, lh, param) + calc_gain(rg, rh, param)
-                - pgain[:, None, None, None])
+    if monotone is None:
+        pgain = calc_gain(parent_sum[:, 0], parent_sum[:, 1], param)  # [N]
+        loss_chg = (calc_gain(lg, lh, param) + calc_gain(rg, rh, param)
+                    - pgain[:, None, None, None])
+        mono_ok = True
+    else:
+        from ..tree.param import calc_gain_given_weight, calc_weight
 
-    bins_idx = jnp.arange(B - 1, dtype=jnp.int32)
-    valid = (bins_idx[None, :, None] < n_real_bins[:, None, None])  # [F,B-1,1]
-    valid = valid[None] & (lh >= param.min_child_weight) \
-        & (rh >= param.min_child_weight)
+        lo = node_lower[:, None, None, None]
+        hi = node_upper[:, None, None, None]
+        wl = jnp.clip(calc_weight(lg, lh, param), lo, hi)
+        wr = jnp.clip(calc_weight(rg, rh, param), lo, hi)
+        wp = jnp.clip(calc_weight(parent_sum[:, 0], parent_sum[:, 1], param),
+                      node_lower, node_upper)
+        pgain = calc_gain_given_weight(parent_sum[:, 0], parent_sum[:, 1],
+                                       wp, param)
+        loss_chg = (calc_gain_given_weight(lg, lh, wl, param)
+                    + calc_gain_given_weight(rg, rh, wr, param)
+                    - pgain[:, None, None, None])
+        mc = monotone[None, :, None, None]
+        mono_ok = (mc == 0) | (mc * (wr - wl) >= 0)
+
+    valid = base_valid & (lh >= param.min_child_weight) \
+        & (rh >= param.min_child_weight) & mono_ok
     if feature_mask is not None:
         fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
         valid = valid & fm[:, :, None, None]
@@ -72,6 +155,27 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     nn = jnp.arange(N)
     best_left = left[nn, f_idx, b_idx, d_idx]             # [N,2]
     best_right = parent_sum - best_left
-    return SplitResult(gain=best_gain, feature=f_idx, bin=b_idx,
-                       default_left=d_idx.astype(bool),
-                       left_sum=best_left, right_sum=best_right)
+
+    if cat is None:
+        w = 1
+        return SplitResult(
+            gain=best_gain, feature=f_idx, bin=b_idx,
+            default_left=d_idx.astype(bool), left_sum=best_left,
+            right_sum=best_right, is_cat=jnp.zeros((N,), bool),
+            cat_words=jnp.zeros((N, w), jnp.uint32))
+
+    chosen_cat = cat.is_cat[f_idx]
+    chosen_oh = cat.is_onehot[f_idx]
+    # left-set mask over real bins of the winning feature
+    real = bins_idx[None, :] < n_real_bins[f_idx][:, None]        # [N,B-1]
+    oh_mask = (bins_idx[None, :] != b_idx[:, None]) & real
+    win_rank = ranks[nn, f_idx]                                    # [N,B-1]
+    sort_mask = (win_rank <= b_idx[:, None]) & real
+    mask = jnp.where(chosen_oh[:, None], oh_mask, sort_mask) \
+        & chosen_cat[:, None]
+    n_words = (B - 2) // 32 + 1
+    return SplitResult(
+        gain=best_gain, feature=f_idx, bin=b_idx,
+        default_left=d_idx.astype(bool), left_sum=best_left,
+        right_sum=best_right, is_cat=chosen_cat,
+        cat_words=_pack_mask(mask, n_words))

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..ops.histogram import build_hist
 from ..ops.partition import update_positions
-from ..ops.split import evaluate_splits
+from ..ops.split import CatInfo, evaluate_splits
 from .param import TrainParam, calc_weight
 from .tree import TreeModel
 
@@ -47,6 +47,8 @@ class GrownTree(NamedTuple):
     gain: jnp.ndarray           # [max_nodes] f32
     positions: jnp.ndarray      # [n_rows] int32 final heap leaf per row
     delta: jnp.ndarray          # [n_rows] f32 leaf value per row (margin update)
+    is_cat_split: jnp.ndarray   # [max_nodes] bool
+    cat_words: jnp.ndarray      # [max_nodes, W] uint32 — categories going LEFT
 
 
 def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
@@ -67,8 +69,11 @@ def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
     jax.jit,
     static_argnames=("param", "max_nbins", "hist_method", "axis_name"))
 def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
-          tree_mask: jnp.ndarray, key: jax.Array, *, param: TrainParam,
-          max_nbins: int, hist_method: str = "auto",
+          tree_mask: jnp.ndarray, key: jax.Array,
+          monotone: Optional[jnp.ndarray] = None,
+          constraint_sets: Optional[jnp.ndarray] = None,
+          cat: Optional[CatInfo] = None, *,
+          param: TrainParam, max_nbins: int, hist_method: str = "auto",
           axis_name: Optional[str] = None) -> GrownTree:
     n, F = bins.shape
     max_depth = param.max_depth
@@ -88,6 +93,16 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     root_sum = allreduce(jnp.sum(gpair, axis=0))
     node_sum = node_sum.at[0].set(root_sum)
     positions = jnp.zeros((n,), jnp.int32)
+    if monotone is not None:
+        # per-node weight bounds (reference TreeEvaluator lower/upper arrays)
+        node_lower = jnp.full((max_nodes,), -jnp.inf, jnp.float32)
+        node_upper = jnp.full((max_nodes,), jnp.inf, jnp.float32)
+    if constraint_sets is not None:
+        # features used on the path to each node (interaction constraints)
+        node_path = jnp.zeros((max_nodes, F), bool)
+    n_words = (max_nbins - 2) // 32 + 1 if cat is not None else 1
+    is_cat_split = jnp.zeros((max_nodes,), bool)
+    cat_words = jnp.zeros((max_nodes, n_words), jnp.uint32)
 
     for depth in range(max_depth):
         lo = 2 ** depth - 1
@@ -112,9 +127,25 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         else:
             fmask = level_mask[None, :]
 
+        if constraint_sets is not None:
+            # allowed(n) = union of constraint sets containing path(n)
+            # (reference FeatureInteractionConstraintHost semantics)
+            path = node_path[lo:lo + n_level]                    # [N,F]
+            compat = ~jnp.any(path[:, None, :] & ~constraint_sets[None, :, :],
+                              axis=2)                            # [N,S]
+            allowed = jnp.any(compat[:, :, None]
+                              & constraint_sets[None, :, :], axis=1)  # [N,F]
+            fmask = fmask & allowed
+
         parent_sum = node_sum[lo:lo + n_level]
-        res = evaluate_splits(hist, parent_sum, n_real_bins, param,
-                              feature_mask=fmask)
+        res = evaluate_splits(
+            hist, parent_sum, n_real_bins, param, feature_mask=fmask,
+            monotone=monotone,
+            node_lower=node_lower[lo:lo + n_level]
+            if monotone is not None else None,
+            node_upper=node_upper[lo:lo + n_level]
+            if monotone is not None else None,
+            cat=cat)
 
         # a node exists at this level iff its parent split; it expands unless
         # the best gain fails the gamma / kRtEps test (reference prune rule).
@@ -128,6 +159,11 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         default_left = default_left.at[idx].set(can_split & res.default_left)
         is_leaf = is_leaf.at[idx].set(~can_split)
         gain = gain.at[idx].set(jnp.where(can_split, res.gain, 0.0))
+        if cat is not None:
+            is_cat_split = is_cat_split.at[idx].set(can_split & res.is_cat)
+            cat_words = cat_words.at[idx].set(
+                jnp.where((can_split & res.is_cat)[:, None], res.cat_words,
+                          jnp.uint32(0)))
 
         li, ri = 2 * idx + 1, 2 * idx + 2
         active = active.at[li].set(can_split).at[ri].set(can_split)
@@ -136,18 +172,54 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             jnp.where(can_split[:, None], res.left_sum, zero2))
         node_sum = node_sum.at[ri].set(
             jnp.where(can_split[:, None], res.right_sum, zero2))
+        if monotone is not None:
+            plo = node_lower[lo:lo + n_level]
+            phi = node_upper[lo:lo + n_level]
+            wl = jnp.clip(calc_weight(res.left_sum[:, 0], res.left_sum[:, 1],
+                                      param), plo, phi)
+            wr = jnp.clip(calc_weight(res.right_sum[:, 0],
+                                      res.right_sum[:, 1], param), plo, phi)
+            mid = (wl + wr) * 0.5
+            mc = monotone[jnp.maximum(res.feature, 0)]
+            # c=+1: left must stay <= mid, right >= mid; c=-1 mirrored
+            l_hi = jnp.where(mc > 0, mid, phi)
+            r_lo = jnp.where(mc > 0, mid, plo)
+            l_lo = jnp.where(mc < 0, mid, plo)
+            r_hi = jnp.where(mc < 0, mid, phi)
+            node_lower = node_lower.at[li].set(jnp.where(can_split, l_lo, 0))
+            node_upper = node_upper.at[li].set(
+                jnp.where(can_split, l_hi, 0))
+            node_lower = node_lower.at[ri].set(jnp.where(can_split, r_lo, 0))
+            node_upper = node_upper.at[ri].set(
+                jnp.where(can_split, r_hi, 0))
+        if constraint_sets is not None:
+            path = node_path[lo:lo + n_level]
+            fsel = (jnp.arange(F, dtype=jnp.int32)[None, :]
+                    == jnp.maximum(res.feature, 0)[:, None]) \
+                & can_split[:, None]
+            child_path = path | fsel
+            node_path = node_path.at[li].set(child_path)
+            node_path = node_path.at[ri].set(child_path)
 
         is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(can_split)
         positions = update_positions(bins, positions, split_feature, split_bin,
-                                     default_left, is_split_full, missing_bin)
+                                     default_left, is_split_full, missing_bin,
+                                     is_cat_split=is_cat_split
+                                     if cat is not None else None,
+                                     cat_words=cat_words
+                                     if cat is not None else None)
 
-    w = calc_weight(node_sum[:, 0], node_sum[:, 1], param) * param.eta
+    w = calc_weight(node_sum[:, 0], node_sum[:, 1], param)
+    if monotone is not None:
+        w = jnp.clip(w, node_lower, node_upper)
+    w = w * param.eta
     leaf_value = jnp.where(active & is_leaf, w, 0.0).astype(jnp.float32)
     delta = leaf_value[positions]
     return GrownTree(split_feature=split_feature, split_bin=split_bin,
                      default_left=default_left, is_leaf=is_leaf, active=active,
                      leaf_value=leaf_value, node_sum=node_sum, gain=gain,
-                     positions=positions, delta=delta)
+                     positions=positions, delta=delta,
+                     is_cat_split=is_cat_split, cat_words=cat_words)
 
 
 class TreeGrower:
@@ -159,12 +231,27 @@ class TreeGrower:
 
     def __init__(self, param: TrainParam, max_nbins: int, cuts,
                  hist_method: str = "auto",
-                 mesh: Optional[jax.sharding.Mesh] = None) -> None:
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 monotone: Optional[np.ndarray] = None,
+                 constraint_sets: Optional[np.ndarray] = None) -> None:
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
         self.hist_method = hist_method
         self.mesh = mesh
+        self.monotone = (None if monotone is None
+                         else jnp.asarray(monotone, jnp.int32))
+        self.constraint_sets = (None if constraint_sets is None
+                                else jnp.asarray(constraint_sets, bool))
+        is_cat = cuts.is_cat()
+        if is_cat.any():
+            n_real = cuts.n_real_bins()
+            self.cat = CatInfo(
+                is_cat=jnp.asarray(is_cat),
+                is_onehot=jnp.asarray(
+                    is_cat & (n_real <= param.max_cat_to_onehot)))
+        else:
+            self.cat = None
         self._sharded_fn = None
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
@@ -176,6 +263,7 @@ class TreeGrower:
         key = jax.random.fold_in(key, 0x5EED)
         if self.mesh is None:
             return _grow(bins, gpair, n_real_bins, tree_mask, key,
+                         self.monotone, self.constraint_sets, self.cat,
                          param=self.param, max_nbins=self.max_nbins,
                          hist_method=self.hist_method, axis_name=None)
         return self._sharded(bins, gpair, n_real_bins, tree_mask, key)
@@ -187,15 +275,17 @@ class TreeGrower:
             P = jax.sharding.PartitionSpec
 
             def inner(b, g, nr, tm, k):
-                return _grow(b, g, nr, tm, k, param=self.param,
-                             max_nbins=self.max_nbins,
+                return _grow(b, g, nr, tm, k, self.monotone,
+                             self.constraint_sets, self.cat,
+                             param=self.param, max_nbins=self.max_nbins,
                              hist_method=self.hist_method,
                              axis_name=DATA_AXIS)
 
             out_specs = GrownTree(
                 split_feature=P(), split_bin=P(), default_left=P(),
                 is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
-                gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS))
+                gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS),
+                is_cat_split=P(), cat_words=P())
             self._sharded_fn = jax.jit(jax.shard_map(
                 inner, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P(),
@@ -223,4 +313,6 @@ class TreeGrower:
             leaf_value=np.array(g.leaf_value),
             sum_hess=np.array(g.node_sum[:, 1]),
             gain=np.array(g.gain),
+            is_cat_split=np.array(g.is_cat_split),
+            cat_words=np.array(g.cat_words),
         )

@@ -86,6 +86,49 @@ def calc_gain(g: jnp.ndarray, h: jnp.ndarray, p: TrainParam) -> jnp.ndarray:
     return jnp.where(h <= 0.0, 0.0, gain)
 
 
+def parse_interaction_constraints(spec: Any, n_features: int,
+                                  feature_names: Optional[list] = None):
+    """'[[0,1],[2,3]]' or list of lists (indices or names) -> bool [S, F] with
+    singleton sets appended for unmentioned features (so a lone feature can
+    still start a path but nothing else may join it)."""
+    import json as _json
+
+    import numpy as np
+
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip()
+        if not s:
+            return None
+        sets = _json.loads(s.replace("'", '"'))
+    else:
+        sets = list(spec)
+    if not sets:
+        return None
+
+    def to_idx(x):
+        if isinstance(x, str) and feature_names:
+            return feature_names.index(x)
+        return int(x)
+
+    rows = []
+    mentioned = set()
+    for group in sets:
+        row = np.zeros(n_features, dtype=bool)
+        for x in group:
+            i = to_idx(x)
+            row[i] = True
+            mentioned.add(i)
+        rows.append(row)
+    for f in range(n_features):
+        if f not in mentioned:
+            row = np.zeros(n_features, dtype=bool)
+            row[f] = True
+            rows.append(row)
+    return np.stack(rows)
+
+
 def parse_monotone_constraints(spec: Any, n_features: int) -> Optional[list]:
     """'(1,-1,0,...)' or list -> per-feature ints; None when unconstrained."""
     if spec is None:

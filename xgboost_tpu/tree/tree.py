@@ -28,6 +28,14 @@ class TreeModel:
     leaf_value: np.ndarray      # [max_nodes] f32 (learning rate already applied)
     sum_hess: np.ndarray        # [max_nodes] f32 cover
     gain: np.ndarray            # [max_nodes] f32 split loss_chg (0 at leaves)
+    is_cat_split: np.ndarray = None  # [max_nodes] bool
+    cat_words: np.ndarray = None     # [max_nodes, W] uint32 left-set bitmask
+
+    def __post_init__(self):
+        if self.is_cat_split is None:
+            self.is_cat_split = np.zeros(len(self.is_leaf), bool)
+        if self.cat_words is None:
+            self.cat_words = np.zeros((len(self.is_leaf), 1), np.uint32)
 
     @property
     def max_nodes(self) -> int:
@@ -87,7 +95,17 @@ class TreeModel:
                 right[c] = ids[2 * h + 2]
                 parent[ids[2 * h + 1]] = c
                 parent[ids[2 * h + 2]] = c
+        cats = {}
+        for c in range(n):
+            h = inv[c]
+            if self.is_cat_split[h]:
+                w = self.cat_words[h]
+                members = [int(b) for b in range(len(w) * 32)
+                           if (w[b // 32] >> (b % 32)) & 1]
+                cats[str(c)] = members
         return {
+            "split_type": [int(self.is_cat_split[inv[c]]) for c in range(n)],
+            "categories": cats,
             "left_children": left.tolist(),
             "right_children": right.tolist(),
             "parents": parent.tolist(),
@@ -116,6 +134,13 @@ class TreeModel:
         hesses = obj.get("sum_hessian", [0.0] * n)
         sbins = obj.get("split_bins", [0] * n)
 
+        split_type = obj.get("split_type", [0] * n)
+        categories = obj.get("categories", {})
+        if categories:
+            max_cat = max((max(v) for v in categories.values() if v),
+                          default=0)
+            t = TreeModel.empty(max_nodes, max_cat // 32 + 1)
+
         def fill(c: int, h: int) -> None:
             t.active[h] = True
             t.sum_hess[h] = hesses[c]
@@ -129,6 +154,10 @@ class TreeModel:
                 t.split_bin[h] = sbins[c]
                 t.default_left[h] = bool(dlefts[c])
                 t.gain[h] = gains[c]
+                if split_type and c < len(split_type) and split_type[c]:
+                    t.is_cat_split[h] = True
+                    for b in categories.get(str(c), []):
+                        t.cat_words[h, b // 32] |= np.uint32(1 << (b % 32))
                 fill(int(left[c]), 2 * h + 1)
                 fill(int(right[c]), 2 * h + 2)
 
@@ -137,7 +166,7 @@ class TreeModel:
         return t
 
     @staticmethod
-    def empty(max_nodes: int) -> "TreeModel":
+    def empty(max_nodes: int, n_words: int = 1) -> "TreeModel":
         return TreeModel(
             split_feature=np.full(max_nodes, -1, np.int32),
             split_bin=np.zeros(max_nodes, np.int32),
@@ -148,17 +177,24 @@ class TreeModel:
             leaf_value=np.zeros(max_nodes, np.float32),
             sum_hess=np.zeros(max_nodes, np.float32),
             gain=np.zeros(max_nodes, np.float32),
+            is_cat_split=np.zeros(max_nodes, bool),
+            cat_words=np.zeros((max_nodes, n_words), np.uint32),
         )
 
-    def resize(self, max_nodes: int) -> "TreeModel":
+    def resize(self, max_nodes: int, n_words: int = None) -> "TreeModel":
         """Pad heap arrays to a larger capacity (for stacking into a forest)."""
-        if max_nodes == self.max_nodes:
+        if n_words is None:
+            n_words = self.cat_words.shape[1]
+        if max_nodes == self.max_nodes and n_words == self.cat_words.shape[1]:
             return self
-        out = TreeModel.empty(max_nodes)
+        out = TreeModel.empty(max_nodes, n_words)
         k = min(max_nodes, self.max_nodes)
         for name in ("split_feature", "split_bin", "split_value", "default_left",
-                     "is_leaf", "active", "leaf_value", "sum_hess", "gain"):
+                     "is_leaf", "active", "leaf_value", "sum_hess", "gain",
+                     "is_cat_split"):
             getattr(out, name)[:k] = getattr(self, name)[:k]
+        w = min(n_words, self.cat_words.shape[1])
+        out.cat_words[:k, :w] = self.cat_words[:k, :w]
         return out
 
 
@@ -178,8 +214,9 @@ def stack_forest(trees: List[TreeModel]) -> Optional[Dict[str, np.ndarray]]:
     if not trees:
         return None
     cap = max(t.max_nodes for t in trees)
-    trees = [t.resize(cap) for t in trees]
-    return {
+    n_words = max(t.cat_words.shape[1] for t in trees)
+    trees = [t.resize(cap, n_words) for t in trees]
+    out = {
         "split_feature": np.stack([t.split_feature for t in trees]),
         "split_value": np.stack([t.split_value for t in trees]),
         "split_bin": np.stack([t.split_bin for t in trees]),
@@ -187,3 +224,7 @@ def stack_forest(trees: List[TreeModel]) -> Optional[Dict[str, np.ndarray]]:
         "is_leaf": np.stack([t.is_leaf for t in trees]),
         "leaf_value": np.stack([t.leaf_value for t in trees]),
     }
+    if any(t.is_cat_split.any() for t in trees):
+        out["is_cat_split"] = np.stack([t.is_cat_split for t in trees])
+        out["cat_words"] = np.stack([t.cat_words for t in trees])
+    return out
