@@ -1,0 +1,148 @@
+"""sklearn wrapper + cv + dump tests (reference tests/python/test_with_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+from conftest import make_classification, make_regression
+
+
+def test_regressor_fit_predict():
+    X, y = make_regression(600, 8)
+    reg = xgb.XGBRegressor(n_estimators=20, max_depth=4, learning_rate=0.3)
+    reg.fit(X, y)
+    preds = reg.predict(X)
+    assert np.sqrt(np.mean((preds - y) ** 2)) < 1.0
+    imp = reg.feature_importances_
+    assert imp.shape == (8,)
+    assert abs(imp.sum() - 1.0) < 1e-5
+
+
+def test_classifier_binary():
+    X, y = make_classification(600, 6)
+    clf = xgb.XGBClassifier(n_estimators=15, max_depth=3)
+    clf.fit(X, y)
+    assert set(np.unique(clf.predict(X))) <= {0.0, 1.0}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    assert clf.score(X, y) > 0.85
+
+
+def test_classifier_multiclass_auto_objective():
+    X, y = make_classification(600, 6, n_classes=3)
+    clf = xgb.XGBClassifier(n_estimators=15, max_depth=3)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 3)
+    assert clf.score(X, y) > 0.8
+
+
+def test_classifier_string_labels():
+    X, _ = make_classification(300, 5)
+    rng = np.random.RandomState(0)
+    y = np.asarray(["cat", "dog"])[(X[:, 0] > 0).astype(int)]
+    clf = xgb.XGBClassifier(n_estimators=10, max_depth=3)
+    clf.fit(X, y)
+    preds = clf.predict(X)
+    assert set(np.unique(preds)) <= {"cat", "dog"}
+    assert (preds == y).mean() > 0.9
+
+
+def test_early_stopping_via_estimator():
+    X, y = make_regression(1200, 6)
+    rng = np.random.RandomState(2)
+    reg = xgb.XGBRegressor(n_estimators=300, max_depth=4,
+                           early_stopping_rounds=5)
+    reg.fit(X[:800], y[:800],
+            eval_set=[(X[800:], rng.randn(400))], verbose=False)
+    assert reg.get_booster().num_boosted_rounds() < 300
+    assert reg.best_iteration >= 0
+
+
+def test_sklearn_clone_and_grid():
+    from sklearn.base import clone
+
+    reg = xgb.XGBRegressor(n_estimators=5, max_depth=3, custom_kw=1)
+    reg2 = clone(reg)
+    assert reg2.get_params()["max_depth"] == 3
+    assert reg2.get_params()["custom_kw"] == 1
+
+
+def test_sklearn_cross_val_score():
+    from sklearn.model_selection import cross_val_score
+
+    X, y = make_regression(400, 5)
+    scores = cross_val_score(
+        xgb.XGBRegressor(n_estimators=8, max_depth=3), X, y, cv=3,
+        scoring="neg_mean_squared_error")
+    assert len(scores) == 3
+
+
+def test_ranker():
+    rng = np.random.RandomState(3)
+    n_q, docs = 20, 15
+    X = rng.randn(n_q * docs, 5).astype(np.float32)
+    y = np.clip((X[:, 0] * 2 + rng.randn(n_q * docs) * 0.3), 0, None)
+    y = np.digitize(y, [0.5, 1.2, 2.0]).astype(np.float32)
+    qid = np.repeat(np.arange(n_q), docs)
+    rk = xgb.XGBRanker(n_estimators=10, max_depth=3)
+    rk.fit(X, y, qid=qid)
+    scores = rk.predict(X)
+    assert scores.shape == (n_q * docs,)
+
+
+def test_rf_wrappers():
+    X, y = make_regression(500, 6)
+    rf = xgb.XGBRFRegressor(n_estimators=1, num_parallel_tree=20, max_depth=4)
+    rf.fit(X, y)
+    assert len(rf.get_booster().gbm.trees) == 20
+    preds = rf.predict(X)
+    assert np.sqrt(np.mean((preds - y) ** 2)) < 2.0
+
+
+def test_cv_basic():
+    X, y = make_regression(600, 6)
+    dm = xgb.DMatrix(X, label=y)
+    res = xgb.cv({"objective": "reg:squarederror", "max_depth": 3}, dm,
+                 num_boost_round=8, nfold=3, as_pandas=False, seed=5)
+    assert len(res["test-rmse-mean"]) == 8
+    assert res["test-rmse-mean"][-1] < res["test-rmse-mean"][0]
+    assert all(s >= 0 for s in res["test-rmse-std"])
+
+
+def test_cv_stratified_early_stop():
+    X, y = make_classification(600, 5)
+    dm = xgb.DMatrix(X, label=y)
+    res = xgb.cv({"objective": "binary:logistic", "max_depth": 3}, dm,
+                 num_boost_round=50, nfold=3, stratified=True,
+                 metrics=["auc"], early_stopping_rounds=5, as_pandas=False)
+    assert len(res["test-auc-mean"]) <= 50
+
+
+def test_dump_formats():
+    X, y = make_regression(300, 4)
+    dm = xgb.DMatrix(X, label=y, feature_names=["a", "b", "c", "d"])
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 3}, dm, 3,
+                    verbose_eval=False)
+    texts = bst.get_dump()
+    assert len(texts) == 3
+    assert "leaf=" in texts[0]
+    assert any(n in texts[0] for n in "abcd")
+    import json
+    j = json.loads(bst.get_dump(dump_format="json")[0])
+    assert "children" in j or "leaf" in j
+    dot = bst.get_dump(dump_format="dot")[0]
+    assert dot.startswith("digraph")
+    df = bst.trees_to_dataframe()
+    assert (df["Feature"] == "Leaf").any()
+
+
+def test_graphviz_source_string():
+    X, y = make_regression(200, 3)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 2},
+                    xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+    out = xgb.to_graphviz(bst, num_trees=1)
+    assert "digraph" in (out if isinstance(out, str) else out.source)

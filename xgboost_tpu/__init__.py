@@ -7,16 +7,25 @@ row partitioning as static-shape gathers under ``jit``, and the rabit/NCCL
 collective layer replaced by ``jax.lax.psum`` over the ICI/DCN device mesh.
 """
 
+from . import callback
 from .config import config_context, get_config, set_config
 from .context import Context, make_data_mesh
 from .core import Booster, train
 from .data.dmatrix import DataIter, DMatrix, QuantileDMatrix
+from .parallel import collective
+from .plotting import plot_importance, plot_tree, to_graphviz
+from .sklearn import (XGBClassifier, XGBModel, XGBRanker, XGBRegressor,
+                      XGBRFClassifier, XGBRFRegressor)
+from .training import cv
 from .tree.param import TrainParam
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Booster", "train", "DMatrix", "QuantileDMatrix", "DataIter",
-    "TrainParam", "Context", "make_data_mesh",
+    "Booster", "train", "cv", "DMatrix", "QuantileDMatrix", "DataIter",
+    "TrainParam", "Context", "make_data_mesh", "callback", "collective",
+    "XGBModel", "XGBRegressor", "XGBClassifier", "XGBRanker",
+    "XGBRFRegressor", "XGBRFClassifier",
+    "plot_importance", "plot_tree", "to_graphviz",
     "config_context", "set_config", "get_config", "__version__",
 ]

@@ -606,6 +606,44 @@ class Booster:
         self.__init__()
         self.load_model(state["raw"])
 
+    # ------------------------------------------------------------------- dump
+    def get_dump(self, fmap: str = "", with_stats: bool = False,
+                 dump_format: str = "text") -> List[str]:
+        """Per-tree dumps (reference ``XGBoosterDumpModelEx``)."""
+        from .dump import dump_dot, dump_json, dump_text
+
+        self._configure(None)
+        if not isinstance(self.gbm, GBTree):
+            raise NotImplementedError("dump is only supported for tree models")
+        out = []
+        for tree in self.gbm.trees:
+            if dump_format == "json":
+                import json as _json
+
+                out.append(_json.dumps(dump_json(tree, self.feature_names,
+                                                 with_stats)))
+            elif dump_format == "dot":
+                out.append(dump_dot(tree, self.feature_names, with_stats))
+            else:
+                out.append(dump_text(tree, self.feature_names, with_stats))
+        return out
+
+    def dump_model(self, fout: str, fmap: str = "", with_stats: bool = False,
+                   dump_format: str = "text") -> None:
+        dumps = self.get_dump(fmap, with_stats, dump_format)
+        with open(fout, "w") as fh:
+            if dump_format == "json":
+                fh.write("[\n" + ",\n".join(dumps) + "\n]")
+            else:
+                for i, d in enumerate(dumps):
+                    fh.write(f"booster[{i}]:\n{d}")
+
+    def trees_to_dataframe(self, fmap: str = ""):
+        from .dump import trees_to_dataframe
+
+        self._configure(None)
+        return trees_to_dataframe(self.gbm.trees, self.feature_names)
+
     # ----------------------------------------------------------- importances
     def get_score(self, fmap: str = "", importance_type: str = "weight"
                   ) -> Dict[str, float]:

@@ -1,0 +1,167 @@
+"""Model dumping — text / json / dot generators.
+
+Reference: ``TreeGenerator`` registry (``src/tree/tree_model.cc:358`` text,
+``:519`` json, graphviz) behind ``Booster.get_dump`` / ``trees_to_dataframe`` /
+``to_graphviz``. Node ids use the compact BFS numbering so dumps line up with
+the reference's output shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .tree.tree import TreeModel
+
+
+def _fname(feature_names: Optional[List[str]], f: int) -> str:
+    if feature_names and 0 <= f < len(feature_names):
+        return feature_names[f]
+    return f"f{f}"
+
+
+def _node_condition(tree: TreeModel, h: int,
+                    feature_names: Optional[List[str]]) -> str:
+    f = int(tree.split_feature[h])
+    name = _fname(feature_names, f)
+    if tree.is_cat_split[h]:
+        w = tree.cat_words[h]
+        members = [str(b) for b in range(len(w) * 32)
+                   if (w[b // 32] >> (b % 32)) & 1]
+        return f"{name}:{{{','.join(members)}}}"
+    # reference text dump convention: x < cond goes left ("yes")
+    return f"{name}<{float(tree.split_value[h]):.9g}"
+
+
+def dump_text(tree: TreeModel, feature_names: Optional[List[str]] = None,
+              with_stats: bool = False) -> str:
+    ids = tree.compact_ids()
+    lines: List[str] = []
+
+    def walk(h: int, depth: int) -> None:
+        c = ids[h]
+        indent = "\t" * depth
+        if tree.is_leaf[h]:
+            stats = f",cover={tree.sum_hess[h]:.9g}" if with_stats else ""
+            lines.append(f"{indent}{c}:leaf={tree.leaf_value[h]:.9g}{stats}")
+            return
+        cond = _node_condition(tree, h, feature_names)
+        yes, no = ids[2 * h + 1], ids[2 * h + 2]
+        miss = yes if tree.default_left[h] else no
+        stats = (f",gain={tree.gain[h]:.9g},cover={tree.sum_hess[h]:.9g}"
+                 if with_stats else "")
+        lines.append(
+            f"{indent}{c}:[{cond}] yes={yes},no={no},missing={miss}{stats}")
+        walk(2 * h + 1, depth + 1)
+        walk(2 * h + 2, depth + 1)
+
+    if tree.active[0]:
+        walk(0, 0)
+    return "\n".join(lines) + "\n"
+
+
+def dump_json(tree: TreeModel, feature_names: Optional[List[str]] = None,
+              with_stats: bool = False) -> dict:
+    ids = tree.compact_ids()
+
+    def node(h: int, depth: int) -> dict:
+        c = ids[h]
+        if tree.is_leaf[h]:
+            out = {"nodeid": c, "leaf": float(tree.leaf_value[h])}
+            if with_stats:
+                out["cover"] = float(tree.sum_hess[h])
+            return out
+        f = int(tree.split_feature[h])
+        yes, no = ids[2 * h + 1], ids[2 * h + 2]
+        out = {
+            "nodeid": c, "depth": depth,
+            "split": _fname(feature_names, f),
+            "yes": yes, "no": no,
+            "missing": yes if tree.default_left[h] else no,
+            "children": [node(2 * h + 1, depth + 1),
+                         node(2 * h + 2, depth + 1)],
+        }
+        if tree.is_cat_split[h]:
+            w = tree.cat_words[h]
+            out["split_condition"] = [
+                b for b in range(len(w) * 32)
+                if (w[b // 32] >> (b % 32)) & 1]
+        else:
+            out["split_condition"] = float(tree.split_value[h])
+        if with_stats:
+            out["gain"] = float(tree.gain[h])
+            out["cover"] = float(tree.sum_hess[h])
+        return out
+
+    return node(0, 0) if tree.active[0] else {}
+
+
+def dump_dot(tree: TreeModel, feature_names: Optional[List[str]] = None,
+             with_stats: bool = False) -> str:
+    ids = tree.compact_ids()
+    lines = ["digraph {", "    graph [rankdir=TB]"]
+
+    def walk(h: int) -> None:
+        c = ids[h]
+        if tree.is_leaf[h]:
+            lines.append(
+                f'    {c} [label="leaf={tree.leaf_value[h]:.6g}" '
+                f"shape=box]")
+            return
+        cond = _node_condition(tree, h, feature_names)
+        lines.append(f'    {c} [label="{cond}"]')
+        yes, no = ids[2 * h + 1], ids[2 * h + 2]
+        ylab = "yes, missing" if tree.default_left[h] else "yes"
+        nlab = "no" if tree.default_left[h] else "no, missing"
+        lines.append(f'    {c} -> {yes} [label="{ylab}" color="#0000FF"]')
+        lines.append(f'    {c} -> {no} [label="{nlab}" color="#FF0000"]')
+        walk(2 * h + 1)
+        walk(2 * h + 2)
+
+    if tree.active[0]:
+        walk(0)
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def trees_to_dataframe(trees: List[TreeModel],
+                       feature_names: Optional[List[str]] = None):
+    """Booster.trees_to_dataframe (reference core.py) — one row per node."""
+    import pandas as pd
+
+    rows = []
+    for t_i, tree in enumerate(trees):
+        ids = tree.compact_ids()
+        for h, c in ids.items():
+            if tree.is_leaf[h]:
+                rows.append({
+                    "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
+                    "Feature": "Leaf", "Split": np.nan, "Yes": np.nan,
+                    "No": np.nan, "Missing": np.nan,
+                    "Gain": float(tree.leaf_value[h]),
+                    "Cover": float(tree.sum_hess[h]),
+                    "Category": np.nan,
+                })
+            else:
+                yes, no = ids[2 * h + 1], ids[2 * h + 2]
+                cat = np.nan
+                split = float(tree.split_value[h])
+                if tree.is_cat_split[h]:
+                    w = tree.cat_words[h]
+                    cat = [b for b in range(len(w) * 32)
+                           if (w[b // 32] >> (b % 32)) & 1]
+                    split = np.nan
+                rows.append({
+                    "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
+                    "Feature": _fname(feature_names,
+                                      int(tree.split_feature[h])),
+                    "Split": split, "Yes": f"{t_i}-{yes}",
+                    "No": f"{t_i}-{no}",
+                    "Missing": (f"{t_i}-{yes}" if tree.default_left[h]
+                                else f"{t_i}-{no}"),
+                    "Gain": float(tree.gain[h]),
+                    "Cover": float(tree.sum_hess[h]),
+                    "Category": cat,
+                })
+    return pd.DataFrame(rows)
