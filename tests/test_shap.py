@@ -1,0 +1,136 @@
+"""SHAP contributions: exact vs brute-force Shapley, invariants, interactions.
+
+Mirrors the reference's contribution tests (tests/python/test_shap.py
+equivalents): the sum-to-margin property and agreement with the definition
+computed by subset enumeration over the path-dependent expectation.
+"""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.boosting import shap as shap_mod
+
+
+def _fit(n=150, F=4, depth=3, rounds=4, seed=7, objective="reg:squarederror"):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 0]
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    if objective == "binary:logistic":
+        y = (y > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": objective, "max_depth": depth, "eta": 0.5},
+                    dm, rounds, verbose_eval=False)
+    return bst, dm, X, y
+
+
+def _expectation(tree, x, S):
+    """Path-dependent conditional expectation v(S) for one tree."""
+    def rec(nid):
+        if tree.is_leaf[nid]:
+            return float(tree.leaf_value[nid])
+        f = int(tree.split_feature[nid])
+        li, ri = 2 * nid + 1, 2 * nid + 2
+        if f in S:
+            if np.isnan(x[f]):
+                return rec(li if tree.default_left[nid] else ri)
+            return rec(li if not (x[f] > tree.split_value[nid]) else ri)
+        hl, hr = float(tree.sum_hess[li]), float(tree.sum_hess[ri])
+        tot = hl + hr
+        if tot <= 0:
+            return 0.0
+        return (hl * rec(li) + hr * rec(ri)) / tot
+    return rec(0)
+
+
+def _brute_shap(trees, x, F):
+    from itertools import combinations
+    from math import factorial
+
+    phi = np.zeros(F + 1)
+    for tree in trees:
+        for i in range(F):
+            others = [j for j in range(F) if j != i]
+            for k in range(F):
+                for S in combinations(others, k):
+                    w = factorial(len(S)) * factorial(F - len(S) - 1) \
+                        / factorial(F)
+                    phi[i] += w * (_expectation(tree, x, set(S) | {i})
+                                   - _expectation(tree, x, set(S)))
+        phi[F] += _expectation(tree, x, set())
+    return phi
+
+
+def test_shap_matches_brute_force():
+    bst, dm, X, y = _fit()
+    contribs = bst.predict(dm, pred_contribs=True)
+    trees, info, _ = bst.gbm.forest_slice(None)
+    for r in (0, 3, 17):
+        expect = _brute_shap(trees, X[r], X.shape[1])
+        expect[-1] += bst.base_margin_[0]
+        np.testing.assert_allclose(contribs[r], expect, rtol=2e-4, atol=2e-4)
+
+
+def test_shap_sums_to_margin():
+    bst, dm, X, y = _fit(objective="binary:logistic")
+    margin = bst.predict(dm, output_margin=True)
+    contribs = bst.predict(dm, pred_contribs=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_native_matches_python():
+    bst, dm, X, y = _fit(n=40, rounds=2)
+    trees, info, _ = bst.gbm.forest_slice(None)
+    base = np.asarray([bst.base_margin_[0]], np.float32)
+    native = shap_mod.tree_shap(X[:10], trees, info, 1, base)
+    arr, T, M, W = shap_mod._forest_arrays(trees)
+    out = np.zeros((10, 1, X.shape[1] + 1), np.float64)
+    py = shap_mod._tree_shap_py(
+        np.ascontiguousarray(X[:10], np.float32), arr, T, M, W,
+        np.ones(T, np.float32), np.asarray(info, np.int32), 1, base, 0, 0,
+        out)
+    np.testing.assert_allclose(native, py, rtol=1e-5, atol=1e-6)
+
+
+def test_approx_contribs_sum():
+    bst, dm, X, y = _fit()
+    margin = bst.predict(dm, output_margin=True)
+    contribs = bst.predict(dm, pred_contribs=True, approx_contribs=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_interactions_row_sums():
+    bst, dm, X, y = _fit(n=60, rounds=2)
+    contribs = bst.predict(dm, pred_contribs=True)
+    inter = bst.predict(dm, pred_interactions=True)
+    n, Fp1 = contribs.shape
+    assert inter.shape == (n, Fp1, Fp1)
+    np.testing.assert_allclose(inter.sum(axis=2), contribs, rtol=1e-3,
+                               atol=1e-3)
+    np.testing.assert_allclose(inter.sum(axis=(1, 2)),
+                               bst.predict(dm, output_margin=True),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_multiclass_contribs_shape():
+    rng = np.random.RandomState(0)
+    X = rng.randn(80, 5).astype(np.float32)
+    y = rng.randint(0, 3, 80).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "multi:softprob", "num_class": 3,
+                     "max_depth": 3}, dm, 3, verbose_eval=False)
+    contribs = bst.predict(dm, pred_contribs=True)
+    assert contribs.shape == (80, 3, 6)
+    margin = bst.predict(dm, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(axis=2), margin, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_pred_leaf_shape():
+    bst, dm, X, y = _fit()
+    leaves = bst.predict(dm, pred_leaf=True)
+    assert leaves.shape[0] == X.shape[0]
+    assert leaves.shape[1] == bst.num_boosted_rounds()

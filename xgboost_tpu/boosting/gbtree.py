@@ -143,15 +143,25 @@ class GBTree:
                                self.n_groups,
                                tree_weights=None if w is None else w[lo:hi])
 
-    def predict_margin(self, X, base, iteration_range=None):
-        """-> (margin [n, K], leaf heap positions [n, T] or None, trees)."""
+    def _tree_range(self, iteration_range=None):
+        """iteration_range -> (tree_lo, tree_hi) indices."""
         if iteration_range is not None and iteration_range != (0, 0):
             b, e = iteration_range
             e = min(e if e else self.num_boosted_rounds(),
                     self.num_boosted_rounds())
-            lo, hi = self.iteration_indptr[b], self.iteration_indptr[e]
-        else:
-            lo, hi = 0, len(self.trees)
+            return self.iteration_indptr[b], self.iteration_indptr[e]
+        return 0, len(self.trees)
+
+    def forest_slice(self, iteration_range=None):
+        """-> (trees, tree_info, tree_weights) for contribution APIs."""
+        lo, hi = self._tree_range(iteration_range)
+        w = self.tree_weights()
+        return (self.trees[lo:hi], np.asarray(self.tree_info[lo:hi]),
+                None if w is None else w[lo:hi])
+
+    def predict_margin(self, X, base, iteration_range=None):
+        """-> (margin [n, K], leaf heap positions [n, T] or None, trees)."""
+        lo, hi = self._tree_range(iteration_range)
         pred = self._predictor(lo, hi)
         n = X.shape[0]
         if pred is None:

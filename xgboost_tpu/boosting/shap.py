@@ -1,0 +1,335 @@
+"""Feature contributions: exact TreeSHAP, approximate (Saabas), interactions.
+
+Reference surface being matched: ``Predictor::PredictContribution`` /
+``PredictInteractionContributions`` (``include/xgboost/predictor.h``, CPU impl
+``src/predictor/cpu_predictor.cc:990`` + ``cpu_treeshap.cc``). The exact
+algorithm runs in the native runtime (``native/treeshap.cc``, OpenMP over
+rows) with a pure-Python mirror as fallback; the approximate path is a
+vectorised cover-weighted walk.
+
+Output convention (matches the reference): last column is the bias —
+expected model output plus base score; SHAP columns sum to the margin.
+Interactions: phi_ij = (phi_i | j present) - (phi_i | j absent) / 2 computed
+by conditioning, diagonal set so each row/column sums to phi_i.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..tree.tree import TreeModel, stack_forest
+from ..native import load as load_native
+
+
+def _forest_arrays(trees: Sequence[TreeModel]):
+    forest = stack_forest(list(trees))
+    T, M = forest["split_feature"].shape
+    W = forest["cat_words"].shape[-1] if "cat_words" in forest else 1
+    arr = {
+        "split_feature": np.ascontiguousarray(
+            forest["split_feature"], np.int32),
+        "split_value": np.ascontiguousarray(forest["split_value"], np.float32),
+        "default_left": np.ascontiguousarray(
+            forest["default_left"], np.uint8),
+        "is_leaf": np.ascontiguousarray(forest["is_leaf"], np.uint8),
+        "leaf_value": np.ascontiguousarray(forest["leaf_value"], np.float32),
+        "sum_hess": np.ascontiguousarray(forest["sum_hess"], np.float32),
+        "is_cat_split": np.ascontiguousarray(
+            forest.get("is_cat_split",
+                       np.zeros((T, M), bool)), np.uint8),
+        "cat_words": np.ascontiguousarray(
+            forest.get("cat_words", np.zeros((T, M, 1), np.uint32)),
+            np.uint32),
+    }
+    return arr, T, M, W
+
+
+def _ptr(a: np.ndarray, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def _prepare(trees, tree_info, base_score, tree_weights):
+    arr, T, M, W = _forest_arrays(trees)
+    tw = np.ascontiguousarray(
+        np.ones(T, np.float32) if tree_weights is None else tree_weights,
+        np.float32)
+    tg = np.ascontiguousarray(tree_info, np.int32)
+    bs = np.ascontiguousarray(base_score, np.float32)
+    return arr, T, M, W, tw, tg, bs
+
+
+def tree_shap(X: np.ndarray, trees: Sequence[TreeModel],
+              tree_info: np.ndarray, n_groups: int, base_score: np.ndarray,
+              tree_weights: Optional[np.ndarray] = None, condition: int = 0,
+              condition_feature: int = 0, _prepared=None) -> np.ndarray:
+    """-> [n, n_groups, n_features + 1] float64 contributions.
+
+    ``_prepared`` lets callers that issue many conditional evaluations
+    (interactions) reuse the stacked forest arrays instead of re-stacking
+    the forest per call."""
+    X = np.ascontiguousarray(X, np.float32)
+    n, F = X.shape
+    out = np.zeros((n, n_groups, F + 1), np.float64)
+    if not trees:
+        if condition == 0:
+            out[:, :, F] = np.asarray(base_score, np.float64)[None, :]
+        return out
+    if _prepared is None:
+        _prepared = _prepare(trees, tree_info, base_score, tree_weights)
+    arr, T, M, W, tw, tg, bs = _prepared
+
+    lib = load_native()
+    if lib is not None:
+        fn = lib.tpugbt_treeshap
+        fn.restype = None
+        fn(_ptr(X, ctypes.c_float), ctypes.c_int64(n), ctypes.c_int(F),
+           _ptr(arr["split_feature"], ctypes.c_int32),
+           _ptr(arr["split_value"], ctypes.c_float),
+           _ptr(arr["default_left"], ctypes.c_uint8),
+           _ptr(arr["is_leaf"], ctypes.c_uint8),
+           _ptr(arr["leaf_value"], ctypes.c_float),
+           _ptr(arr["sum_hess"], ctypes.c_float),
+           _ptr(tw, ctypes.c_float), _ptr(tg, ctypes.c_int32),
+           ctypes.c_int(T), ctypes.c_int(M),
+           _ptr(arr["is_cat_split"], ctypes.c_uint8),
+           _ptr(arr["cat_words"], ctypes.c_uint32), ctypes.c_int(W),
+           ctypes.c_int(n_groups), _ptr(bs, ctypes.c_float),
+           ctypes.c_int(condition), ctypes.c_int(condition_feature),
+           _ptr(out, ctypes.c_double))
+        return out
+    return _tree_shap_py(X, arr, T, M, W, tw, tg, n_groups, bs, condition,
+                         condition_feature, out)
+
+
+# ---------------------------------------------------------------------------
+# pure-Python mirror of native/treeshap.cc (used when no C++ toolchain)
+# ---------------------------------------------------------------------------
+
+def _extend(m: List[list], pz: float, po: float, fi: int) -> None:
+    d = len(m)
+    m.append([fi, pz, po, 1.0 if d == 0 else 0.0])
+    for i in range(d - 1, -1, -1):
+        m[i + 1][3] += po * m[i][3] * (i + 1) / (d + 1)
+        m[i][3] = pz * m[i][3] * (d - i) / (d + 1)
+
+
+def _unwind(m: List[list], idx: int) -> List[list]:
+    d = len(m) - 1
+    one, zero = m[idx][2], m[idx][1]
+    out = [row[:] for row in m]
+    nxt = out[d][3]
+    if one != 0.0:
+        for i in range(d - 1, -1, -1):
+            tmp = out[i][3]
+            out[i][3] = nxt * (d + 1) / ((i + 1) * one)
+            nxt = tmp - out[i][3] * zero * (d - i) / (d + 1)
+    else:
+        for i in range(d - 1, -1, -1):
+            out[i][3] = out[i][3] * (d + 1) / (zero * (d - i))
+    for i in range(idx, d):
+        out[i][0], out[i][1], out[i][2] = out[i + 1][0], out[i + 1][1], \
+            out[i + 1][2]
+    return out[:-1]
+
+
+def _unwound_sum(m: List[list], idx: int) -> float:
+    d = len(m) - 1
+    one, zero = m[idx][2], m[idx][1]
+    nxt, total = m[d][3], 0.0
+    if one != 0.0:
+        for i in range(d - 1, -1, -1):
+            t = nxt / ((i + 1) * one)
+            total += t
+            nxt = m[i][3] - t * zero * (d - i)
+    else:
+        for i in range(d - 1, -1, -1):
+            total += m[i][3] / (zero * (d - i))
+    return total * (d + 1)
+
+
+def _tree_shap_py(X, arr, T, M, W, tw, tg, n_groups, bs, condition,
+                  condition_feature, out):
+    n, F = X.shape
+    sf = arr["split_feature"].reshape(T, M)
+    sv = arr["split_value"].reshape(T, M)
+    dl = arr["default_left"].reshape(T, M)
+    lf = arr["is_leaf"].reshape(T, M)
+    lv = arr["leaf_value"].reshape(T, M)
+    sh = arr["sum_hess"].reshape(T, M)
+    ics = arr["is_cat_split"].reshape(T, M)
+    cw = arr["cat_words"].reshape(T, M, W)
+
+    def goes_left(t, nid, x):
+        if np.isnan(x):
+            return bool(dl[t, nid])
+        if ics[t, nid]:
+            code = int(x)
+            if code < 0 or code >= W * 32:
+                return bool(dl[t, nid])
+            return bool((cw[t, nid, code // 32] >> (code % 32)) & 1)
+        return not (x > sv[t, nid])
+
+    def mean_value(t, nid):
+        if lf[t, nid]:
+            return float(lv[t, nid])
+        hl, hr = float(sh[t, 2 * nid + 1]), float(sh[t, 2 * nid + 2])
+        ml, mr = mean_value(t, 2 * nid + 1), mean_value(t, 2 * nid + 2)
+        h = hl + hr
+        return (hl * ml + hr * mr) / h if h > 0 else 0.0
+
+    means = [mean_value(t, 0) for t in range(T)]
+
+    def recurse(t, x, phi, nid, m, cond_frac, scale):
+        if lf[t, nid]:
+            for i in range(1, len(m)):
+                w = _unwound_sum(m, i)
+                phi[m[i][0]] += w * (m[i][2] - m[i][1]) * lv[t, nid] * \
+                    cond_frac * scale
+            return
+        fid = int(sf[t, nid])
+        left, right = 2 * nid + 1, 2 * nid + 2
+        hot, cold = (left, right) if goes_left(t, nid, x[fid]) else \
+            (right, left)
+        cover = float(sh[t, nid])
+        hz = sh[t, hot] / cover if cover > 0 else 0.0
+        cz = sh[t, cold] / cover if cover > 0 else 0.0
+        iz = io = 1.0
+        mm = m
+        for i in range(1, len(m)):
+            if m[i][0] == fid:
+                iz, io = m[i][1], m[i][2]
+                mm = _unwind(m, i)
+                break
+        if condition != 0 and fid == condition_feature:
+            if condition > 0:
+                recurse(t, x, phi, hot, mm, cond_frac, scale)
+            else:
+                recurse(t, x, phi, hot, mm, cond_frac * hz, scale)
+                recurse(t, x, phi, cold, mm, cond_frac * cz, scale)
+            return
+        mh = [row[:] for row in mm]
+        _extend(mh, iz * hz, io, fid)
+        recurse(t, x, phi, hot, mh, cond_frac, scale)
+        mc = [row[:] for row in mm]
+        _extend(mc, iz * cz, 0.0, fid)
+        recurse(t, x, phi, cold, mc, cond_frac, scale)
+
+    for r in range(n):
+        x = X[r]
+        for t in range(T):
+            phi = out[r, tg[t]]
+            m: List[list] = []
+            _extend(m, 1.0, 1.0, -1)
+            recurse(t, x, phi, 0, m, 1.0, float(tw[t]))
+            if condition == 0:
+                out[r, tg[t], F] += means[t] * tw[t]
+        if condition == 0:
+            out[r, :, F] += bs
+    return out
+
+
+def approx_contribs(X: np.ndarray, trees: Sequence[TreeModel],
+                    tree_info: np.ndarray, n_groups: int,
+                    base_score: np.ndarray,
+                    tree_weights: Optional[np.ndarray] = None) -> np.ndarray:
+    """Saabas-style contributions (reference ``approximate=True`` path,
+    ``src/predictor/cpu_predictor.cc`` ApproximateFeatureContributions):
+    walk each row's path; credit value change to the split feature."""
+    X = np.ascontiguousarray(X, np.float32)
+    n, F = X.shape
+    out = np.zeros((n, n_groups, F + 1), np.float64)
+    out[:, :, F] = np.asarray(base_score, np.float64)[None, :]
+    if not trees:
+        return out
+    arr, T, M, W = _forest_arrays(trees)
+    sf = arr["split_feature"].reshape(T, M)
+    sv = arr["split_value"].reshape(T, M)
+    dl = arr["default_left"].reshape(T, M).astype(bool)
+    lf = arr["is_leaf"].reshape(T, M).astype(bool)
+    lv = arr["leaf_value"].reshape(T, M)
+    sh = arr["sum_hess"].reshape(T, M)
+    ics = arr["is_cat_split"].reshape(T, M).astype(bool)
+    cw = arr["cat_words"].reshape(T, M, W)
+    tw = np.ones(T, np.float32) if tree_weights is None else tree_weights
+    tg = np.asarray(tree_info, np.int32)
+
+    # per-node cover-weighted mean values, vectorised bottom-up over the heap
+    mean = np.where(lf, lv, 0.0).astype(np.float64)
+    max_depth = int(np.log2(M + 1)) - 1
+    for depth in range(max_depth - 1, -1, -1):
+        lo, hi = 2 ** depth - 1, 2 ** (depth + 1) - 1
+        for nid in range(lo, hi):
+            li, ri = 2 * nid + 1, 2 * nid + 2
+            hl, hr = sh[:, li].astype(np.float64), sh[:, ri].astype(np.float64)
+            tot = hl + hr
+            internal = ~lf[:, nid]
+            safe = np.where(tot > 0, tot, 1.0)
+            m = (hl * mean[:, li] + hr * mean[:, ri]) / safe
+            mean[:, nid] = np.where(internal, m, mean[:, nid])
+
+    for t in range(T):
+        pos = np.zeros(n, np.int64)
+        out[:, tg[t], F] += mean[t, 0] * tw[t]
+        for _ in range(max_depth):
+            nid = pos
+            act = ~lf[t, nid]  # rows parked at a leaf are done
+            if not act.any():
+                break
+            fid = sf[t, nid]
+            x = X[np.arange(n), np.maximum(fid, 0)]
+            miss = np.isnan(x)
+            go_right = x > sv[t, nid]
+            cat_node = ics[t, nid]
+            if cat_node.any():
+                code = np.where(miss, -1, x).astype(np.int64)
+                in_rng = (code >= 0) & (code < W * 32)
+                cc = np.clip(code, 0, W * 32 - 1)
+                bit = (cw[t, nid, cc // 32] >> (cc % 32).astype(np.uint32)) & 1
+                cat_right = np.where(in_rng, bit == 0, ~dl[t, nid])
+                go_right = np.where(cat_node, cat_right, go_right)
+            go_right = np.where(miss, ~dl[t, nid], go_right)
+            child = 2 * pos + 1 + go_right.astype(np.int64)
+            delta = (mean[t, child] - mean[t, nid]) * tw[t]
+            rows = np.where(act)[0]
+            np.add.at(out, (rows, tg[t], fid[rows]), delta[rows])
+            pos = np.where(act, child, pos)
+        # no-op: leaf values are exactly the accumulated means
+    return out
+
+
+def shap_interactions(X: np.ndarray, trees: Sequence[TreeModel],
+                      tree_info: np.ndarray, n_groups: int,
+                      base_score: np.ndarray,
+                      tree_weights: Optional[np.ndarray] = None) -> np.ndarray:
+    """-> [n, n_groups, F+1, F+1] SHAP interaction values (reference
+    ``PredictInteractionContributions``): off-diagonals from conditional
+    TreeSHAP, diagonal = phi_i minus the off-diagonal row sum; the bias
+    row/column carries the conditioning-free remainder."""
+    X = np.ascontiguousarray(X, np.float32)
+    n, F = X.shape
+    prep = _prepare(trees, tree_info, base_score, tree_weights) if trees \
+        else None
+    contribs = tree_shap(X, trees, tree_info, n_groups, base_score,
+                         tree_weights, _prepared=prep)
+    out = np.zeros((n, n_groups, F + 1, F + 1), np.float64)
+    used = sorted({int(f) for t in trees
+                   for f in np.unique(t.split_feature) if f >= 0})
+    for j in used:
+        on = tree_shap(X, trees, tree_info, n_groups, base_score,
+                       tree_weights, condition=1, condition_feature=j,
+                       _prepared=prep)
+        off = tree_shap(X, trees, tree_info, n_groups, base_score,
+                        tree_weights, condition=-1, condition_feature=j,
+                        _prepared=prep)
+        inter = (on - off) / 2.0
+        inter[:, :, j] = 0.0
+        out[:, :, j, :] = inter
+        out[:, :, j, j] = contribs[:, :, j] - inter.sum(axis=2)
+    # features never used: their phi is 0; diagonal already 0
+    # bias row/column: remainder so that rows sum to contribs
+    out[:, :, F, :F] = contribs[:, :, :F] - out[:, :, :F, :F].sum(axis=2)
+    out[:, :, F, F] = contribs[:, :, F]
+    return out

@@ -375,13 +375,16 @@ class Booster:
 
     def predict(self, data: DMatrix, output_margin: bool = False,
                 pred_leaf: bool = False, pred_contribs: bool = False,
+                approx_contribs: bool = False,
+                pred_interactions: bool = False,
                 iteration_range: Optional[Tuple[int, int]] = None,
                 strict_shape: bool = False, training: bool = False
                 ) -> np.ndarray:
-        if pred_contribs:
-            raise NotImplementedError(
-                "pred_contribs (SHAP) is not implemented yet")
         self._configure(data if data.info.labels is not None else None)
+        if pred_contribs or pred_interactions:
+            return self._predict_contribs(
+                data, approx=approx_contribs, interactions=pred_interactions,
+                iteration_range=iteration_range, strict_shape=strict_shape)
         X = data.X
         base = self.base_margin_ if self.base_margin_ is not None else \
             np.zeros(self.n_groups, np.float32)
@@ -403,6 +406,46 @@ class Booster:
         if not strict_shape and out.ndim == 2 and out.shape[1] == 1:
             out = out[:, 0]
         return out
+
+    def _predict_contribs(self, data: DMatrix, approx: bool,
+                          interactions: bool, iteration_range, strict_shape):
+        """SHAP/Saabas feature contributions (reference
+        ``PredictContribution`` / ``PredictInteractionContributions``)."""
+        from .boosting import shap as shap_mod
+        from .boosting.gblinear import GBLinear
+
+        X = np.asarray(data.X, np.float32)
+        n, F = X.shape
+        base = (self.base_margin_ if self.base_margin_ is not None
+                else np.zeros(self.n_groups, np.float32))
+        if isinstance(self.gbm, GBLinear):
+            if interactions:
+                raise ValueError(
+                    "pred_interactions is not defined for gblinear")
+            W = np.asarray(self.gbm.W)              # [F, K]
+            b = np.asarray(self.gbm.bias)           # [K]
+            out = np.zeros((n, self.n_groups, F + 1), np.float64)
+            Xz = np.nan_to_num(X)
+            out[:, :, :F] = (Xz[:, None, :] * W.T[None, :, :])
+            out[:, :, F] = b[None, :] + np.asarray(base)[None, :]
+        else:
+            trees, info, weights = self.gbm.forest_slice(iteration_range)
+            if interactions:
+                if approx:
+                    raise NotImplementedError(
+                        "approx_contribs with pred_interactions is not "
+                        "supported; use exact interactions")
+                out = shap_mod.shap_interactions(X, trees, info,
+                                                 self.n_groups, base, weights)
+            elif approx:
+                out = shap_mod.approx_contribs(X, trees, info, self.n_groups,
+                                               base, weights)
+            else:
+                out = shap_mod.tree_shap(X, trees, info, self.n_groups, base,
+                                         weights)
+        if not strict_shape and self.n_groups == 1:
+            out = out[:, 0]
+        return out.astype(np.float32)
 
     def inplace_predict(self, data: Any, iteration_range=None,
                         predict_type: str = "value", missing: float = np.nan,

@@ -1,0 +1,72 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its CPU hot paths in C++ (TreeSHAP in
+``src/predictor/cpu_treeshap.cc``, data parsing in dmlc-core); this module is
+the equivalent runtime layer for the TPU framework: a small shared library
+compiled from ``native/*.cc`` on first use (g++ is part of the toolchain;
+there is no separate wheel build step) and cached next to the sources.
+
+All device compute stays in JAX/Pallas — only host-side, latency-bound,
+pointer-chasing work (SHAP path algebra, text parsing, CLI serving) lives
+here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_NAME = "libxgboost_tpu_native.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_NATIVE_DIR, f)
+        for f in os.listdir(_NATIVE_DIR) if f.endswith(".cc"))
+
+
+def _build(lib_path: str) -> None:
+    # Build to a unique temp path and rename atomically so concurrent
+    # processes never dlopen a half-written library.
+    srcs = _sources()
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+           "-o", tmp] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError:
+        # retry without OpenMP (toolchains without libgomp)
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               "-o", tmp] + srcs
+        subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, lib_path)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native library, building it on first use; None when no
+    C++ toolchain is available (callers fall back to pure-Python paths)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+        try:
+            newest_src = max(os.path.getmtime(s) for s in _sources())
+            if (not os.path.exists(lib_path)
+                    or os.path.getmtime(lib_path) < newest_src):
+                _build(lib_path)
+            _lib = ctypes.CDLL(lib_path)
+        except (OSError, subprocess.CalledProcessError, ValueError):
+            _load_failed = True
+            return None
+    return _lib

@@ -94,9 +94,29 @@ def build_hist_onehot(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarra
 @partial(jax.jit, static_argnames=("n_nodes", "max_nbins", "method", "block_rows"))
 def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                n_nodes: int, max_nbins: int, method: str = "auto",
-               block_rows: int = 1 << 16) -> jnp.ndarray:
+               block_rows: int = 1 << 16,
+               bins_t: jnp.ndarray = None) -> jnp.ndarray:
     if method == "auto":
-        method = "segment" if jax.default_backend() == "cpu" else "onehot"
+        backend = jax.default_backend()
+        # The fused Pallas kernel accumulates [F_blk, max_nbins, 2*n_nodes]
+        # blocks in VMEM; past ~128 nodes per level (depth > 7) fall back to
+        # the XLA formulation rather than shrinking blocks. Non-TPU
+        # accelerators get the XLA onehot path (Pallas specs here are
+        # TPU-only).
+        if backend == "cpu":
+            method = "segment"
+        elif backend == "tpu" and n_nodes <= 128:
+            method = "pallas"
+        else:
+            method = "onehot"
+    if method.startswith("pallas"):
+        from .pallas.histogram import build_hist_pallas
+
+        precision = method.split(":", 1)[1] if ":" in method else "bf16x2"
+        if bins_t is None:
+            bins_t = bins.T
+        return build_hist_pallas(bins_t, gpair, rel_pos, n_nodes, max_nbins,
+                                 precision=precision)
     if method == "segment":
         return build_hist_segment(bins, gpair, rel_pos, n_nodes, max_nbins)
     if method == "onehot":

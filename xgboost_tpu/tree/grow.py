@@ -104,6 +104,8 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     is_cat_split = jnp.zeros((max_nodes,), bool)
     cat_words = jnp.zeros((max_nodes, n_words), jnp.uint32)
 
+    bins_t = bins.T  # loop-invariant; feeds the fused Pallas hist kernel
+
     for depth in range(max_depth):
         lo = 2 ** depth - 1
         n_level = 2 ** depth
@@ -112,7 +114,7 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         in_level = (positions >= lo) & (positions < lo + n_level)
         rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
         hist = build_hist(bins, gpair, rel, n_level, max_nbins,
-                          method=hist_method)
+                          method=hist_method, bins_t=bins_t)
         hist = allreduce(hist)
 
         level_key = jax.random.fold_in(key, depth)

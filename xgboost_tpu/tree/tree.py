@@ -223,6 +223,7 @@ def stack_forest(trees: List[TreeModel]) -> Optional[Dict[str, np.ndarray]]:
         "default_left": np.stack([t.default_left for t in trees]),
         "is_leaf": np.stack([t.is_leaf for t in trees]),
         "leaf_value": np.stack([t.leaf_value for t in trees]),
+        "sum_hess": np.stack([t.sum_hess for t in trees]),
     }
     if any(t.is_cat_split.any() for t in trees):
         out["is_cat_split"] = np.stack([t.is_cat_split for t in trees])
