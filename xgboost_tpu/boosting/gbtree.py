@@ -27,7 +27,8 @@ class GBTree:
 
     def __init__(self, tree_param: TrainParam, n_groups: int,
                  num_parallel_tree: int = 1, hist_method: str = "auto",
-                 mesh=None, monotone=None, constraint_sets=None) -> None:
+                 mesh=None, monotone=None, constraint_sets=None,
+                 tree_method: str = "hist") -> None:
         self.tree_param = tree_param
         self.n_groups = n_groups
         self.num_parallel_tree = num_parallel_tree
@@ -35,10 +36,13 @@ class GBTree:
         self.mesh = mesh
         self.monotone = monotone
         self.constraint_sets = constraint_sets
+        self.tree_method = tree_method
         self.trees: List[TreeModel] = []
         self.tree_info: List[int] = []
         self.iteration_indptr: List[int] = [0]
         self._grower: Optional[TreeGrower] = None
+        self._exact_quant = None
+        self._stat_version = 0  # bumped by process_type=update refreshes
 
     # -- training -------------------------------------------------------------
     def _grower_for(self, binned: BinnedMatrix) -> TreeGrower:
@@ -65,12 +69,37 @@ class GBTree:
         grower's row positions."""
         binned = state["binned"]
         info = state["info"]
-        grower = self._grower_for(binned)
         n, K = gpair.shape[0], gpair.shape[1]
-        n_real = binned.n_real_bins()
         adaptive = obj is not None and hasattr(obj, "update_tree_leaf")
+        exact = self.tree_method == "exact"
+        if exact:
+            if self._exact_quant is None:
+                from ..tree.exact import ExactQuantization
+
+                self._exact_quant = ExactQuantization(
+                    np.asarray(state["dm"].X))
+        elif self.tree_method != "approx":
+            grower = self._grower_for(binned)
+            n_real = binned.n_real_bins()
         deltas = []
         for k in range(K):
+            if self.tree_method == "approx":
+                # GlobalApproxUpdater: re-sketch cuts every iteration with
+                # hessian weights (reference src/tree/updater_approx.cc:55)
+                from ..data.binned import BinnedMatrix
+                from ..data.quantile import sketch_matrix
+
+                w = np.asarray(gpair[:, k, 1], np.float64)
+                if info.weights is not None:
+                    w = w * np.asarray(info.weights, np.float64)
+                cuts = sketch_matrix(np.asarray(state["dm"].X),
+                                     self.tree_param.max_bin, w,
+                                     info.feature_types)
+                binned = BinnedMatrix.from_dense(np.asarray(state["dm"].X),
+                                                 cuts)
+                self._grower = None
+                grower = self._grower_for(binned)
+                n_real = binned.n_real_bins()
             delta_k = jnp.zeros((n,), jnp.float32)
             for p in range(self.num_parallel_tree):
                 tkey = jax.random.fold_in(key, k * self.num_parallel_tree + p)
@@ -80,8 +109,15 @@ class GBTree:
                         jax.random.fold_in(tkey, 0x5AB),
                         self.tree_param.subsample, (n,))
                     gp = gp * mask[:, None].astype(gp.dtype)
-                grown = grower.grow(binned.bins, gp, n_real, tkey)
-                tree = grower.to_tree_model(grown)
+                if exact:
+                    from ..tree.exact import ExactGrower
+
+                    egrower = ExactGrower(self.tree_param, self._exact_quant)
+                    grown = egrower.grow(gp, tkey)
+                    tree = egrower.to_tree_model(grown)
+                else:
+                    grown = grower.grow(binned.bins, gp, n_real, tkey)
+                    tree = grower.to_tree_model(grown)
                 if adaptive:
                     pos = np.asarray(grown.positions)
                     alphas = obj.alphas() if hasattr(obj, "alphas") else [0.5]
@@ -103,7 +139,9 @@ class GBTree:
     supports_margin_cache = True
 
     def version(self) -> int:
-        """Monotone counter identifying the current model contents."""
+        """Monotone counter identifying the current model contents (a tree
+        count — the margin cache slices trees by it, so in-place updates
+        reset caches through the Booster instead of bumping this)."""
         return len(self.trees)
 
     def training_margin(self, state: dict) -> jnp.ndarray:

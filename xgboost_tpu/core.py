@@ -120,9 +120,14 @@ class Booster:
         if self._configured:
             return
         tm = self.learner_params.get("tree_method", "auto")
-        if tm not in ("auto", "hist", "gpu_hist", "tpu_hist"):
+        if tm not in ("auto", "hist", "gpu_hist", "tpu_hist", "approx",
+                      "exact"):
             raise NotImplementedError(
-                f"tree_method={tm} is not implemented yet; use 'hist'")
+                f"tree_method={tm} is not implemented; use hist/approx/exact")
+        if tm == "exact" and self.ctx.mesh is not None:
+            raise ValueError("tree_method=exact does not support "
+                             "distributed training (reference ColMaker "
+                             "limitation)")
         if self.tree_param.grow_policy != "depthwise":
             raise NotImplementedError(
                 f"grow_policy={self.tree_param.grow_policy} is not "
@@ -190,11 +195,13 @@ class Booster:
         ics = parse_interaction_constraints(
             self.tree_param.interaction_constraints or None, nf,
             self.feature_names)
+        tm = self.learner_params.get("tree_method", "auto")
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
             hist_method=self.learner_params.get("hist_method", "auto"),
-            mesh=self.ctx.mesh, monotone=mono, constraint_sets=ics)
+            mesh=self.ctx.mesh, monotone=mono, constraint_sets=ics,
+            tree_method=tm if tm in ("approx", "exact") else "hist")
         if name == "dart":
             gbm = Dart(self.tree_param, n_groups, **kwargs)
             gbm.configure(self.learner_params)
@@ -210,13 +217,20 @@ class Booster:
     # ---------------------------------------------------------------- training
     def _state_of(self, dm: DMatrix, is_train: bool) -> Dict[str, Any]:
         key = id(dm)
+        tm = getattr(self.gbm, "tree_method", "hist")
+        needs_binned = tm not in ("approx", "exact")
         if key in self._caches and is_train and (
                 not self._caches[key]["is_train"]
-                or self._caches[key]["binned"] is None):
+                or (needs_binned and self._caches[key]["binned"] is None)):
             # first seen as eval-only; rebuild as a training entry
             del self._caches[key]
         if key not in self._caches:
-            if is_train:
+            if is_train and tm in ("approx", "exact"):
+                # approx re-sketches per iteration and exact rank-encodes
+                # losslessly — neither trains against a shared binned matrix,
+                # so margins always walk raw thresholds (binned=None).
+                binned = None
+            elif is_train:
                 binned = dm.binned(self.tree_param.max_bin)
                 if self.ctx.mesh is not None:
                     return self._make_sharded_train_state(key, dm, binned)
@@ -314,6 +328,9 @@ class Booster:
                fobj: Optional[Callable] = None) -> None:
         """One boosting iteration (reference ``XGBoosterUpdateOneIter``)."""
         self._configure(dtrain)
+        if self.learner_params.get("process_type") == "update":
+            self._update_existing_trees(dtrain)
+            return
         state = self._state_of(dtrain, is_train=True)
         margin = self.gbm.training_margin(state)
         if fobj is None:
@@ -332,6 +349,49 @@ class Booster:
         else:
             state["margin"] = self.gbm.compute_margin(state)
         state["n_trees"] = self.gbm.version()
+
+    def _update_existing_trees(self, dtrain: DMatrix) -> None:
+        """``process_type=update`` (reference ``src/gbm/gbtree.cc:312-327``):
+        each call re-processes the next iteration's existing trees with the
+        configured updater sequence (refresh / prune / sync) against the
+        current gradients instead of growing new trees."""
+        from .tree.updaters import prune_tree, refresh_tree, sync_trees
+
+        it = getattr(self, "_update_iter", 0)
+        if it >= self.gbm.num_boosted_rounds():
+            raise ValueError(
+                "process_type=update: no more trees to update "
+                f"(model has {self.gbm.num_boosted_rounds()} iterations)")
+        updaters = [u.strip() for u in str(self.learner_params.get(
+            "updater", "refresh")).split(",") if u.strip()]
+        refresh_leaf = bool(int(self.learner_params.get("refresh_leaf", 1)))
+        state = self._state_of(dtrain, is_train=True)
+        margin = self.gbm.compute_margin(state)
+        gpair = np.asarray(self.obj.get_gradient(margin, state["info"], it))
+        lo = self.gbm.iteration_indptr[it]
+        hi = self.gbm.iteration_indptr[it + 1]
+        X = np.asarray(dtrain.X, np.float32)
+        for t_idx in range(lo, hi):
+            tree = self.gbm.trees[t_idx]
+            k = self.gbm.tree_info[t_idx]
+            for up in updaters:
+                if up == "refresh":
+                    tree = refresh_tree(tree, X, gpair[:, k, :],
+                                        self.tree_param,
+                                        refresh_leaf=refresh_leaf)
+                elif up == "prune":
+                    tree = prune_tree(tree, self.tree_param)
+                elif up == "sync":
+                    tree = sync_trees([tree])[0]
+                else:
+                    raise ValueError(f"unknown updater '{up}' for "
+                                     "process_type=update")
+            self.gbm.trees[t_idx] = tree
+        self._update_iter = it + 1
+        # leaf values changed in place -> every cached margin is stale
+        for st in self._caches.values():
+            st["margin"] = st["base"]
+            st["n_trees"] = 0
 
     def boost(self, dtrain: DMatrix, grad: np.ndarray, hess: np.ndarray) -> None:
         """Boost with externally computed gradients (reference Booster.boost)."""

@@ -1,0 +1,211 @@
+"""Exact greedy tree growing (reference ``ColMaker`` / ``tree_method=exact``,
+``src/tree/updater_colmaker.cc:604``).
+
+The reference walks pre-sorted CSC columns per node; the TPU formulation keeps
+the depth-wise heap loop of grow.py but quantizes each feature LOSSLESSLY —
+every distinct value is its own "bin" (rank in the feature's sorted unique
+values) — and evaluates all candidate thresholds of one feature at a time with
+a segment-sum + cumulative scan. Splitting between two distinct values uses
+their midpoint, matching ColMaker's ``(fvalue + last_fvalue) / 2`` rule.
+
+Like the reference's exact updater this path is single-device (no row-split
+distributed mode) and rejects categorical features; it exists for parity and
+for small-data users who want exact thresholds rather than hist's quantile
+cuts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.partition import update_positions
+from .param import TrainParam, calc_gain, calc_weight
+from .grow import GrownTree
+
+_EPS = 1e-6
+
+
+class ExactQuantization:
+    """Lossless per-feature rank encoding built on host once per DMatrix."""
+
+    def __init__(self, X: np.ndarray) -> None:
+        n, F = X.shape
+        self.uniques = []          # per-feature sorted distinct values
+        ranks = np.zeros((n, F), np.int32)
+        max_distinct = 1
+        for f in range(F):
+            col = np.asarray(X[:, f], np.float32)
+            mask = np.isfinite(col)
+            vals = np.unique(col[mask])
+            self.uniques.append(vals)
+            max_distinct = max(max_distinct, len(vals))
+            r = np.searchsorted(vals, col[mask]).astype(np.int32)
+            ranks[mask, f] = r
+            ranks[~mask, f] = -1
+        self.n_ranks = max_distinct
+        # missing -> rank n_ranks (the trailing missing slot)
+        ranks[ranks < 0] = self.n_ranks
+        self.ranks = jnp.asarray(ranks)
+        # midpoints[f, r] = threshold when splitting after rank r
+        mids = np.full((F, max_distinct), np.inf, np.float32)
+        for f, vals in enumerate(self.uniques):
+            if len(vals) > 1:
+                mids[f, : len(vals) - 1] = (vals[:-1] + vals[1:]) / 2.0
+            if len(vals) >= 1:
+                # splitting after the last distinct value separates nothing;
+                # leave +inf so it is never selected as a valid split
+                pass
+        self.midpoints = jnp.asarray(mids)
+        self.n_distinct = jnp.asarray(
+            np.asarray([len(v) for v in self.uniques], np.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("param", "n_ranks"))
+def _grow_exact(ranks: jnp.ndarray, gpair: jnp.ndarray,
+                n_distinct: jnp.ndarray, midpoints: jnp.ndarray,
+                key: jax.Array, *, param: TrainParam,
+                n_ranks: int) -> GrownTree:
+    n, F = ranks.shape
+    max_depth = param.max_depth
+    max_nodes = 2 ** (max_depth + 1) - 1
+    missing_rank = n_ranks  # ranks carry missing as n_ranks
+
+    split_feature = jnp.full((max_nodes,), -1, jnp.int32)
+    split_bin = jnp.zeros((max_nodes,), jnp.int32)
+    default_left = jnp.zeros((max_nodes,), bool)
+    is_leaf = jnp.ones((max_nodes,), bool)
+    active = jnp.zeros((max_nodes,), bool).at[0].set(True)
+    gain = jnp.zeros((max_nodes,), jnp.float32)
+    node_sum = jnp.zeros((max_nodes, 2), jnp.float32)
+    node_sum = node_sum.at[0].set(jnp.sum(gpair, axis=0))
+    positions = jnp.zeros((n,), jnp.int32)
+
+    for depth in range(max_depth):
+        lo = 2 ** depth - 1
+        n_level = 2 ** depth
+        idx = lo + jnp.arange(n_level)
+
+        in_level = (positions >= lo) & (positions < lo + n_level)
+        rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
+        parent_sum = node_sum[lo:lo + n_level]
+        pgain = calc_gain(parent_sum[:, 0], parent_sum[:, 1], param)
+
+        # one feature at a time (ColMaker's column loop) to bound memory:
+        # hist[rel, rank] via segment_sum, then prefix scans for all
+        # thresholds of the feature at once.
+        def feature_best(_, f):
+            r = ranks[:, f].astype(jnp.int32)            # [n]
+            seg = rel * (n_ranks + 1) + jnp.minimum(r, n_ranks)
+            hist = jax.ops.segment_sum(
+                gpair, seg, num_segments=(n_level + 1) * (n_ranks + 1))
+            hist = hist[: n_level * (n_ranks + 1)].reshape(
+                n_level, n_ranks + 1, 2)
+            miss = hist[:, n_ranks, :]                   # [N, 2]
+            present = hist[:, :n_ranks, :]
+            cum = jnp.cumsum(present, axis=1)            # left sums
+            # dir 0: missing right; dir 1: missing left
+            left = jnp.stack([cum, cum + miss[:, None, :]], axis=2)
+            right = parent_sum[:, None, None, :] - left
+            lg, lh = left[..., 0], left[..., 1]
+            rg, rh = right[..., 0], right[..., 1]
+            loss = (calc_gain(lg, lh, param) + calc_gain(rg, rh, param)
+                    - pgain[:, None, None])
+            rr = jnp.arange(n_ranks, dtype=jnp.int32)
+            valid = ((rr[None, :, None] < n_distinct[f] - 1)
+                     & (lh >= param.min_child_weight)
+                     & (rh >= param.min_child_weight))
+            loss = jnp.where(valid, loss, -jnp.inf)
+            flat = loss.reshape(n_level, -1)
+            best = jnp.argmax(flat, axis=1)
+            bg = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            b_rank = (best // 2).astype(jnp.int32)
+            b_dir = (best % 2).astype(jnp.int32)
+            nn = jnp.arange(n_level)
+            bl = left[nn, b_rank, b_dir]
+            return None, (bg, b_rank, b_dir, bl)
+
+        _, (gains_f, rank_f, dir_f, left_f) = jax.lax.scan(
+            feature_best, None, jnp.arange(F))
+        # gains_f: [F, N] -> best feature per node
+        best_f = jnp.argmax(gains_f, axis=0).astype(jnp.int32)   # [N]
+        nn = jnp.arange(n_level)
+        bgain = gains_f[best_f, nn]
+        brank = rank_f[best_f, nn]
+        bdir = dir_f[best_f, nn]
+        bleft = left_f[best_f, nn]
+
+        can_split = (active[lo:lo + n_level]
+                     & (bgain > max(param.gamma, _EPS))
+                     & jnp.isfinite(bgain))
+
+        split_feature = split_feature.at[idx].set(
+            jnp.where(can_split, best_f, -1))
+        split_bin = split_bin.at[idx].set(jnp.where(can_split, brank, 0))
+        default_left = default_left.at[idx].set(can_split & bdir.astype(bool))
+        is_leaf = is_leaf.at[idx].set(~can_split)
+        gain = gain.at[idx].set(jnp.where(can_split, bgain, 0.0))
+
+        li, ri = 2 * idx + 1, 2 * idx + 2
+        active = active.at[li].set(can_split).at[ri].set(can_split)
+        zero2 = jnp.zeros_like(bleft)
+        bright = parent_sum - bleft
+        node_sum = node_sum.at[li].set(
+            jnp.where(can_split[:, None], bleft, zero2))
+        node_sum = node_sum.at[ri].set(
+            jnp.where(can_split[:, None], bright, zero2))
+
+        is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(can_split)
+        positions = update_positions(ranks, positions, split_feature,
+                                     split_bin, default_left, is_split_full,
+                                     missing_rank)
+
+    w = calc_weight(node_sum[:, 0], node_sum[:, 1], param) * param.eta
+    leaf_value = jnp.where(active & is_leaf, w, 0.0).astype(jnp.float32)
+    base_weight = jnp.where(active, w, 0.0).astype(jnp.float32)
+    delta = leaf_value[positions]
+    n_words = 1
+    return GrownTree(split_feature=split_feature, split_bin=split_bin,
+                     default_left=default_left, is_leaf=is_leaf,
+                     active=active, leaf_value=leaf_value, node_sum=node_sum,
+                     gain=gain, positions=positions, delta=delta,
+                     is_cat_split=jnp.zeros((max_nodes,), bool),
+                     cat_words=jnp.zeros((max_nodes, n_words), jnp.uint32),
+                     base_weight=base_weight)
+
+
+class ExactGrower:
+    """Drop-in grower for ``tree_method=exact`` (numerical features only)."""
+
+    def __init__(self, param: TrainParam, quant: ExactQuantization) -> None:
+        self.param = param
+        self.quant = quant
+
+    def grow(self, gpair: jnp.ndarray, key: jax.Array) -> GrownTree:
+        return _grow_exact(self.quant.ranks, gpair, self.quant.n_distinct,
+                           self.quant.midpoints, key, param=self.param,
+                           n_ranks=self.quant.n_ranks)
+
+    def to_tree_model(self, g: GrownTree):
+        from .tree import TreeModel
+
+        sf = np.asarray(g.split_feature)
+        sb = np.asarray(g.split_bin)
+        mids = np.asarray(self.quant.midpoints)
+        split_value = np.zeros(sf.shape, np.float32)
+        mask = sf >= 0
+        split_value[mask] = mids[sf[mask], sb[mask]]
+        return TreeModel(
+            split_feature=sf.copy(), split_bin=sb.copy(),
+            split_value=split_value,
+            default_left=np.asarray(g.default_left),
+            is_leaf=np.asarray(g.is_leaf), active=np.asarray(g.active),
+            leaf_value=np.asarray(g.leaf_value),
+            sum_hess=np.asarray(g.node_sum[:, 1]),
+            gain=np.asarray(g.gain),
+            base_weight=np.asarray(g.base_weight),
+        )

@@ -49,6 +49,7 @@ class GrownTree(NamedTuple):
     delta: jnp.ndarray          # [n_rows] f32 leaf value per row (margin update)
     is_cat_split: jnp.ndarray   # [max_nodes] bool
     cat_words: jnp.ndarray      # [max_nodes, W] uint32 — categories going LEFT
+    base_weight: Optional[jnp.ndarray] = None  # [max_nodes] f32 node weight*eta
 
 
 def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
@@ -216,12 +217,14 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         w = jnp.clip(w, node_lower, node_upper)
     w = w * param.eta
     leaf_value = jnp.where(active & is_leaf, w, 0.0).astype(jnp.float32)
+    base_weight = jnp.where(active, w, 0.0).astype(jnp.float32)
     delta = leaf_value[positions]
     return GrownTree(split_feature=split_feature, split_bin=split_bin,
                      default_left=default_left, is_leaf=is_leaf, active=active,
                      leaf_value=leaf_value, node_sum=node_sum, gain=gain,
                      positions=positions, delta=delta,
-                     is_cat_split=is_cat_split, cat_words=cat_words)
+                     is_cat_split=is_cat_split, cat_words=cat_words,
+                     base_weight=base_weight)
 
 
 class TreeGrower:
@@ -287,7 +290,7 @@ class TreeGrower:
                 split_feature=P(), split_bin=P(), default_left=P(),
                 is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
                 gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS),
-                is_cat_split=P(), cat_words=P())
+                is_cat_split=P(), cat_words=P(), base_weight=P())
             self._sharded_fn = jax.jit(jax.shard_map(
                 inner, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P(),
@@ -317,4 +320,6 @@ class TreeGrower:
             gain=np.array(g.gain),
             is_cat_split=np.array(g.is_cat_split),
             cat_words=np.array(g.cat_words),
+            base_weight=None if g.base_weight is None
+            else np.array(g.base_weight),
         )

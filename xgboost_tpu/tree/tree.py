@@ -30,12 +30,17 @@ class TreeModel:
     gain: np.ndarray            # [max_nodes] f32 split loss_chg (0 at leaves)
     is_cat_split: np.ndarray = None  # [max_nodes] bool
     cat_words: np.ndarray = None     # [max_nodes, W] uint32 left-set bitmask
+    base_weight: np.ndarray = None   # [max_nodes] f32 optimal node weight*eta
+    # (reference RTreeNodeStat::base_weight — kept for pruning/refresh)
 
     def __post_init__(self):
         if self.is_cat_split is None:
             self.is_cat_split = np.zeros(len(self.is_leaf), bool)
         if self.cat_words is None:
             self.cat_words = np.zeros((len(self.is_leaf), 1), np.uint32)
+        if self.base_weight is None:
+            self.base_weight = np.where(self.is_leaf, self.leaf_value,
+                                        0.0).astype(np.float32)
 
     @property
     def max_nodes(self) -> int:
@@ -116,6 +121,8 @@ class TreeModel:
             "loss_changes": gain.tolist(),
             "sum_hessian": hess.tolist(),
             "split_bins": [int(self.split_bin[inv[c]]) for c in range(n)],
+            "base_weights": [float(self.base_weight[inv[c]])
+                             for c in range(n)],
             "heap_depth": self.max_depth,
         }
 
@@ -133,6 +140,7 @@ class TreeModel:
         gains = obj.get("loss_changes", [0.0] * n)
         hesses = obj.get("sum_hessian", [0.0] * n)
         sbins = obj.get("split_bins", [0] * n)
+        bweights = obj.get("base_weights", [0.0] * n)
 
         split_type = obj.get("split_type", [0] * n)
         categories = obj.get("categories", {})
@@ -144,6 +152,7 @@ class TreeModel:
         def fill(c: int, h: int) -> None:
             t.active[h] = True
             t.sum_hess[h] = hesses[c]
+            t.base_weight[h] = bweights[c] if c < len(bweights) else 0.0
             if left[c] < 0:
                 t.is_leaf[h] = True
                 t.leaf_value[h] = conds[c]
@@ -191,7 +200,7 @@ class TreeModel:
         k = min(max_nodes, self.max_nodes)
         for name in ("split_feature", "split_bin", "split_value", "default_left",
                      "is_leaf", "active", "leaf_value", "sum_hess", "gain",
-                     "is_cat_split"):
+                     "is_cat_split", "base_weight"):
             getattr(out, name)[:k] = getattr(self, name)[:k]
         w = min(n_words, self.cat_words.shape[1])
         out.cat_words[:k, :w] = self.cat_words[:k, :w]

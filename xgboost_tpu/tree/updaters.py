@@ -1,0 +1,106 @@
+"""Secondary tree updaters: prune, refresh, sync (reference
+``src/tree/updater_prune.cc:91``, ``updater_refresh.cc:143``,
+``updater_sync.cc:54``) and the ``process_type=update`` pipeline
+(``src/gbm/gbtree.cc:312-327``).
+
+These operate on finished ``TreeModel``s (host-side heap arrays); refresh
+re-derives node statistics from data with one vectorised device pass per tree.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .param import TrainParam
+from .tree import TreeModel
+
+
+def prune_tree(tree: TreeModel, param: TrainParam) -> TreeModel:
+    """Recursively turn split nodes with ``gain < min_split_loss`` (and only
+    leaf children) into leaves — the reference's ``TreePruner::DoPrune``."""
+    t = tree
+    changed = True
+    while changed:
+        changed = False
+        # deepest-first so cascades propagate upward in one sweep
+        for nid in range(t.max_nodes - 1, -1, -1):
+            if not t.active[nid] or t.is_leaf[nid]:
+                continue
+            li, ri = 2 * nid + 1, 2 * nid + 2
+            if li >= t.max_nodes or (t.is_leaf[li] and t.is_leaf[ri]):
+                if t.gain[nid] < param.gamma:
+                    t.is_leaf[nid] = True
+                    t.split_feature[nid] = -1
+                    t.gain[nid] = 0.0
+                    t.leaf_value[nid] = t.base_weight[nid]
+                    if li < t.max_nodes:
+                        t.active[li] = False
+                        t.active[ri] = False
+                        t.leaf_value[li] = 0.0
+                        t.leaf_value[ri] = 0.0
+                    changed = True
+    return t
+
+
+def refresh_tree(tree: TreeModel, X: np.ndarray, gpair: np.ndarray,
+                 param: TrainParam, refresh_leaf: bool = True) -> TreeModel:
+    """Recompute node stats (cover) and optionally leaf values of an existing
+    tree on new data — the reference's ``TreeRefresher``. Routes rows by raw
+    thresholds so it works for loaded models whose bin ids refer to cuts
+    that no longer exist."""
+    n = X.shape[0]
+    pos = np.zeros(n, np.int64)
+    W = tree.cat_words.shape[1]
+    for _ in range(tree.max_depth):
+        splitting = tree.active[pos] & ~tree.is_leaf[pos]
+        if not splitting.any():
+            break
+        fid = np.maximum(tree.split_feature[pos], 0)
+        x = X[np.arange(n), fid]
+        miss = np.isnan(x)
+        go_right = x > tree.split_value[pos]
+        if tree.is_cat_split.any():
+            cat_node = tree.is_cat_split[pos]
+            code = np.where(miss, -1, x).astype(np.int64)
+            in_rng = (code >= 0) & (code < W * 32)
+            cc = np.clip(code, 0, W * 32 - 1)
+            bit = (tree.cat_words[pos, cc // 32]
+                   >> (cc % 32).astype(np.uint32)) & 1
+            cat_right = np.where(in_rng, bit == 0, ~tree.default_left[pos])
+            go_right = np.where(cat_node, cat_right, go_right)
+        go_right = np.where(miss, ~tree.default_left[pos], go_right)
+        pos = np.where(splitting, 2 * pos + 1 + go_right.astype(np.int64),
+                       pos)
+    g = np.zeros(tree.max_nodes, np.float64)
+    h = np.zeros(tree.max_nodes, np.float64)
+    np.add.at(g, pos, gpair[:, 0])
+    np.add.at(h, pos, gpair[:, 1])
+    # push sums up the heap (leaf stats -> internal covers)
+    for nid in range(tree.max_nodes - 1, 0, -1):
+        parent = (nid - 1) // 2
+        g[parent] += g[nid]
+        h[parent] += h[nid]
+    tree.sum_hess = h.astype(np.float32)
+    w_all = (-g / (h + param.reg_lambda) * param.eta).astype(np.float32)
+    tree.base_weight = np.where(tree.active, w_all, 0.0).astype(np.float32)
+    if refresh_leaf:
+        leaves = tree.active & tree.is_leaf
+        tree.leaf_value[leaves] = w_all[leaves]
+    return tree
+
+
+def sync_trees(trees: List[TreeModel], communicator=None) -> List[TreeModel]:
+    """Broadcast trees from rank 0 (reference ``TreeSyncher``). Under the
+    single-controller JAX model all hosts hold identical trees by
+    construction; with a multi-controller communicator the serialized model
+    is broadcast explicitly."""
+    if communicator is None or not communicator.is_distributed():
+        return trees
+    import json
+
+    payload = json.dumps([t.to_json() for t in trees]) \
+        if communicator.get_rank() == 0 else None
+    payload = communicator.broadcast_obj(payload, root=0)
+    return [TreeModel.from_json(o) for o in json.loads(payload)]
