@@ -328,8 +328,8 @@ class Booster:
                fobj: Optional[Callable] = None) -> None:
         """One boosting iteration (reference ``XGBoosterUpdateOneIter``)."""
         self._configure(dtrain)
-        if self.learner_params.get("process_type") == "update":
-            self._update_existing_trees(dtrain)
+        if self.tree_param.process_type == "update":
+            self._update_existing_trees(dtrain, fobj=fobj)
             return
         state = self._state_of(dtrain, is_train=True)
         margin = self.gbm.training_margin(state)
@@ -350,33 +350,65 @@ class Booster:
             state["margin"] = self.gbm.compute_margin(state)
         state["n_trees"] = self.gbm.version()
 
-    def _update_existing_trees(self, dtrain: DMatrix) -> None:
-        """``process_type=update`` (reference ``src/gbm/gbtree.cc:312-327``):
-        each call re-processes the next iteration's existing trees with the
-        configured updater sequence (refresh / prune / sync) against the
-        current gradients instead of growing new trees."""
+    def _update_existing_trees(self, dtrain: DMatrix,
+                               fobj: Optional[Callable] = None) -> None:
+        """``process_type=update`` (reference ``src/gbm/gbtree.cc:115,312-327``):
+        on the first boost the model's trees move into a ``trees_to_update``
+        queue and the committed model restarts empty; each call pops the next
+        iteration's trees, re-processes them with the configured updater
+        sequence (refresh / prune / sync) against gradients of the *partial*
+        committed margin, and commits them back."""
         from .tree.updaters import prune_tree, refresh_tree, sync_trees
 
-        it = getattr(self, "_update_iter", 0)
-        if it >= self.gbm.num_boosted_rounds():
+        if not hasattr(self, "_trees_to_update"):
+            self._trees_to_update = (
+                list(self.gbm.trees), list(self.gbm.tree_info),
+                list(self.gbm.iteration_indptr))
+            self.gbm.trees = []
+            self.gbm.tree_info = []
+            self.gbm.iteration_indptr = [0]
+            for st in self._caches.values():
+                st["margin"] = st["base"]
+                st["n_trees"] = 0
+        old_trees, old_info, old_indptr = self._trees_to_update
+        it = self.gbm.num_boosted_rounds()
+        if it >= len(old_indptr) - 1:
             raise ValueError(
                 "process_type=update: no more trees to update "
-                f"(model has {self.gbm.num_boosted_rounds()} iterations)")
+                f"(model has {len(old_indptr) - 1} iterations)")
         updaters = [u.strip() for u in str(self.learner_params.get(
             "updater", "refresh")).split(",") if u.strip()]
-        refresh_leaf = bool(int(self.learner_params.get("refresh_leaf", 1)))
+        refresh_leaf = bool(self.tree_param.refresh_leaf)
         state = self._state_of(dtrain, is_train=True)
-        margin = self.gbm.compute_margin(state)
-        gpair = np.asarray(self.obj.get_gradient(margin, state["info"], it))
-        lo = self.gbm.iteration_indptr[it]
-        hi = self.gbm.iteration_indptr[it + 1]
+        total = self.gbm.version()
+        if state["n_trees"] == total and self.gbm.supports_margin_cache:
+            margin = state["margin"]
+        elif (self.gbm.supports_margin_cache and state["binned"] is not None
+              and state["n_trees"] < total):
+            margin = state["margin"] + self.gbm.margin_delta_binned(
+                state["binned"], state["n_trees"], total)
+        else:
+            margin = self.gbm.compute_margin(state)
+        state["margin"] = margin
+        state["n_trees"] = total
+        if fobj is None:
+            gpair = np.asarray(self.obj.get_gradient(
+                margin, state["info"], it))
+        else:
+            grad, hess = fobj(np.asarray(margin).squeeze(), dtrain)
+            gpair = np.stack(
+                [np.asarray(grad, np.float32).reshape(margin.shape),
+                 np.asarray(hess, np.float32).reshape(margin.shape)], axis=-1)
+        if gpair.ndim == 2:
+            gpair = gpair[:, None, :]
+        n = dtrain.num_row()
         X = np.asarray(dtrain.X, np.float32)
-        for t_idx in range(lo, hi):
-            tree = self.gbm.trees[t_idx]
-            k = self.gbm.tree_info[t_idx]
+        for t_idx in range(old_indptr[it], old_indptr[it + 1]):
+            tree = old_trees[t_idx]
+            k = old_info[t_idx]
             for up in updaters:
                 if up == "refresh":
-                    tree = refresh_tree(tree, X, gpair[:, k, :],
+                    tree = refresh_tree(tree, X, gpair[:n, k, :],
                                         self.tree_param,
                                         refresh_leaf=refresh_leaf)
                 elif up == "prune":
@@ -386,12 +418,11 @@ class Booster:
                 else:
                     raise ValueError(f"unknown updater '{up}' for "
                                      "process_type=update")
-            self.gbm.trees[t_idx] = tree
-        self._update_iter = it + 1
-        # leaf values changed in place -> every cached margin is stale
-        for st in self._caches.values():
-            st["margin"] = st["base"]
-            st["n_trees"] = 0
+            self.gbm.trees.append(tree)
+            self.gbm.tree_info.append(k)
+        self.gbm.iteration_indptr.append(len(self.gbm.trees))
+        # committed trees are immutable once appended; the incremental margin
+        # cache walks only the newly committed trees on the next predict
 
     def boost(self, dtrain: DMatrix, grad: np.ndarray, hess: np.ndarray) -> None:
         """Boost with externally computed gradients (reference Booster.boost)."""
@@ -670,6 +701,10 @@ class Booster:
         }
 
     def _model_from_json(self, obj: dict) -> None:
+        # a freshly loaded model invalidates any pending update queue
+        # (reference re-queues trees_to_update on LoadModel, gbtree.cc:364)
+        if hasattr(self, "_trees_to_update"):
+            del self._trees_to_update
         learner = obj["learner"]
         cfg = obj.get("config", {})
         self.tree_param = TrainParam.from_dict(cfg.get("tree_param", {}))
