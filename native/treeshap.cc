@@ -1,10 +1,10 @@
-// Exact path-dependent TreeSHAP over heap-layout forests.
+// Exact path-dependent TreeSHAP over compact struct-of-arrays forests.
 //
 // Native-runtime counterpart of the reference's CPU TreeSHAP
 // (src/predictor/cpu_treeshap.cc) re-designed for this framework's tree
-// representation: every tree is a fixed-capacity binary heap (node i ->
-// children 2i+1 / 2i+2) stored as flat arrays, exactly as produced by the
-// jitted grower. Exposed through a minimal C ABI consumed via ctypes.
+// representation: every tree is a flat node array in BFS order with explicit
+// left_child / right_child links (-1 at leaves), exactly as produced by
+// TreeModel / stack_forest. Exposed through a minimal C ABI via ctypes.
 //
 // Algorithm: Lundberg & Lee's polynomial-time TreeSHAP (Algorithm 2 of the
 // "Consistent Individualized Feature Attribution for Tree Ensembles" paper):
@@ -16,6 +16,7 @@
 //
 // Build: g++ -O3 -march=native -fopenmp -shared -fPIC treeshap.cc -o ...
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -31,6 +32,8 @@ struct PathEl {
 };
 
 struct Forest {
+  const int32_t* left_child;
+  const int32_t* right_child;
   const int32_t* split_feature;
   const float* split_value;
   const uint8_t* default_left;
@@ -132,7 +135,7 @@ void tree_shap(const Forest& f, int64_t tree_off, const float* x, double* phi,
     return;
   }
 
-  const int left = 2 * nid + 1, right = 2 * nid + 2;
+  const int left = f.left_child[g], right = f.right_child[g];
   const int fid = f.split_feature[g];
   const bool lft = goes_left(f, tree_off, nid, x[fid]);
   const int hot = lft ? left : right;
@@ -197,14 +200,36 @@ double node_mean(const Forest& f, int64_t tree_off, int nid,
   if (f.is_leaf[g]) {
     (*mean)[nid] = f.leaf_value[g];
   } else {
-    const double ml = node_mean(f, tree_off, 2 * nid + 1, mean);
-    const double mr = node_mean(f, tree_off, 2 * nid + 2, mean);
-    const double hl = f.sum_hess[tree_off + 2 * nid + 1];
-    const double hr = f.sum_hess[tree_off + 2 * nid + 2];
+    const int li = f.left_child[g], ri = f.right_child[g];
+    const double ml = node_mean(f, tree_off, li, mean);
+    const double mr = node_mean(f, tree_off, ri, mean);
+    const double hl = f.sum_hess[tree_off + li];
+    const double hr = f.sum_hess[tree_off + ri];
     const double h = hl + hr;
     (*mean)[nid] = h > 0 ? (hl * ml + hr * mr) / h : 0.0;
   }
   return (*mean)[nid];
+}
+
+// deepest root->leaf path across the forest (children have larger ids than
+// their parent within a tree, so one forward pass per tree suffices)
+int forest_depth(const Forest& f, int n_trees) {
+  int max_d = 0;
+  std::vector<int> depth(f.max_nodes);
+  for (int t = 0; t < n_trees; ++t) {
+    const int64_t off = static_cast<int64_t>(t) * f.max_nodes;
+    std::fill(depth.begin(), depth.end(), 0);
+    for (int nid = 0; nid < f.max_nodes; ++nid) {
+      const int64_t g = off + nid;
+      if (f.is_leaf[g]) {
+        if (depth[nid] > max_d) max_d = depth[nid];
+      } else {
+        depth[f.left_child[g]] = depth[nid] + 1;
+        depth[f.right_child[g]] = depth[nid] + 1;
+      }
+    }
+  }
+  return max_d;
 }
 
 }  // namespace
@@ -213,6 +238,7 @@ extern "C" {
 
 // out: [n_rows, n_groups, n_features + 1] (bias last), pre-zeroed by caller.
 void tpugbt_treeshap(const float* X, int64_t n_rows, int n_features,
+                     const int32_t* left_child, const int32_t* right_child,
                      const int32_t* split_feature, const float* split_value,
                      const uint8_t* default_left, const uint8_t* is_leaf,
                      const float* leaf_value, const float* sum_hess,
@@ -221,11 +247,10 @@ void tpugbt_treeshap(const float* X, int64_t n_rows, int n_features,
                      const uint32_t* cat_words, int n_cat_words, int n_groups,
                      const float* base_score, int condition,
                      int condition_feature, double* out) {
-  Forest f{split_feature, split_value,  default_left, is_leaf,
-           leaf_value,    sum_hess,     is_cat_split, cat_words,
-           n_cat_words,   max_nodes};
-  int max_depth = 0;
-  while ((1 << (max_depth + 1)) - 1 < max_nodes) ++max_depth;
+  Forest f{left_child,    right_child,  split_feature, split_value,
+           default_left,  is_leaf,      leaf_value,    sum_hess,
+           is_cat_split,  cat_words,    n_cat_words,   max_nodes};
+  const int max_depth = forest_depth(f, n_trees);
   const int arena_len = (max_depth + 2) * (max_depth + 3) / 2 + 2;
 
   // per-tree expected values (bias column), condition == 0 only
@@ -265,9 +290,10 @@ void tpugbt_treeshap(const float* X, int64_t n_rows, int n_features,
   }
 }
 
-// Plain prediction over the heap forest (used by the CLI and as a
+// Plain prediction over the compact forest (used by the CLI and as a
 // native-speed check): out [n_rows, n_groups] margins.
 void tpugbt_predict(const float* X, int64_t n_rows, int n_features,
+                    const int32_t* left_child, const int32_t* right_child,
                     const int32_t* split_feature, const float* split_value,
                     const uint8_t* default_left, const uint8_t* is_leaf,
                     const float* leaf_value, const float* tree_weight,
@@ -275,9 +301,9 @@ void tpugbt_predict(const float* X, int64_t n_rows, int n_features,
                     const uint8_t* is_cat_split, const uint32_t* cat_words,
                     int n_cat_words, int n_groups, const float* base_score,
                     double* out) {
-  Forest f{split_feature, split_value,  default_left, is_leaf,
-           leaf_value,    nullptr,      is_cat_split, cat_words,
-           n_cat_words,   max_nodes};
+  Forest f{left_child,    right_child,  split_feature, split_value,
+           default_left,  is_leaf,      leaf_value,    nullptr,
+           is_cat_split,  cat_words,    n_cat_words,   max_nodes};
 #pragma omp parallel for schedule(static)
   for (int64_t r = 0; r < n_rows; ++r) {
     const float* x = X + r * n_features;
@@ -288,8 +314,8 @@ void tpugbt_predict(const float* X, int64_t n_rows, int n_features,
       int nid = 0;
       while (!is_leaf[off + nid]) {
         nid = goes_left(f, off, nid, x[split_feature[off + nid]])
-                  ? 2 * nid + 1
-                  : 2 * nid + 2;
+                  ? left_child[off + nid]
+                  : right_child[off + nid];
       }
       row_out[tree_group[t]] += leaf_value[off + nid] * tree_weight[t];
     }

@@ -52,8 +52,7 @@ def test_monotone_unconstrained_differs():
 def _used_features_per_tree(bst):
     out = []
     for tree in bst.gbm.trees:
-        used = set(int(f) for f in tree.split_feature[
-            tree.active & ~tree.is_leaf])
+        used = set(int(f) for f in tree.split_feature[~tree.is_leaf])
         out.append(used)
     return out
 
@@ -75,14 +74,14 @@ def test_interaction_constraints_respected():
     # stronger check: walk each tree's paths
     for tree in bst.gbm.trees:
         def walk(h, path):
-            if not tree.active[h] or tree.is_leaf[h]:
+            if tree.is_leaf[h]:
                 groups = [{0, 1}, {2, 3}]
                 if path:
                     assert any(path <= g for g in groups), path
                 return
             f = int(tree.split_feature[h])
-            walk(2 * h + 1, path | {f})
-            walk(2 * h + 2, path | {f})
+            walk(int(tree.left_child[h]), path | {f})
+            walk(int(tree.right_child[h]), path | {f})
         walk(0, set())
 
 

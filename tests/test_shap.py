@@ -31,7 +31,7 @@ def _expectation(tree, x, S):
         if tree.is_leaf[nid]:
             return float(tree.leaf_value[nid])
         f = int(tree.split_feature[nid])
-        li, ri = 2 * nid + 1, 2 * nid + 2
+        li, ri = int(tree.left_child[nid]), int(tree.right_child[nid])
         if f in S:
             if np.isnan(x[f]):
                 return rec(li if tree.default_left[nid] else ri)

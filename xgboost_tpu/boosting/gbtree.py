@@ -71,6 +71,7 @@ class GBTree:
         info = state["info"]
         n, K = gpair.shape[0], gpair.shape[1]
         adaptive = obj is not None and hasattr(obj, "update_tree_leaf")
+        eta = self.tree_param.eta / max(self.num_parallel_tree, 1)
         exact = self.tree_method == "exact"
         if exact:
             if self._exact_quant is None:
@@ -119,12 +120,13 @@ class GBTree:
                     grown = grower.grow(binned.bins, gp, n_real, tkey)
                     tree = grower.to_tree_model(grown)
                 if adaptive:
-                    pos = np.asarray(grown.positions)
+                    # grower positions are heap ids; translate to the
+                    # committed tree's compact ids first
+                    pos = tree.heap_map[np.asarray(grown.positions)]
                     alphas = obj.alphas() if hasattr(obj, "alphas") else [0.5]
                     obj.update_tree_leaf(
                         tree, pos, np.asarray(margin[:, k]), info,
-                        grower.param.eta, alpha=alphas[min(k,
-                                                           len(alphas) - 1)])
+                        eta, alpha=alphas[min(k, len(alphas) - 1)])
                     delta_k = delta_k + jnp.asarray(
                         tree.leaf_value[pos], dtype=jnp.float32)
                 else:

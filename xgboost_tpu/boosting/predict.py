@@ -3,8 +3,10 @@
 Reference predictors walk trees row-by-row (CPU ``src/predictor/cpu_predictor.cc:299``,
 GPU one-thread-per-row ``src/predictor/gpu_predictor.cu:285-320``). The TPU-native
 predictor is a *level-synchronous* walk: positions for ALL (row, tree) pairs
-advance one depth per step via gathers — no divergence, static shapes, and the
-final per-group reduction is a [rows, trees] x [trees, groups] matmul on the MXU.
+advance one depth per step via child-pointer gathers — no divergence, static
+shapes, and the final per-group reduction is a [rows, trees] x [trees, groups]
+matmul on the MXU. Node ids are the compact BFS ids of ``TreeModel``; rows
+parked at a leaf gather themselves, so ragged tree depths cost nothing extra.
 Categorical nodes route by membership in a packed uint32 left-set bitmask
 (reference ``CategoricalSplitMatrix`` + ``Decision``); unseen / out-of-range
 category codes follow the missing direction.
@@ -35,12 +37,13 @@ def _bit_is_left(code: jnp.ndarray, words_flat: jnp.ndarray,
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_margin(split_feature: jnp.ndarray, split_value: jnp.ndarray,
                     default_left: jnp.ndarray, is_leaf: jnp.ndarray,
+                    left_child: jnp.ndarray, right_child: jnp.ndarray,
                     leaf_value: jnp.ndarray, tree_weight: jnp.ndarray,
                     group_onehot: jnp.ndarray, X: jnp.ndarray,
                     base: jnp.ndarray, max_depth: int,
                     is_cat_split: Optional[jnp.ndarray] = None,
                     cat_words: Optional[jnp.ndarray] = None):
-    """-> (margin [n, G], leaf_pos [n, T] heap ids)."""
+    """-> (margin [n, G], leaf_pos [n, T] compact node ids)."""
     n = X.shape[0]
     T, M = split_feature.shape
     pos = jnp.zeros((n, T), jnp.int32)
@@ -49,6 +52,8 @@ def _predict_margin(split_feature: jnp.ndarray, split_value: jnp.ndarray,
     sv = split_value.reshape(-1)
     dl = default_left.reshape(-1)
     lf = is_leaf.reshape(-1)
+    lc = left_child.reshape(-1)
+    rc = right_child.reshape(-1)
     if cat_words is not None:
         ics = is_cat_split.reshape(-1)
         cw = cat_words.reshape(T * M, -1)
@@ -69,7 +74,8 @@ def _predict_margin(split_feature: jnp.ndarray, split_value: jnp.ndarray,
             go_right = jnp.where(cat_node, ~left, go_right)
             missing = missing | (cat_node & ~in_range)
         go_right = jnp.where(missing, ~dl[gi], go_right)
-        pos = jnp.where(lf[gi], pos, 2 * pos + 1 + go_right.astype(jnp.int32))
+        child = jnp.where(go_right, rc[gi], lc[gi])
+        pos = jnp.where(lf[gi], pos, child)
 
     leaf = leaf_value.reshape(-1)[tofs + pos] * tree_weight[None, :]
     margin = jnp.dot(leaf, group_onehot,
@@ -80,6 +86,7 @@ def _predict_margin(split_feature: jnp.ndarray, split_value: jnp.ndarray,
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
                            default_left: jnp.ndarray, is_leaf: jnp.ndarray,
+                           left_child: jnp.ndarray, right_child: jnp.ndarray,
                            leaf_value: jnp.ndarray, tree_weight: jnp.ndarray,
                            group_onehot: jnp.ndarray, bins: jnp.ndarray,
                            base: jnp.ndarray, max_depth: int,
@@ -97,6 +104,8 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
     sb = split_bin.reshape(-1)
     dl = default_left.reshape(-1)
     lf = is_leaf.reshape(-1)
+    lc = left_child.reshape(-1)
+    rc = right_child.reshape(-1)
     if cat_words is not None:
         ics = is_cat_split.reshape(-1)
         cw = cat_words.reshape(T * M, -1)
@@ -113,7 +122,8 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
             left = _bit_is_left(b, cw, gi, n_words)
             go_right = jnp.where(ics[gi], ~left, go_right)
         go_right = jnp.where(miss, ~dl[gi], go_right)
-        pos = jnp.where(lf[gi], pos, 2 * pos + 1 + go_right.astype(jnp.int32))
+        child = jnp.where(go_right, rc[gi], lc[gi])
+        pos = jnp.where(lf[gi], pos, child)
 
     leaf = leaf_value.reshape(-1)[tofs + pos] * tree_weight[None, :]
     margin = jnp.dot(leaf, group_onehot,
@@ -126,8 +136,9 @@ class ForestPredictor:
 
     def __init__(self, forest: Dict[str, np.ndarray], tree_info: np.ndarray,
                  n_groups: int, tree_weights: Optional[np.ndarray] = None) -> None:
+        forest = dict(forest)
+        self.max_depth = int(forest.pop("depth", 0))
         self.n_trees, self.max_nodes = forest["split_feature"].shape
-        self.max_depth = int(np.log2(self.max_nodes + 1)) - 1
         self.n_groups = n_groups
         self.dev = {k: jnp.asarray(v) for k, v in forest.items()}
         self.has_cat = "cat_words" in forest
@@ -147,6 +158,7 @@ class ForestPredictor:
         m, pos = _predict_margin(
             self.dev["split_feature"], self.dev["split_value"],
             self.dev["default_left"], self.dev["is_leaf"],
+            self.dev["left_child"], self.dev["right_child"],
             self.dev["leaf_value"], self.tree_weight, self.group_onehot,
             jnp.asarray(X, dtype=jnp.float32),
             jnp.asarray(base, dtype=jnp.float32), self.max_depth,
@@ -159,6 +171,7 @@ class ForestPredictor:
         m, pos = _predict_margin_binned(
             self.dev["split_feature"], self.dev["split_bin"],
             self.dev["default_left"], self.dev["is_leaf"],
+            self.dev["left_child"], self.dev["right_child"],
             self.dev["leaf_value"], self.tree_weight, self.group_onehot,
             bins, jnp.asarray(base, dtype=jnp.float32), self.max_depth,
             missing_bin, ics, cw)

@@ -29,6 +29,8 @@ def _forest_arrays(trees: Sequence[TreeModel]):
     T, M = forest["split_feature"].shape
     W = forest["cat_words"].shape[-1] if "cat_words" in forest else 1
     arr = {
+        "left_child": np.ascontiguousarray(forest["left_child"], np.int32),
+        "right_child": np.ascontiguousarray(forest["right_child"], np.int32),
         "split_feature": np.ascontiguousarray(
             forest["split_feature"], np.int32),
         "split_value": np.ascontiguousarray(forest["split_value"], np.float32),
@@ -86,6 +88,8 @@ def tree_shap(X: np.ndarray, trees: Sequence[TreeModel],
         fn = lib.tpugbt_treeshap
         fn.restype = None
         fn(_ptr(X, ctypes.c_float), ctypes.c_int64(n), ctypes.c_int(F),
+           _ptr(arr["left_child"], ctypes.c_int32),
+           _ptr(arr["right_child"], ctypes.c_int32),
            _ptr(arr["split_feature"], ctypes.c_int32),
            _ptr(arr["split_value"], ctypes.c_float),
            _ptr(arr["default_left"], ctypes.c_uint8),
@@ -153,6 +157,8 @@ def _unwound_sum(m: List[list], idx: int) -> float:
 def _tree_shap_py(X, arr, T, M, W, tw, tg, n_groups, bs, condition,
                   condition_feature, out):
     n, F = X.shape
+    lc = arr["left_child"].reshape(T, M)
+    rc = arr["right_child"].reshape(T, M)
     sf = arr["split_feature"].reshape(T, M)
     sv = arr["split_value"].reshape(T, M)
     dl = arr["default_left"].reshape(T, M)
@@ -175,8 +181,9 @@ def _tree_shap_py(X, arr, T, M, W, tw, tg, n_groups, bs, condition,
     def mean_value(t, nid):
         if lf[t, nid]:
             return float(lv[t, nid])
-        hl, hr = float(sh[t, 2 * nid + 1]), float(sh[t, 2 * nid + 2])
-        ml, mr = mean_value(t, 2 * nid + 1), mean_value(t, 2 * nid + 2)
+        li, ri = int(lc[t, nid]), int(rc[t, nid])
+        hl, hr = float(sh[t, li]), float(sh[t, ri])
+        ml, mr = mean_value(t, li), mean_value(t, ri)
         h = hl + hr
         return (hl * ml + hr * mr) / h if h > 0 else 0.0
 
@@ -190,7 +197,7 @@ def _tree_shap_py(X, arr, T, M, W, tw, tg, n_groups, bs, condition,
                     cond_frac * scale
             return
         fid = int(sf[t, nid])
-        left, right = 2 * nid + 1, 2 * nid + 2
+        left, right = int(lc[t, nid]), int(rc[t, nid])
         hot, cold = (left, right) if goes_left(t, nid, x[fid]) else \
             (right, left)
         cover = float(sh[t, nid])
@@ -245,6 +252,8 @@ def approx_contribs(X: np.ndarray, trees: Sequence[TreeModel],
     if not trees:
         return out
     arr, T, M, W = _forest_arrays(trees)
+    lc = arr["left_child"].reshape(T, M).astype(np.int64)
+    rc = arr["right_child"].reshape(T, M).astype(np.int64)
     sf = arr["split_feature"].reshape(T, M)
     sv = arr["split_value"].reshape(T, M)
     dl = arr["default_left"].reshape(T, M).astype(bool)
@@ -256,19 +265,19 @@ def approx_contribs(X: np.ndarray, trees: Sequence[TreeModel],
     tw = np.ones(T, np.float32) if tree_weights is None else tree_weights
     tg = np.asarray(tree_info, np.int32)
 
-    # per-node cover-weighted mean values, vectorised bottom-up over the heap
+    # per-node cover-weighted mean values: children have larger ids than
+    # their parent (BFS invariant), so one reverse sweep per tree suffices
     mean = np.where(lf, lv, 0.0).astype(np.float64)
-    max_depth = int(np.log2(M + 1)) - 1
-    for depth in range(max_depth - 1, -1, -1):
-        lo, hi = 2 ** depth - 1, 2 ** (depth + 1) - 1
-        for nid in range(lo, hi):
-            li, ri = 2 * nid + 1, 2 * nid + 2
-            hl, hr = sh[:, li].astype(np.float64), sh[:, ri].astype(np.float64)
+    for t in range(T):
+        for nid in range(M - 1, -1, -1):
+            if lf[t, nid]:
+                continue
+            li, ri = lc[t, nid], rc[t, nid]
+            hl, hr = float(sh[t, li]), float(sh[t, ri])
             tot = hl + hr
-            internal = ~lf[:, nid]
-            safe = np.where(tot > 0, tot, 1.0)
-            m = (hl * mean[:, li] + hr * mean[:, ri]) / safe
-            mean[:, nid] = np.where(internal, m, mean[:, nid])
+            mean[t, nid] = ((hl * mean[t, li] + hr * mean[t, ri]) / tot
+                            if tot > 0 else 0.0)
+    max_depth = max(t.max_depth() for t in trees)
 
     for t in range(T):
         pos = np.zeros(n, np.int64)
@@ -291,8 +300,8 @@ def approx_contribs(X: np.ndarray, trees: Sequence[TreeModel],
                 cat_right = np.where(in_rng, bit == 0, ~dl[t, nid])
                 go_right = np.where(cat_node, cat_right, go_right)
             go_right = np.where(miss, ~dl[t, nid], go_right)
-            child = 2 * pos + 1 + go_right.astype(np.int64)
-            delta = (mean[t, child] - mean[t, nid]) * tw[t]
+            child = np.where(go_right, rc[t, nid], lc[t, nid])
+            delta = (mean[t, np.maximum(child, 0)] - mean[t, nid]) * tw[t]
             rows = np.where(act)[0]
             np.add.at(out, (rows, tg[t], fid[rows]), delta[rows])
             pos = np.where(act, child, pos)

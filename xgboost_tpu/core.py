@@ -491,7 +491,8 @@ class Booster:
         if pred_leaf:
             if pos is None:
                 return np.zeros((data.num_row(), 0), dtype=np.int32)
-            return self._compact_leaves(np.asarray(pos), trees)
+            # predictor positions are already compact BFS node ids
+            return np.asarray(pos, dtype=np.int32)
         out = margin if output_margin else np.asarray(
             self.obj.pred_transform(jnp.asarray(margin)))
         if not strict_shape and out.ndim == 2 and out.shape[1] == 1:
@@ -549,13 +550,6 @@ class Booster:
         return self.predict(dm, output_margin=(predict_type == "margin"),
                             iteration_range=iteration_range,
                             strict_shape=strict_shape)
-
-    def _compact_leaves(self, pos: np.ndarray, trees) -> np.ndarray:
-        out = np.zeros_like(pos)
-        for t, tree in enumerate(trees[:pos.shape[1]]):
-            ids = tree.compact_ids()
-            out[:, t] = np.vectorize(lambda h: ids.get(int(h), 0))(pos[:, t])
-        return out
 
     # ------------------------------------------------------------------- eval
     def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,
@@ -796,7 +790,7 @@ class Booster:
         scores: Dict[int, float] = {}
         counts: Dict[int, int] = {}
         for tree in self.gbm.trees:
-            mask = tree.active & ~tree.is_leaf
+            mask = ~tree.is_leaf
             for h in np.nonzero(mask)[0]:
                 f = int(tree.split_feature[h])
                 counts[f] = counts.get(f, 0) + 1

@@ -2,8 +2,8 @@
 
 Reference: ``TreeGenerator`` registry (``src/tree/tree_model.cc:358`` text,
 ``:519`` json, graphviz) behind ``Booster.get_dump`` / ``trees_to_dataframe`` /
-``to_graphviz``. Node ids use the compact BFS numbering so dumps line up with
-the reference's output shape.
+``to_graphviz``. Node ids are ``TreeModel``'s compact BFS ids, which line up
+with the reference's node numbering for depth-wise growth.
 """
 
 from __future__ import annotations
@@ -21,106 +21,94 @@ def _fname(feature_names: Optional[List[str]], f: int) -> str:
     return f"f{f}"
 
 
-def _node_condition(tree: TreeModel, h: int,
+def _node_condition(tree: TreeModel, c: int,
                     feature_names: Optional[List[str]]) -> str:
-    f = int(tree.split_feature[h])
+    f = int(tree.split_feature[c])
     name = _fname(feature_names, f)
-    if tree.is_cat_split[h]:
-        w = tree.cat_words[h]
+    if tree.is_cat_split[c]:
+        w = tree.cat_words[c]
         members = [str(b) for b in range(len(w) * 32)
                    if (w[b // 32] >> (b % 32)) & 1]
         return f"{name}:{{{','.join(members)}}}"
     # reference text dump convention: x < cond goes left ("yes")
-    return f"{name}<{float(tree.split_value[h]):.9g}"
+    return f"{name}<{float(tree.split_value[c]):.9g}"
 
 
 def dump_text(tree: TreeModel, feature_names: Optional[List[str]] = None,
               with_stats: bool = False) -> str:
-    ids = tree.compact_ids()
     lines: List[str] = []
-
-    def walk(h: int, depth: int) -> None:
-        c = ids[h]
+    stack = [(0, 0)]
+    while stack:
+        c, depth = stack.pop()
         indent = "\t" * depth
-        if tree.is_leaf[h]:
-            stats = f",cover={tree.sum_hess[h]:.9g}" if with_stats else ""
-            lines.append(f"{indent}{c}:leaf={tree.leaf_value[h]:.9g}{stats}")
-            return
-        cond = _node_condition(tree, h, feature_names)
-        yes, no = ids[2 * h + 1], ids[2 * h + 2]
-        miss = yes if tree.default_left[h] else no
-        stats = (f",gain={tree.gain[h]:.9g},cover={tree.sum_hess[h]:.9g}"
+        if tree.is_leaf[c]:
+            stats = f",cover={tree.sum_hess[c]:.9g}" if with_stats else ""
+            lines.append(f"{indent}{c}:leaf={tree.leaf_value[c]:.9g}{stats}")
+            continue
+        cond = _node_condition(tree, c, feature_names)
+        yes, no = int(tree.left_child[c]), int(tree.right_child[c])
+        miss = yes if tree.default_left[c] else no
+        stats = (f",gain={tree.gain[c]:.9g},cover={tree.sum_hess[c]:.9g}"
                  if with_stats else "")
         lines.append(
             f"{indent}{c}:[{cond}] yes={yes},no={no},missing={miss}{stats}")
-        walk(2 * h + 1, depth + 1)
-        walk(2 * h + 2, depth + 1)
-
-    if tree.active[0]:
-        walk(0, 0)
+        stack.append((no, depth + 1))
+        stack.append((yes, depth + 1))
     return "\n".join(lines) + "\n"
 
 
 def dump_json(tree: TreeModel, feature_names: Optional[List[str]] = None,
               with_stats: bool = False) -> dict:
-    ids = tree.compact_ids()
-
-    def node(h: int, depth: int) -> dict:
-        c = ids[h]
-        if tree.is_leaf[h]:
-            out = {"nodeid": c, "leaf": float(tree.leaf_value[h])}
+    def node(c: int, depth: int) -> dict:
+        if tree.is_leaf[c]:
+            out = {"nodeid": c, "leaf": float(tree.leaf_value[c])}
             if with_stats:
-                out["cover"] = float(tree.sum_hess[h])
+                out["cover"] = float(tree.sum_hess[c])
             return out
-        f = int(tree.split_feature[h])
-        yes, no = ids[2 * h + 1], ids[2 * h + 2]
+        f = int(tree.split_feature[c])
+        yes, no = int(tree.left_child[c]), int(tree.right_child[c])
         out = {
             "nodeid": c, "depth": depth,
             "split": _fname(feature_names, f),
             "yes": yes, "no": no,
-            "missing": yes if tree.default_left[h] else no,
-            "children": [node(2 * h + 1, depth + 1),
-                         node(2 * h + 2, depth + 1)],
+            "missing": yes if tree.default_left[c] else no,
+            "children": [node(yes, depth + 1), node(no, depth + 1)],
         }
-        if tree.is_cat_split[h]:
-            w = tree.cat_words[h]
+        if tree.is_cat_split[c]:
+            w = tree.cat_words[c]
             out["split_condition"] = [
                 b for b in range(len(w) * 32)
                 if (w[b // 32] >> (b % 32)) & 1]
         else:
-            out["split_condition"] = float(tree.split_value[h])
+            out["split_condition"] = float(tree.split_value[c])
         if with_stats:
-            out["gain"] = float(tree.gain[h])
-            out["cover"] = float(tree.sum_hess[h])
+            out["gain"] = float(tree.gain[c])
+            out["cover"] = float(tree.sum_hess[c])
         return out
 
-    return node(0, 0) if tree.active[0] else {}
+    return node(0, 0) if tree.num_nodes() else {}
 
 
 def dump_dot(tree: TreeModel, feature_names: Optional[List[str]] = None,
              with_stats: bool = False) -> str:
-    ids = tree.compact_ids()
     lines = ["digraph {", "    graph [rankdir=TB]"]
-
-    def walk(h: int) -> None:
-        c = ids[h]
-        if tree.is_leaf[h]:
+    stack = [0]
+    while stack:
+        c = stack.pop()
+        if tree.is_leaf[c]:
             lines.append(
-                f'    {c} [label="leaf={tree.leaf_value[h]:.6g}" '
+                f'    {c} [label="leaf={tree.leaf_value[c]:.6g}" '
                 f"shape=box]")
-            return
-        cond = _node_condition(tree, h, feature_names)
+            continue
+        cond = _node_condition(tree, c, feature_names)
         lines.append(f'    {c} [label="{cond}"]')
-        yes, no = ids[2 * h + 1], ids[2 * h + 2]
-        ylab = "yes, missing" if tree.default_left[h] else "yes"
-        nlab = "no" if tree.default_left[h] else "no, missing"
+        yes, no = int(tree.left_child[c]), int(tree.right_child[c])
+        ylab = "yes, missing" if tree.default_left[c] else "yes"
+        nlab = "no" if tree.default_left[c] else "no, missing"
         lines.append(f'    {c} -> {yes} [label="{ylab}" color="#0000FF"]')
         lines.append(f'    {c} -> {no} [label="{nlab}" color="#FF0000"]')
-        walk(2 * h + 1)
-        walk(2 * h + 2)
-
-    if tree.active[0]:
-        walk(0)
+        stack.append(no)
+        stack.append(yes)
     lines.append("}")
     return "\n".join(lines)
 
@@ -132,36 +120,35 @@ def trees_to_dataframe(trees: List[TreeModel],
 
     rows = []
     for t_i, tree in enumerate(trees):
-        ids = tree.compact_ids()
-        for h, c in ids.items():
-            if tree.is_leaf[h]:
+        for c in range(tree.num_nodes()):
+            if tree.is_leaf[c]:
                 rows.append({
                     "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
                     "Feature": "Leaf", "Split": np.nan, "Yes": np.nan,
                     "No": np.nan, "Missing": np.nan,
-                    "Gain": float(tree.leaf_value[h]),
-                    "Cover": float(tree.sum_hess[h]),
+                    "Gain": float(tree.leaf_value[c]),
+                    "Cover": float(tree.sum_hess[c]),
                     "Category": np.nan,
                 })
             else:
-                yes, no = ids[2 * h + 1], ids[2 * h + 2]
+                yes, no = int(tree.left_child[c]), int(tree.right_child[c])
                 cat = np.nan
-                split = float(tree.split_value[h])
-                if tree.is_cat_split[h]:
-                    w = tree.cat_words[h]
+                split = float(tree.split_value[c])
+                if tree.is_cat_split[c]:
+                    w = tree.cat_words[c]
                     cat = [b for b in range(len(w) * 32)
                            if (w[b // 32] >> (b % 32)) & 1]
                     split = np.nan
                 rows.append({
                     "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
                     "Feature": _fname(feature_names,
-                                      int(tree.split_feature[h])),
+                                      int(tree.split_feature[c])),
                     "Split": split, "Yes": f"{t_i}-{yes}",
                     "No": f"{t_i}-{no}",
-                    "Missing": (f"{t_i}-{yes}" if tree.default_left[h]
+                    "Missing": (f"{t_i}-{yes}" if tree.default_left[c]
                                 else f"{t_i}-{no}"),
-                    "Gain": float(tree.gain[h]),
-                    "Cover": float(tree.sum_hess[h]),
+                    "Gain": float(tree.gain[c]),
+                    "Cover": float(tree.sum_hess[c]),
                     "Category": cat,
                 })
     return pd.DataFrame(rows)

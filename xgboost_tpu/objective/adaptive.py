@@ -46,7 +46,7 @@ def _weighted_quantile(values: np.ndarray, weights: Optional[np.ndarray],
 def segment_quantiles(positions: np.ndarray, residuals: np.ndarray,
                       weights: Optional[np.ndarray], leaves: np.ndarray,
                       alpha: float) -> np.ndarray:
-    """Quantile of residuals per leaf (leaves = heap node ids present)."""
+    """Quantile of residuals per leaf (leaves = compact node ids present)."""
     order = np.argsort(positions, kind="stable")
     pos_s = positions[order]
     res_s = residuals[order]
@@ -75,7 +75,7 @@ class _AdaptiveBase(Objective):
         labels = np.asarray(info.labels, dtype=np.float64).reshape(-1)
         n = len(labels)
         residual = labels - np.asarray(margin, dtype=np.float64).reshape(-1)[:n]
-        leaves = np.nonzero(tree.active & tree.is_leaf)[0]
+        leaves = np.nonzero(tree.is_leaf)[0]
         q = segment_quantiles(positions[:n], residual,
                               None if info.weights is None else
                               np.asarray(info.weights, np.float64),

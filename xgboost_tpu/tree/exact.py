@@ -199,9 +199,8 @@ class ExactGrower:
         split_value = np.zeros(sf.shape, np.float32)
         mask = sf >= 0
         split_value[mask] = mids[sf[mask], sb[mask]]
-        return TreeModel(
-            split_feature=sf.copy(), split_bin=sb.copy(),
-            split_value=split_value,
+        return TreeModel.from_heap(
+            split_feature=sf, split_bin=sb, split_value=split_value,
             default_left=np.asarray(g.default_left),
             is_leaf=np.asarray(g.is_leaf), active=np.asarray(g.active),
             leaf_value=np.asarray(g.leaf_value),

@@ -299,7 +299,8 @@ class TreeGrower:
         return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
 
     def to_tree_model(self, g: GrownTree) -> TreeModel:
-        """Pull device arrays to host and attach raw split thresholds."""
+        """Pull device arrays to host, compact the heap, attach raw split
+        thresholds."""
         sf = np.asarray(g.split_feature)
         sb = np.asarray(g.split_bin)
         ptrs = self.cuts.ptrs
@@ -308,18 +309,15 @@ class TreeGrower:
         mask = sf >= 0
         gb = ptrs[np.maximum(sf, 0)] + sb
         split_value[mask] = vals[np.clip(gb[mask], 0, len(vals) - 1)]
-        return TreeModel(
-            split_feature=np.array(sf),
-            split_bin=np.array(sb),
-            split_value=split_value,
-            default_left=np.array(g.default_left),
-            is_leaf=np.array(g.is_leaf),
-            active=np.array(g.active),
-            leaf_value=np.array(g.leaf_value),
-            sum_hess=np.array(g.node_sum[:, 1]),
-            gain=np.array(g.gain),
-            is_cat_split=np.array(g.is_cat_split),
-            cat_words=np.array(g.cat_words),
+        return TreeModel.from_heap(
+            split_feature=sf, split_bin=sb, split_value=split_value,
+            default_left=np.asarray(g.default_left),
+            is_leaf=np.asarray(g.is_leaf), active=np.asarray(g.active),
+            leaf_value=np.asarray(g.leaf_value),
+            sum_hess=np.asarray(g.node_sum[:, 1]),
+            gain=np.asarray(g.gain),
+            is_cat_split=np.asarray(g.is_cat_split),
+            cat_words=np.asarray(g.cat_words),
             base_weight=None if g.base_weight is None
-            else np.array(g.base_weight),
+            else np.asarray(g.base_weight),
         )

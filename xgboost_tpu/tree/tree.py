@@ -1,10 +1,17 @@
 """Tree model container.
 
-The reference's ``RegTree`` (``include/xgboost/tree_model.h:158``) is a pointer-y
-node array; the TPU-native model is a struct-of-arrays in **heap layout** (node i
-has children 2i+1 / 2i+2, root 0) so a whole forest stacks into rectangular
-tensors for batched, gather-only inference. Conversion to the reference's
-compact node numbering happens only at serialization/dump time.
+The reference's ``RegTree`` (``include/xgboost/tree_model.h:158``) stores
+explicit child links per node; the TPU-native model is the same topology as a
+**compact struct-of-arrays** — node ids are BFS order (root 0, every parent id
+smaller than its children), children addressed through ``left_child`` /
+``right_child`` gather arrays. Rectangular stacking for batched inference pads
+trees to the widest node count; unlike a heap layout, capacity grows with the
+node count, not ``2^depth``, so deep loss-guided or externally loaded trees
+stay small.
+
+Device growers (``grow.py`` / ``exact.py``) still build in heap layout — the
+level-synchronous depth-wise loop is naturally a heap — and convert through
+``TreeModel.from_heap`` at commit time.
 """
 
 from __future__ import annotations
@@ -17,113 +24,194 @@ import numpy as np
 
 @dataclass
 class TreeModel:
-    """One regression tree in heap layout (host copy; numpy)."""
+    """One regression tree in compact BFS layout (host copy; numpy).
 
-    split_feature: np.ndarray   # [max_nodes] int32, -1 where leaf / absent
-    split_bin: np.ndarray       # [max_nodes] int32 local bin threshold
-    split_value: np.ndarray     # [max_nodes] f32 raw threshold (x <= v -> left)
-    default_left: np.ndarray    # [max_nodes] bool
-    is_leaf: np.ndarray         # [max_nodes] bool
-    active: np.ndarray          # [max_nodes] bool — node exists in the tree
-    leaf_value: np.ndarray      # [max_nodes] f32 (learning rate already applied)
-    sum_hess: np.ndarray        # [max_nodes] f32 cover
-    gain: np.ndarray            # [max_nodes] f32 split loss_chg (0 at leaves)
-    is_cat_split: np.ndarray = None  # [max_nodes] bool
-    cat_words: np.ndarray = None     # [max_nodes, W] uint32 left-set bitmask
-    base_weight: np.ndarray = None   # [max_nodes] f32 optimal node weight*eta
+    Invariant: node 0 is the root and ``parent[i] < i`` for every non-root
+    node, so a single forward pass visits parents before children and a
+    single reverse pass visits children before parents.
+    """
+
+    left_child: np.ndarray      # [n] int32, -1 at leaves
+    right_child: np.ndarray     # [n] int32, -1 at leaves
+    parent: np.ndarray          # [n] int32, -1 at root
+    split_feature: np.ndarray   # [n] int32, -1 at leaves
+    split_bin: np.ndarray       # [n] int32 local bin threshold
+    split_value: np.ndarray     # [n] f32 raw threshold (x <= v -> left)
+    default_left: np.ndarray    # [n] bool
+    is_leaf: np.ndarray         # [n] bool
+    leaf_value: np.ndarray      # [n] f32 (learning rate already applied)
+    sum_hess: np.ndarray        # [n] f32 cover
+    gain: np.ndarray            # [n] f32 split loss_chg (0 at leaves)
+    is_cat_split: np.ndarray = None  # [n] bool
+    cat_words: np.ndarray = None     # [n, W] uint32 left-set bitmask
+    base_weight: np.ndarray = None   # [n] f32 optimal node weight*eta
     # (reference RTreeNodeStat::base_weight — kept for pruning/refresh)
+    heap_map: np.ndarray = None      # transient [heap_cap] -> compact id
+    # (set by from_heap; lets the adaptive-leaf hook translate grower row
+    #  positions; never serialized)
 
     def __post_init__(self):
+        n = len(self.is_leaf)
         if self.is_cat_split is None:
-            self.is_cat_split = np.zeros(len(self.is_leaf), bool)
+            self.is_cat_split = np.zeros(n, bool)
         if self.cat_words is None:
-            self.cat_words = np.zeros((len(self.is_leaf), 1), np.uint32)
+            self.cat_words = np.zeros((n, 1), np.uint32)
         if self.base_weight is None:
             self.base_weight = np.where(self.is_leaf, self.leaf_value,
                                         0.0).astype(np.float32)
 
-    @property
-    def max_nodes(self) -> int:
+    def num_nodes(self) -> int:
         return len(self.is_leaf)
 
-    @property
-    def max_depth(self) -> int:
-        return int(np.log2(self.max_nodes + 1)) - 1
-
-    def num_nodes(self) -> int:
-        return int(self.active.sum())
-
     def num_leaves(self) -> int:
-        return int((self.active & self.is_leaf).sum())
+        return int(self.is_leaf.sum())
 
-    # --- compact (reference RegTree-style) numbering -------------------------
-    def compact_ids(self) -> Dict[int, int]:
-        """heap id -> BFS compact id over active nodes (root=0), matching the
-        reference's node allocation order for depth-wise growth."""
-        ids: Dict[int, int] = {}
+    def depths(self) -> np.ndarray:
+        """Per-node depth (root 0); one forward pass via the BFS invariant."""
+        d = np.zeros(self.num_nodes(), np.int32)
+        for i in range(1, self.num_nodes()):
+            d[i] = d[self.parent[i]] + 1
+        return d
+
+    def max_depth(self) -> int:
+        return int(self.depths().max(initial=0))
+
+    # --- construction --------------------------------------------------------
+    @staticmethod
+    def from_heap(split_feature, split_bin, split_value, default_left,
+                  is_leaf, active, leaf_value, sum_hess, gain,
+                  is_cat_split=None, cat_words=None,
+                  base_weight=None) -> "TreeModel":
+        """Compact a heap-layout tree (node i children 2i+1/2i+2, ``active``
+        marks nodes that exist). Keeps BFS order, records ``heap_map``."""
+        cap = len(is_leaf)
+        order: List[int] = []
+        heap_map = np.full(cap, -1, np.int32)
         queue = [0]
         while queue:
             h = queue.pop(0)
-            if not self.active[h]:
+            if h >= cap or not active[h]:
                 continue
-            ids[h] = len(ids)
-            if not self.is_leaf[h]:
-                queue.extend((2 * h + 1, 2 * h + 2))
-        return ids
-
-    def to_json(self) -> dict:
-        ids = self.compact_ids()
-        inv = {c: h for h, c in ids.items()}
-        n = len(ids)
-        left = np.full(n, -1, np.int32)
-        right = np.full(n, -1, np.int32)
+            heap_map[h] = len(order)
+            order.append(h)
+            if not is_leaf[h]:
+                queue.append(2 * h + 1)
+                queue.append(2 * h + 2)
+        if not order:            # completely empty tree -> single leaf root
+            order = [0]
+            heap_map[0] = 0
+        o = np.asarray(order, np.int64)
+        n = len(order)
+        internal = ~np.asarray(is_leaf)[o]
+        li = np.minimum(2 * o + 1, cap - 1)
+        ri = np.minimum(2 * o + 2, cap - 1)
+        left = np.where(internal, heap_map[li], -1).astype(np.int32)
+        right = np.where(internal, heap_map[ri], -1).astype(np.int32)
         parent = np.full(n, -1, np.int32)
-        feat = np.zeros(n, np.int32)
-        cond = np.zeros(n, np.float64)
-        dleft = np.zeros(n, bool)
-        leaf = np.zeros(n, bool)
-        value = np.zeros(n, np.float64)
-        hess = np.zeros(n, np.float64)
-        gain = np.zeros(n, np.float64)
-        for c in range(n):
-            h = inv[c]
-            leaf[c] = self.is_leaf[h]
-            hess[c] = self.sum_hess[h]
-            if leaf[c]:
-                value[c] = self.leaf_value[h]
-            else:
-                feat[c] = self.split_feature[h]
-                cond[c] = self.split_value[h]
-                dleft[c] = self.default_left[h]
-                gain[c] = self.gain[h]
-                left[c] = ids[2 * h + 1]
-                right[c] = ids[2 * h + 2]
-                parent[ids[2 * h + 1]] = c
-                parent[ids[2 * h + 2]] = c
+        parent[left[internal]] = np.nonzero(internal)[0]
+        parent[right[internal]] = np.nonzero(internal)[0]
+        t = TreeModel(
+            left_child=left, right_child=right, parent=parent,
+            split_feature=np.where(internal,
+                                   np.asarray(split_feature)[o],
+                                   -1).astype(np.int32),
+            split_bin=np.asarray(split_bin)[o].astype(np.int32),
+            split_value=np.asarray(split_value)[o].astype(np.float32),
+            default_left=np.asarray(default_left)[o].astype(bool),
+            is_leaf=~internal,
+            leaf_value=np.asarray(leaf_value)[o].astype(np.float32),
+            sum_hess=np.asarray(sum_hess)[o].astype(np.float32),
+            gain=np.asarray(gain)[o].astype(np.float32),
+            is_cat_split=None if is_cat_split is None
+            else np.asarray(is_cat_split)[o].astype(bool),
+            cat_words=None if cat_words is None
+            else np.asarray(cat_words)[o].astype(np.uint32),
+            base_weight=None if base_weight is None
+            else np.asarray(base_weight)[o].astype(np.float32),
+        )
+        t.heap_map = heap_map
+        return t
+
+    @staticmethod
+    def single_leaf(value: float = 0.0) -> "TreeModel":
+        return TreeModel(
+            left_child=np.asarray([-1], np.int32),
+            right_child=np.asarray([-1], np.int32),
+            parent=np.asarray([-1], np.int32),
+            split_feature=np.asarray([-1], np.int32),
+            split_bin=np.zeros(1, np.int32),
+            split_value=np.zeros(1, np.float32),
+            default_left=np.zeros(1, bool),
+            is_leaf=np.ones(1, bool),
+            leaf_value=np.asarray([value], np.float32),
+            sum_hess=np.zeros(1, np.float32),
+            gain=np.zeros(1, np.float32))
+
+    def renumbered_bfs(self) -> "TreeModel":
+        """Return an equivalent tree renumbered to BFS order (restores the
+        parent<child invariant after structural edits such as pruning)."""
+        order: List[int] = []
+        remap: Dict[int, int] = {}
+        queue = [0]
+        while queue:
+            c = queue.pop(0)
+            remap[c] = len(order)
+            order.append(c)
+            if not self.is_leaf[c]:
+                queue.append(int(self.left_child[c]))
+                queue.append(int(self.right_child[c]))
+        o = np.asarray(order, np.int64)
+        n = len(order)
+        internal = ~self.is_leaf[o]
+        left = np.where(
+            internal,
+            np.asarray([remap.get(int(x), -1) for x in self.left_child[o]],
+                       np.int32), -1).astype(np.int32)
+        right = np.where(
+            internal,
+            np.asarray([remap.get(int(x), -1) for x in self.right_child[o]],
+                       np.int32), -1).astype(np.int32)
+        parent = np.full(n, -1, np.int32)
+        parent[left[internal]] = np.nonzero(internal)[0]
+        parent[right[internal]] = np.nonzero(internal)[0]
+        return TreeModel(
+            left_child=left, right_child=right, parent=parent,
+            split_feature=np.where(internal, self.split_feature[o],
+                                   -1).astype(np.int32),
+            split_bin=self.split_bin[o].copy(),
+            split_value=self.split_value[o].copy(),
+            default_left=self.default_left[o].copy(),
+            is_leaf=~internal,
+            leaf_value=self.leaf_value[o].copy(),
+            sum_hess=self.sum_hess[o].copy(),
+            gain=self.gain[o].copy(),
+            is_cat_split=self.is_cat_split[o].copy(),
+            cat_words=self.cat_words[o].copy(),
+            base_weight=self.base_weight[o].copy())
+
+    # --- serialization (reference model-JSON node arrays) --------------------
+    def to_json(self) -> dict:
+        n = self.num_nodes()
         cats = {}
-        for c in range(n):
-            h = inv[c]
-            if self.is_cat_split[h]:
-                w = self.cat_words[h]
-                members = [int(b) for b in range(len(w) * 32)
-                           if (w[b // 32] >> (b % 32)) & 1]
-                cats[str(c)] = members
+        for c in np.nonzero(self.is_cat_split)[0]:
+            w = self.cat_words[c]
+            cats[str(int(c))] = [int(b) for b in range(len(w) * 32)
+                                 if (w[b // 32] >> (b % 32)) & 1]
         return {
-            "split_type": [int(self.is_cat_split[inv[c]]) for c in range(n)],
+            "split_type": [int(x) for x in self.is_cat_split],
             "categories": cats,
-            "left_children": left.tolist(),
-            "right_children": right.tolist(),
-            "parents": parent.tolist(),
-            "split_indices": feat.tolist(),
-            "split_conditions": [float(v) if lf else float(s)
-                                 for v, s, lf in zip(value, cond, leaf)],
-            "default_left": [int(d) for d in dleft],
-            "loss_changes": gain.tolist(),
-            "sum_hessian": hess.tolist(),
-            "split_bins": [int(self.split_bin[inv[c]]) for c in range(n)],
-            "base_weights": [float(self.base_weight[inv[c]])
-                             for c in range(n)],
-            "heap_depth": self.max_depth,
+            "left_children": self.left_child.tolist(),
+            "right_children": self.right_child.tolist(),
+            "parents": self.parent.tolist(),
+            "split_indices": [int(max(f, 0)) for f in self.split_feature],
+            "split_conditions": [
+                float(self.leaf_value[c]) if self.is_leaf[c]
+                else float(self.split_value[c]) for c in range(n)],
+            "default_left": [int(d) for d in self.default_left],
+            "loss_changes": self.gain.tolist(),
+            "sum_hessian": self.sum_hess.tolist(),
+            "split_bins": self.split_bin.tolist(),
+            "base_weights": self.base_weight.tolist(),
         }
 
     @staticmethod
@@ -131,110 +219,83 @@ class TreeModel:
         left = np.asarray(obj["left_children"], np.int32)
         right = np.asarray(obj["right_children"], np.int32)
         n = len(left)
-        depth = int(obj.get("heap_depth", _depth_of(left, right)))
-        max_nodes = 2 ** (depth + 1) - 1
-        t = TreeModel.empty(max_nodes)
-        conds = obj["split_conditions"]
-        feats = obj["split_indices"]
-        dlefts = obj["default_left"]
-        gains = obj.get("loss_changes", [0.0] * n)
-        hesses = obj.get("sum_hessian", [0.0] * n)
-        sbins = obj.get("split_bins", [0] * n)
-        bweights = obj.get("base_weights", [0.0] * n)
-
-        split_type = obj.get("split_type", [0] * n)
+        if n == 0:
+            return TreeModel.single_leaf()
+        is_leaf = left < 0
+        conds = np.asarray(obj["split_conditions"], np.float64)
+        split_type = np.asarray(
+            obj.get("split_type", [0] * n), np.int32)
         categories = obj.get("categories", {})
+        n_words = 1
         if categories:
             max_cat = max((max(v) for v in categories.values() if v),
                           default=0)
-            t = TreeModel.empty(max_nodes, max_cat // 32 + 1)
-
-        def fill(c: int, h: int) -> None:
-            t.active[h] = True
-            t.sum_hess[h] = hesses[c]
-            t.base_weight[h] = bweights[c] if c < len(bweights) else 0.0
-            if left[c] < 0:
-                t.is_leaf[h] = True
-                t.leaf_value[h] = conds[c]
-            else:
-                t.is_leaf[h] = False
-                t.split_feature[h] = feats[c]
-                t.split_value[h] = conds[c]
-                t.split_bin[h] = sbins[c]
-                t.default_left[h] = bool(dlefts[c])
-                t.gain[h] = gains[c]
-                if split_type and c < len(split_type) and split_type[c]:
-                    t.is_cat_split[h] = True
-                    for b in categories.get(str(c), []):
-                        t.cat_words[h, b // 32] |= np.uint32(1 << (b % 32))
-                fill(int(left[c]), 2 * h + 1)
-                fill(int(right[c]), 2 * h + 2)
-
-        if n:
-            fill(0, 0)
+            n_words = max_cat // 32 + 1
+        cat_words = np.zeros((n, n_words), np.uint32)
+        for key, members in categories.items():
+            c = int(key)
+            for b in members:
+                cat_words[c, b // 32] |= np.uint32(1 << (b % 32))
+        parent = np.full(n, -1, np.int32)
+        internal = np.nonzero(~is_leaf)[0]
+        parent[left[internal]] = internal
+        parent[right[internal]] = internal
+        t = TreeModel(
+            left_child=left, right_child=right, parent=parent,
+            split_feature=np.where(
+                is_leaf, -1,
+                np.asarray(obj["split_indices"], np.int32)).astype(np.int32),
+            split_bin=np.asarray(obj.get("split_bins", [0] * n), np.int32),
+            split_value=np.where(is_leaf, 0.0, conds).astype(np.float32),
+            default_left=np.asarray(obj["default_left"], bool),
+            is_leaf=is_leaf,
+            leaf_value=np.where(is_leaf, conds, 0.0).astype(np.float32),
+            sum_hess=np.asarray(obj.get("sum_hessian", [0.0] * n),
+                                np.float32),
+            gain=np.asarray(obj.get("loss_changes", [0.0] * n), np.float32),
+            is_cat_split=split_type.astype(bool),
+            cat_words=cat_words,
+            base_weight=np.asarray(obj.get("base_weights", [0.0] * n),
+                                   np.float32))
+        # enforce the parent<child invariant for models produced elsewhere
+        if n > 1 and not (parent[1:] < np.arange(1, n)).all():
+            t = t.renumbered_bfs()
         return t
-
-    @staticmethod
-    def empty(max_nodes: int, n_words: int = 1) -> "TreeModel":
-        return TreeModel(
-            split_feature=np.full(max_nodes, -1, np.int32),
-            split_bin=np.zeros(max_nodes, np.int32),
-            split_value=np.zeros(max_nodes, np.float32),
-            default_left=np.zeros(max_nodes, bool),
-            is_leaf=np.ones(max_nodes, bool),
-            active=np.zeros(max_nodes, bool),
-            leaf_value=np.zeros(max_nodes, np.float32),
-            sum_hess=np.zeros(max_nodes, np.float32),
-            gain=np.zeros(max_nodes, np.float32),
-            is_cat_split=np.zeros(max_nodes, bool),
-            cat_words=np.zeros((max_nodes, n_words), np.uint32),
-        )
-
-    def resize(self, max_nodes: int, n_words: int = None) -> "TreeModel":
-        """Pad heap arrays to a larger capacity (for stacking into a forest)."""
-        if n_words is None:
-            n_words = self.cat_words.shape[1]
-        if max_nodes == self.max_nodes and n_words == self.cat_words.shape[1]:
-            return self
-        out = TreeModel.empty(max_nodes, n_words)
-        k = min(max_nodes, self.max_nodes)
-        for name in ("split_feature", "split_bin", "split_value", "default_left",
-                     "is_leaf", "active", "leaf_value", "sum_hess", "gain",
-                     "is_cat_split", "base_weight"):
-            getattr(out, name)[:k] = getattr(self, name)[:k]
-        w = min(n_words, self.cat_words.shape[1])
-        out.cat_words[:k, :w] = self.cat_words[:k, :w]
-        return out
-
-
-def _depth_of(left: np.ndarray, right: np.ndarray) -> int:
-    depth = [0] * len(left)
-    best = 0
-    for c in range(len(left)):
-        if left[c] >= 0:
-            depth[left[c]] = depth[right[c]] = depth[c] + 1
-            best = max(best, depth[c] + 1)
-    return best
 
 
 def stack_forest(trees: List[TreeModel]) -> Optional[Dict[str, np.ndarray]]:
-    """Stack per-tree heap arrays into [n_trees, max_nodes] tensors for the
-    batched predictor."""
+    """Stack per-tree compact arrays into [n_trees, max_nodes] tensors for the
+    batched predictor. Padded slots are inert leaves. ``depth`` holds the
+    deepest tree's depth (the number of walk steps the predictor needs)."""
     if not trees:
         return None
-    cap = max(t.max_nodes for t in trees)
+    cap = max(t.num_nodes() for t in trees)
     n_words = max(t.cat_words.shape[1] for t in trees)
-    trees = [t.resize(cap, n_words) for t in trees]
+    T = len(trees)
+
+    def pad1(vals, fill, dtype):
+        out = np.full((T, cap), fill, dtype)
+        for i, v in enumerate(vals):
+            out[i, : len(v)] = v
+        return out
+
     out = {
-        "split_feature": np.stack([t.split_feature for t in trees]),
-        "split_value": np.stack([t.split_value for t in trees]),
-        "split_bin": np.stack([t.split_bin for t in trees]),
-        "default_left": np.stack([t.default_left for t in trees]),
-        "is_leaf": np.stack([t.is_leaf for t in trees]),
-        "leaf_value": np.stack([t.leaf_value for t in trees]),
-        "sum_hess": np.stack([t.sum_hess for t in trees]),
+        "left_child": pad1([t.left_child for t in trees], -1, np.int32),
+        "right_child": pad1([t.right_child for t in trees], -1, np.int32),
+        "split_feature": pad1([t.split_feature for t in trees], -1, np.int32),
+        "split_value": pad1([t.split_value for t in trees], 0, np.float32),
+        "split_bin": pad1([t.split_bin for t in trees], 0, np.int32),
+        "default_left": pad1([t.default_left for t in trees], False, bool),
+        "is_leaf": pad1([t.is_leaf for t in trees], True, bool),
+        "leaf_value": pad1([t.leaf_value for t in trees], 0, np.float32),
+        "sum_hess": pad1([t.sum_hess for t in trees], 0, np.float32),
     }
     if any(t.is_cat_split.any() for t in trees):
-        out["is_cat_split"] = np.stack([t.is_cat_split for t in trees])
-        out["cat_words"] = np.stack([t.cat_words for t in trees])
+        out["is_cat_split"] = pad1([t.is_cat_split for t in trees], False,
+                                   bool)
+        cw = np.zeros((T, cap, n_words), np.uint32)
+        for i, t in enumerate(trees):
+            cw[i, : t.num_nodes(), : t.cat_words.shape[1]] = t.cat_words
+        out["cat_words"] = cw
+    out["depth"] = np.asarray(max(t.max_depth() for t in trees), np.int32)
     return out

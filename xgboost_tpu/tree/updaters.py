@@ -3,8 +3,8 @@
 ``updater_sync.cc:54``) and the ``process_type=update`` pipeline
 (``src/gbm/gbtree.cc:312-327``).
 
-These operate on finished ``TreeModel``s (host-side heap arrays); refresh
-re-derives node statistics from data with one vectorised device pass per tree.
+These operate on finished ``TreeModel``s (host-side compact arrays); refresh
+re-derives node statistics from data with one vectorised pass per tree.
 """
 
 from __future__ import annotations
@@ -19,42 +19,51 @@ from .tree import TreeModel
 
 def prune_tree(tree: TreeModel, param: TrainParam) -> TreeModel:
     """Recursively turn split nodes with ``gain < min_split_loss`` (and only
-    leaf children) into leaves — the reference's ``TreePruner::DoPrune``."""
-    t = tree
-    changed = True
-    while changed:
-        changed = False
-        # deepest-first so cascades propagate upward in one sweep
-        for nid in range(t.max_nodes - 1, -1, -1):
-            if not t.active[nid] or t.is_leaf[nid]:
-                continue
-            li, ri = 2 * nid + 1, 2 * nid + 2
-            if li >= t.max_nodes or (t.is_leaf[li] and t.is_leaf[ri]):
-                if t.gain[nid] < param.gamma:
-                    t.is_leaf[nid] = True
-                    t.split_feature[nid] = -1
-                    t.gain[nid] = 0.0
-                    t.leaf_value[nid] = t.base_weight[nid]
-                    if li < t.max_nodes:
-                        t.active[li] = False
-                        t.active[ri] = False
-                        t.leaf_value[li] = 0.0
-                        t.leaf_value[ri] = 0.0
-                    changed = True
-    return t
+    leaf children) into leaves — the reference's ``TreePruner::DoPrune``.
+    Returns a renumbered compact tree with the collapsed subtrees removed."""
+    n = tree.num_nodes()
+    is_leaf = tree.is_leaf.copy()
+    gain = tree.gain.copy()
+    leaf_value = tree.leaf_value.copy()
+    split_feature = tree.split_feature.copy()
+    # children always have larger ids (BFS invariant), so one reverse sweep
+    # cascades collapses upward
+    for nid in range(n - 1, -1, -1):
+        if is_leaf[nid]:
+            continue
+        li, ri = tree.left_child[nid], tree.right_child[nid]
+        if is_leaf[li] and is_leaf[ri] and gain[nid] < param.gamma:
+            is_leaf[nid] = True
+            split_feature[nid] = -1
+            gain[nid] = 0.0
+            leaf_value[nid] = tree.base_weight[nid]
+    pruned = TreeModel(
+        left_child=np.where(is_leaf, -1, tree.left_child).astype(np.int32),
+        right_child=np.where(is_leaf, -1, tree.right_child).astype(np.int32),
+        parent=tree.parent.copy(),
+        split_feature=split_feature,
+        split_bin=tree.split_bin.copy(),
+        split_value=tree.split_value.copy(),
+        default_left=tree.default_left.copy(),
+        is_leaf=is_leaf,
+        leaf_value=leaf_value,
+        sum_hess=tree.sum_hess.copy(),
+        gain=gain,
+        is_cat_split=tree.is_cat_split.copy(),
+        cat_words=tree.cat_words.copy(),
+        base_weight=tree.base_weight.copy())
+    if is_leaf.sum() == tree.is_leaf.sum():
+        return pruned
+    return pruned.renumbered_bfs()   # drop orphaned subtrees
 
 
-def refresh_tree(tree: TreeModel, X: np.ndarray, gpair: np.ndarray,
-                 param: TrainParam, refresh_leaf: bool = True) -> TreeModel:
-    """Recompute node stats (cover) and optionally leaf values of an existing
-    tree on new data — the reference's ``TreeRefresher``. Routes rows by raw
-    thresholds so it works for loaded models whose bin ids refer to cuts
-    that no longer exist."""
+def route_rows(tree: TreeModel, X: np.ndarray) -> np.ndarray:
+    """Leaf position (compact id) of every row, walking raw thresholds."""
     n = X.shape[0]
     pos = np.zeros(n, np.int64)
     W = tree.cat_words.shape[1]
-    for _ in range(tree.max_depth):
-        splitting = tree.active[pos] & ~tree.is_leaf[pos]
+    for _ in range(tree.max_depth()):
+        splitting = ~tree.is_leaf[pos]
         if not splitting.any():
             break
         fid = np.maximum(tree.split_feature[pos], 0)
@@ -71,23 +80,33 @@ def refresh_tree(tree: TreeModel, X: np.ndarray, gpair: np.ndarray,
             cat_right = np.where(in_rng, bit == 0, ~tree.default_left[pos])
             go_right = np.where(cat_node, cat_right, go_right)
         go_right = np.where(miss, ~tree.default_left[pos], go_right)
-        pos = np.where(splitting, 2 * pos + 1 + go_right.astype(np.int64),
-                       pos)
-    g = np.zeros(tree.max_nodes, np.float64)
-    h = np.zeros(tree.max_nodes, np.float64)
+        child = np.where(go_right, tree.right_child[pos],
+                         tree.left_child[pos])
+        pos = np.where(splitting, child, pos)
+    return pos
+
+
+def refresh_tree(tree: TreeModel, X: np.ndarray, gpair: np.ndarray,
+                 param: TrainParam, refresh_leaf: bool = True) -> TreeModel:
+    """Recompute node stats (cover) and optionally leaf values of an existing
+    tree on new data — the reference's ``TreeRefresher``. Routes rows by raw
+    thresholds so it works for loaded models whose bin ids refer to cuts
+    that no longer exist."""
+    pos = route_rows(tree, X)
+    n_nodes = tree.num_nodes()
+    g = np.zeros(n_nodes, np.float64)
+    h = np.zeros(n_nodes, np.float64)
     np.add.at(g, pos, gpair[:, 0])
     np.add.at(h, pos, gpair[:, 1])
-    # push sums up the heap (leaf stats -> internal covers)
-    for nid in range(tree.max_nodes - 1, 0, -1):
-        parent = (nid - 1) // 2
-        g[parent] += g[nid]
-        h[parent] += h[nid]
+    # push leaf sums up to internal nodes (children before parents)
+    for nid in range(n_nodes - 1, 0, -1):
+        g[tree.parent[nid]] += g[nid]
+        h[tree.parent[nid]] += h[nid]
     tree.sum_hess = h.astype(np.float32)
     w_all = (-g / (h + param.reg_lambda) * param.eta).astype(np.float32)
-    tree.base_weight = np.where(tree.active, w_all, 0.0).astype(np.float32)
+    tree.base_weight = w_all
     if refresh_leaf:
-        leaves = tree.active & tree.is_leaf
-        tree.leaf_value[leaves] = w_all[leaves]
+        tree.leaf_value[tree.is_leaf] = w_all[tree.is_leaf]
     return tree
 
 
