@@ -1,0 +1,129 @@
+"""grow_policy=lossguide and max_leaves (reference Driver LossGuide ordering,
+``src/tree/driver.h:29-107``, and CPUExpandEntry leaf-cap validity)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _data(n=4000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] + 0.3 * rng.randn(n) > 0).astype(
+        np.float32)
+    return X, y
+
+
+def test_lossguide_respects_max_leaves():
+    X, y = _data()
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train({"objective": "binary:logistic",
+                     "grow_policy": "lossguide", "max_leaves": 16,
+                     "max_depth": 0, "eval_metric": "logloss"}, dm, 5,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    for t in bst.gbm.trees:
+        assert t.num_leaves() <= 16
+    ll = res["train"]["logloss"]
+    assert ll[-1] < ll[0]
+
+
+def test_lossguide_can_exceed_heap_depth():
+    # with max_depth=0 lossguide may grow skewed chains deeper than
+    # log2(max_leaves); the compact layout must handle it
+    X, y = _data(seed=3)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic",
+                     "grow_policy": "lossguide", "max_leaves": 8,
+                     "max_depth": 0}, dm, 5, verbose_eval=False)
+    depths = [t.max_depth() for t in bst.gbm.trees]
+    assert max(depths) >= 3
+    p = bst.predict(dm)
+    assert np.isfinite(p).all()
+
+
+def test_lossguide_uncapped_equals_depthwise():
+    # split decisions are order-independent: lossguide with no leaf cap and
+    # bounded depth must produce the same model as depthwise
+    X, y = _data(seed=1)
+    dm = xgb.DMatrix(X, label=y)
+    p_lg = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                      "grow_policy": "lossguide", "max_leaves": 0},
+                     dm, 3, verbose_eval=False).predict(dm)
+    p_dw = xgb.train({"objective": "binary:logistic", "max_depth": 4},
+                     dm, 3, verbose_eval=False).predict(dm)
+    assert np.abs(p_lg - p_dw).max() < 2e-5
+
+
+def test_depthwise_max_leaves_cap():
+    X, y = _data(seed=2)
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 5,
+                     "max_leaves": 8, "eval_metric": "logloss"}, dm, 5,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    for t in bst.gbm.trees:
+        assert t.num_leaves() <= 8
+    assert res["train"]["logloss"][-1] < res["train"]["logloss"][0]
+
+
+def test_lossguide_save_load_round_trip(tmp_path):
+    X, y = _data(seed=4)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic",
+                     "grow_policy": "lossguide", "max_leaves": 12,
+                     "max_depth": 0}, dm, 4, verbose_eval=False)
+    p = bst.predict(dm)
+    path = str(tmp_path / "lg.json")
+    bst.save_model(path)
+    p2 = xgb.Booster(model_file=path).predict(dm)
+    assert np.abs(p - p2).max() < 1e-6
+    # ubjson too
+    upath = str(tmp_path / "lg.ubj")
+    bst.save_model(upath)
+    p3 = xgb.Booster(model_file=upath).predict(dm)
+    assert np.abs(p - p3).max() < 1e-6
+
+
+def test_lossguide_monotone_constraint():
+    rng = np.random.RandomState(5)
+    n = 3000
+    X = rng.randn(n, 3).astype(np.float32)
+    y = (X[:, 0] + 0.2 * rng.randn(n)).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:squarederror",
+                     "grow_policy": "lossguide", "max_leaves": 16,
+                     "monotone_constraints": "(1,0,0)"}, dm, 10,
+                    verbose_eval=False)
+    grid = np.tile(np.zeros(3, np.float32), (50, 1))
+    grid[:, 0] = np.linspace(-2, 2, 50)
+    p = bst.predict(xgb.DMatrix(grid))
+    assert (np.diff(p) >= -1e-5).all()
+
+
+def test_lossguide_distributed_mesh():
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = xgb.make_data_mesh(devices=tuple(jax.devices()[:4]))
+    X, y = _data(n=4 * 997 + 1, seed=6)   # uneven shard
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train({"objective": "binary:logistic",
+                     "grow_policy": "lossguide", "max_leaves": 8,
+                     "mesh": mesh, "eval_metric": "logloss"}, dm, 3,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    ll = res["train"]["logloss"]
+    assert ll[-1] < ll[0]
+    # distributed == single-device model
+    bst1 = xgb.train({"objective": "binary:logistic",
+                      "grow_policy": "lossguide", "max_leaves": 8},
+                     dm, 3, verbose_eval=False)
+    p_m = bst.predict(dm)
+    p_1 = bst1.predict(dm)
+    assert np.abs(p_m - p_1).max() < 2e-4

@@ -52,10 +52,16 @@ class GBTree:
                 # reference BoostNewTrees: lr /= num_parallel_tree
                 param = param.clone()
                 param.eta = param.eta / self.num_parallel_tree
-            self._grower = TreeGrower(param, binned.max_nbins, binned.cuts,
-                                      hist_method=self.hist_method,
-                                      mesh=self.mesh, monotone=self.monotone,
-                                      constraint_sets=self.constraint_sets)
+            if param.grow_policy == "lossguide":
+                from ..tree.lossguide import LossguideGrower
+
+                cls = LossguideGrower
+            else:
+                cls = TreeGrower
+            self._grower = cls(param, binned.max_nbins, binned.cuts,
+                               hist_method=self.hist_method,
+                               mesh=self.mesh, monotone=self.monotone,
+                               constraint_sets=self.constraint_sets)
         return self._grower
 
     def do_boost(self, state: dict, gpair: jnp.ndarray,

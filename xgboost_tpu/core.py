@@ -128,12 +128,16 @@ class Booster:
             raise ValueError("tree_method=exact does not support "
                              "distributed training (reference ColMaker "
                              "limitation)")
-        if self.tree_param.grow_policy != "depthwise":
-            raise NotImplementedError(
-                f"grow_policy={self.tree_param.grow_policy} is not "
-                "implemented yet; use 'depthwise'")
-        if self.tree_param.max_leaves != 0:
-            raise NotImplementedError("max_leaves is not implemented yet")
+        if self.tree_param.grow_policy not in ("depthwise", "lossguide"):
+            raise ValueError(
+                f"unknown grow_policy={self.tree_param.grow_policy}; use "
+                "'depthwise' or 'lossguide'")
+        if self.tree_param.grow_policy == "lossguide" and tm == "exact":
+            raise ValueError("tree_method=exact only supports "
+                             "grow_policy=depthwise (reference ColMaker)")
+        if (self.tree_param.grow_policy == "depthwise"
+                and self.tree_param.max_depth <= 0):
+            raise ValueError("grow_policy=depthwise requires max_depth > 0")
         obj_name = self.learner_params.get("objective", "reg:squarederror")
         if self.obj is None or getattr(self.obj, "name", None) != obj_name:
             self.obj = get_objective(

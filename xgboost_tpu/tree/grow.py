@@ -267,11 +267,65 @@ class TreeGrower:
                                      self.param.colsample_bytree)
         key = jax.random.fold_in(key, 0x5EED)
         if self.mesh is None:
-            return _grow(bins, gpair, n_real_bins, tree_mask, key,
-                         self.monotone, self.constraint_sets, self.cat,
-                         param=self.param, max_nbins=self.max_nbins,
-                         hist_method=self.hist_method, axis_name=None)
-        return self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+            g = _grow(bins, gpair, n_real_bins, tree_mask, key,
+                      self.monotone, self.constraint_sets, self.cat,
+                      param=self.param, max_nbins=self.max_nbins,
+                      hist_method=self.hist_method, axis_name=None)
+        else:
+            g = self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+        if self.param.max_leaves > 0:
+            g = self._truncate_max_leaves(g)
+        return g
+
+    def _truncate_max_leaves(self, g: GrownTree) -> GrownTree:
+        """Depth-wise growth under a ``max_leaves`` cap: the reference Driver
+        pops same-depth nodes in insertion order and stops splitting once the
+        leaf count hits the cap (``CPUExpandEntry::IsValid``). Splits are
+        order-independent, so simulating that schedule over the fully grown
+        level tree reproduces it exactly; rows in truncated subtrees are
+        re-parked on their deepest surviving ancestor."""
+        max_leaves = self.param.max_leaves
+        active = np.asarray(g.active)
+        is_leaf = np.asarray(g.is_leaf)
+        cap = len(is_leaf)
+        exists = np.zeros(cap, bool)
+        exists[0] = True
+        selected = np.zeros(cap, bool)
+        n_leaves = 1
+        for nid in range(cap):      # heap BFS order == insertion order
+            if not exists[nid] or is_leaf[nid] or not active[nid]:
+                continue
+            if n_leaves >= max_leaves:
+                continue
+            selected[nid] = True
+            n_leaves += 1
+            exists[2 * nid + 1] = exists[2 * nid + 2] = True
+        was_split = active & ~is_leaf
+        if (selected == was_split).all():
+            return g
+        base_weight = np.asarray(g.base_weight)
+        new_is_leaf = exists & ~selected
+        leaf_value = np.where(new_is_leaf, base_weight, 0.0).astype(np.float32)
+        pos = np.asarray(g.positions)
+        for _ in range(self.param.max_depth):
+            pos = np.where(exists[pos], pos, (pos - 1) // 2)
+        return GrownTree(
+            split_feature=np.where(selected, np.asarray(g.split_feature),
+                                   -1).astype(np.int32),
+            split_bin=np.where(selected, np.asarray(g.split_bin),
+                               0).astype(np.int32),
+            default_left=np.asarray(g.default_left) & selected,
+            is_leaf=new_is_leaf, active=exists,
+            leaf_value=leaf_value,
+            node_sum=np.asarray(g.node_sum),
+            gain=np.where(selected, np.asarray(g.gain), 0.0).astype(
+                np.float32),
+            positions=pos.astype(np.int32),
+            delta=jnp.asarray(leaf_value[pos]),
+            is_cat_split=np.asarray(g.is_cat_split) & selected,
+            cat_words=np.where(selected[:, None], np.asarray(g.cat_words),
+                               np.uint32(0)),
+            base_weight=np.where(exists, base_weight, 0.0).astype(np.float32))
 
     def _sharded(self, bins, gpair, n_real_bins, tree_mask, key) -> GrownTree:
         from ..context import DATA_AXIS
