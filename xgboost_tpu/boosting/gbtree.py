@@ -16,9 +16,40 @@ import numpy as np
 
 from ..data.binned import BinnedMatrix
 from ..registry import BOOSTERS
-from ..tree.grow import TreeGrower
+from ..tree.grow import GrownTree, TreeGrower
 from ..tree.param import TrainParam
 from ..tree.tree import TreeModel
+
+
+_GROWN_FIELDS = ("split_feature", "split_bin", "default_left", "is_leaf",
+                 "active", "leaf_value", "node_sum", "gain", "is_cat_split",
+                 "cat_words", "base_weight")
+
+
+class _PendingTree:
+    """A grown tree whose per-node arrays still live on device."""
+
+    __slots__ = ("arrays", "grower")
+
+    def __init__(self, grown, grower) -> None:
+        self.arrays = {f: getattr(grown, f) for f in _GROWN_FIELDS}
+        self.grower = grower
+
+
+class _HostGrown:
+    """Host-side view of fetched grown-tree arrays (duck-types GrownTree for
+    ``TreeGrower.to_tree_model``)."""
+
+    __slots__ = ("_arrs",)
+
+    def __init__(self, arrs) -> None:
+        self._arrs = arrs
+
+    def __getattr__(self, name):
+        try:
+            return self._arrs[name]
+        except KeyError:
+            raise AttributeError(name)
 
 
 @BOOSTERS.register("gbtree")
@@ -37,12 +68,35 @@ class GBTree:
         self.monotone = monotone
         self.constraint_sets = constraint_sets
         self.tree_method = tree_method
-        self.trees: List[TreeModel] = []
+        self._trees: List = []  # TreeModel | _PendingTree (device-side)
         self.tree_info: List[int] = []
         self.iteration_indptr: List[int] = [0]
         self._grower: Optional[TreeGrower] = None
         self._exact_quant = None
         self._stat_version = 0  # bumped by process_type=update refreshes
+
+    # -- deferred tree materialisation ---------------------------------------
+    # Pulling a grown tree to the host costs one tunnel round trip per array
+    # (~40 ms each against a remote TPU), so plain-hist training keeps the
+    # per-node arrays on device and converts them to TreeModels lazily, in ONE
+    # batched ``jax.device_get`` for however many trees have accumulated.
+    @property
+    def trees(self) -> List[TreeModel]:
+        self._flush()
+        return self._trees
+
+    @trees.setter
+    def trees(self, value) -> None:
+        self._trees = list(value)
+
+    def _flush(self) -> None:
+        pending = [(i, t) for i, t in enumerate(self._trees)
+                   if isinstance(t, _PendingTree)]
+        if not pending:
+            return
+        host = jax.device_get([t.arrays for _, t in pending])
+        for (i, t), arrs in zip(pending, host):
+            self._trees[i] = t.grower.to_tree_model(_HostGrown(arrs))
 
     # -- training -------------------------------------------------------------
     def _grower_for(self, binned: BinnedMatrix) -> TreeGrower:
@@ -104,7 +158,15 @@ class GBTree:
                                      info.feature_types)
                 binned = BinnedMatrix.from_dense(np.asarray(state["dm"].X),
                                                  cuts)
-                self._grower = None
+                # reuse the grower (and its jitted kernels) across re-sketches
+                # when the compiled shapes are unchanged; categorical split
+                # sets depend on the cuts, so those rebuild
+                g = self._grower
+                if (g is not None and g.max_nbins == binned.max_nbins
+                        and g.cat is None and not cuts.is_cat().any()):
+                    g.cuts = cuts
+                else:
+                    self._grower = None
                 grower = self._grower_for(binned)
                 n_real = binned.n_real_bins()
             delta_k = jnp.zeros((n,), jnp.float32)
@@ -122,9 +184,16 @@ class GBTree:
                     egrower = ExactGrower(self.tree_param, self._exact_quant)
                     grown = egrower.grow(gp, tkey)
                     tree = egrower.to_tree_model(grown)
-                else:
+                elif adaptive:
                     grown = grower.grow(binned.bins, gp, n_real, tkey)
                     tree = grower.to_tree_model(grown)
+                else:
+                    grown = grower.grow(binned.bins, gp, n_real, tkey)
+                    if (isinstance(grown, GrownTree)
+                            and isinstance(grown.split_feature, jnp.ndarray)):
+                        tree = _PendingTree(grown, grower)  # stays on device
+                    else:  # host arrays (lossguide / max_leaves truncation)
+                        tree = grower.to_tree_model(grown)
                 if adaptive:
                     # grower positions are heap ids; translate to the
                     # committed tree's compact ids first
@@ -137,10 +206,10 @@ class GBTree:
                         tree.leaf_value[pos], dtype=jnp.float32)
                 else:
                     delta_k = delta_k + grown.delta
-                self.trees.append(tree)
+                self._trees.append(tree)
                 self.tree_info.append(k)
             deltas.append(delta_k)
-        self.iteration_indptr.append(len(self.trees))
+        self.iteration_indptr.append(len(self._trees))
         return jnp.stack(deltas, axis=1)
 
     # -- prediction interface (used by core.Booster) --------------------------
@@ -150,7 +219,7 @@ class GBTree:
         """Monotone counter identifying the current model contents (a tree
         count — the margin cache slices trees by it, so in-place updates
         reset caches through the Booster instead of bumping this)."""
-        return len(self.trees)
+        return len(self._trees)
 
     def training_margin(self, state: dict) -> jnp.ndarray:
         """Margin to compute gradients against (DART overrides: drop trees)."""

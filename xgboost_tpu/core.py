@@ -135,6 +135,9 @@ class Booster:
         if self.tree_param.grow_policy == "lossguide" and tm == "exact":
             raise ValueError("tree_method=exact only supports "
                              "grow_policy=depthwise (reference ColMaker)")
+        if tm == "exact" and self.tree_param.max_leaves > 0:
+            raise NotImplementedError(
+                "tree_method=exact does not support max_leaves")
         if (self.tree_param.grow_policy == "depthwise"
                 and self.tree_param.max_depth <= 0):
             raise ValueError("grow_policy=depthwise requires max_depth > 0")

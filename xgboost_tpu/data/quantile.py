@@ -134,6 +134,18 @@ class HistogramCuts:
         x <= split_value."""
         return float(self.values[int(self.ptrs[f]) + int(local_bin)])
 
+    def split_values(self, split_feature: np.ndarray,
+                     split_bin: np.ndarray) -> np.ndarray:
+        """Vectorised raw thresholds for per-node (feature, local bin) pairs;
+        entries with split_feature < 0 (leaves) map to 0."""
+        sf = np.asarray(split_feature)
+        sb = np.asarray(split_bin)
+        out = np.zeros(sf.shape, np.float32)
+        mask = sf >= 0
+        gb = self.ptrs[np.maximum(sf, 0)] + sb
+        out[mask] = self.values[np.clip(gb[mask], 0, len(self.values) - 1)]
+        return out
+
     def is_cat(self) -> np.ndarray:
         if not self.feature_types:
             return np.zeros(self.n_features, dtype=bool)

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.histogram import build_hist
-from ..ops.partition import update_positions
+from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import CatInfo, evaluate_splits
 from .param import TrainParam, calc_weight
 from .tree import TreeModel
@@ -106,6 +106,30 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     cat_words = jnp.zeros((max_nodes, n_words), jnp.uint32)
 
     bins_t = bins.T  # loop-invariant; feeds the fused Pallas hist kernel
+    # f32 copy of the bin matrix: the level-wise position advance fetches each
+    # node's split-feature column with one [n, F] @ [F, N] MXU matmul (bin ids
+    # are < 2^24 so the f32 values are exact).
+    bins_f32 = bins.astype(jnp.float32)
+
+    # The gather-free level ops materialise [n, n_level] intermediates; past
+    # this level width the memory cost outweighs the gather cost, so deeper
+    # levels fall back to the per-row gather walk.
+    DENSE_LEVEL_MAX = 64
+    # per-level delta accumulation touches the deepest level (2^max_depth
+    # nodes); all levels must be dense for it to cover every row exactly once
+    dense_delta = 2 ** max_depth <= DENSE_LEVEL_MAX
+
+    # per-row margin delta, accumulated level by level as nodes become leaves
+    # (avoids a data-dependent [n] gather from the leaf table at the end)
+    delta = jnp.zeros((n,), jnp.float32)
+
+    def level_weight(lo, n_level):
+        s = node_sum[lo:lo + n_level]
+        w = calc_weight(s[:, 0], s[:, 1], param)
+        if monotone is not None:
+            w = jnp.clip(w, node_lower[lo:lo + n_level],
+                         node_upper[lo:lo + n_level])
+        return w * param.eta
 
     for depth in range(max_depth):
         lo = 2 ** depth - 1
@@ -204,13 +228,31 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             node_path = node_path.at[li].set(child_path)
             node_path = node_path.at[ri].set(child_path)
 
-        is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(can_split)
-        positions = update_positions(bins, positions, split_feature, split_bin,
-                                     default_left, is_split_full, missing_bin,
-                                     is_cat_split=is_cat_split
-                                     if cat is not None else None,
-                                     cat_words=cat_words
-                                     if cat is not None else None)
+        if dense_delta:
+            # rows whose node just became a terminal leaf take its value now
+            leaf_now = active[idx] & ~can_split
+            w_level = jnp.where(leaf_now, level_weight(lo, n_level), 0.0)
+            rel_oh = (rel[:, None]
+                      == jnp.arange(n_level, dtype=jnp.int32)[None, :])
+            delta = delta + jnp.sum(
+                jnp.where(rel_oh, w_level[None, :], 0.0), axis=1)
+
+        if n_level <= DENSE_LEVEL_MAX:
+            positions = advance_positions_level(
+                bins_f32, positions, rel,
+                jnp.where(can_split, res.feature, -1),
+                jnp.where(can_split, res.bin, 0),
+                can_split & res.default_left, can_split, missing_bin,
+                is_cat=(can_split & res.is_cat) if cat is not None else None,
+                cat_words=res.cat_words if cat is not None else None)
+        else:  # deep level: per-row gather walk bounds memory to O(n)
+            is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(
+                can_split)
+            positions = update_positions(
+                bins, positions, split_feature, split_bin, default_left,
+                is_split_full, missing_bin,
+                is_cat_split=is_cat_split if cat is not None else None,
+                cat_words=cat_words if cat is not None else None)
 
     w = calc_weight(node_sum[:, 0], node_sum[:, 1], param)
     if monotone is not None:
@@ -218,7 +260,20 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     w = w * param.eta
     leaf_value = jnp.where(active & is_leaf, w, 0.0).astype(jnp.float32)
     base_weight = jnp.where(active, w, 0.0).astype(jnp.float32)
-    delta = leaf_value[positions]
+
+    if dense_delta:
+        # deepest level: every surviving node is a leaf
+        lo = 2 ** max_depth - 1
+        n_level = 2 ** max_depth
+        w_last = jnp.where(active[lo:lo + n_level],
+                           level_weight(lo, n_level), 0.0)
+        rel = jnp.where(positions >= lo, positions - lo,
+                        n_level).astype(jnp.int32)
+        rel_oh = rel[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
+        delta = delta + jnp.sum(jnp.where(rel_oh, w_last[None, :], 0.0),
+                                axis=1)
+    else:
+        delta = leaf_value[positions]
     return GrownTree(split_feature=split_feature, split_bin=split_bin,
                      default_left=default_left, is_leaf=is_leaf, active=active,
                      leaf_value=leaf_value, node_sum=node_sum, gain=gain,
@@ -357,12 +412,7 @@ class TreeGrower:
         thresholds."""
         sf = np.asarray(g.split_feature)
         sb = np.asarray(g.split_bin)
-        ptrs = self.cuts.ptrs
-        vals = self.cuts.values
-        split_value = np.zeros(sf.shape, np.float32)
-        mask = sf >= 0
-        gb = ptrs[np.maximum(sf, 0)] + sb
-        split_value[mask] = vals[np.clip(gb[mask], 0, len(vals) - 1)]
+        split_value = self.cuts.split_values(sf, sb)
         return TreeModel.from_heap(
             split_feature=sf, split_bin=sb, split_value=split_value,
             default_left=np.asarray(g.default_left),

@@ -342,11 +342,7 @@ class LossguideGrower:
         w = np.clip(w, lower[:n_nodes], upper[:n_nodes]) * param.eta
         is_leaf = lc[:n_nodes] < 0
         leaf_value = np.where(is_leaf, w, 0.0).astype(np.float32)
-        ptrs, vals = self.cuts.ptrs, self.cuts.values
-        split_value = np.zeros(n_nodes, np.float32)
-        mask = sf[:n_nodes] >= 0
-        gb = ptrs[np.maximum(sf[:n_nodes], 0)] + sb[:n_nodes]
-        split_value[mask] = vals[np.clip(gb[mask], 0, len(vals) - 1)]
+        split_value = self.cuts.split_values(sf[:n_nodes], sb[:n_nodes])
         tree = TreeModel(
             left_child=lc[:n_nodes].copy(), right_child=rc[:n_nodes].copy(),
             parent=pa[:n_nodes].copy(),
