@@ -115,7 +115,8 @@ class GBTree:
             self._grower = cls(param, binned.max_nbins, binned.cuts,
                                hist_method=self.hist_method,
                                mesh=self.mesh, monotone=self.monotone,
-                               constraint_sets=self.constraint_sets)
+                               constraint_sets=self.constraint_sets,
+                               has_missing=binned.has_missing)
         return self._grower
 
     def do_boost(self, state: dict, gpair: jnp.ndarray,
@@ -164,6 +165,9 @@ class GBTree:
                 g = self._grower
                 if (g is not None and g.max_nbins == binned.max_nbins
                         and g.cat is None and not cuts.is_cat().any()):
+                    # pending trees still reference this grower's cuts for
+                    # their raw thresholds — materialise them first
+                    self._flush()
                     g.cuts = cuts
                 else:
                     self._grower = None
@@ -292,7 +296,7 @@ class GBTree:
         pred = self._predictor(tree_lo, tree_hi)
         if pred is None:
             return 0.0
-        delta, _ = pred.margin_binned(binned.bins, binned.max_nbins - 1,
+        delta, _ = pred.margin_binned(binned.bins, binned.missing_bin,
                                       np.zeros(self.n_groups, np.float32))
         return delta
 
@@ -302,7 +306,7 @@ class GBTree:
         if pred is None:
             return jnp.broadcast_to(
                 jnp.asarray(base, jnp.float32)[None, :], (n, self.n_groups))
-        m, _ = pred.margin_binned(binned.bins, binned.max_nbins - 1,
+        m, _ = pred.margin_binned(binned.bins, binned.missing_bin,
                                   np.asarray(base, np.float32))
         return m
 

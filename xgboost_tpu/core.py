@@ -287,13 +287,17 @@ class Booster:
         pad = n_pad - n
         bins_np = np.asarray(binned.bins)
         if pad:
-            fill = np.full((pad, bins_np.shape[1]), binned.missing_bin,
+            # any in-range bin works: padded rows carry zero gradient, so
+            # they never contribute to histograms or leaf sums
+            fill = np.full((pad, bins_np.shape[1]),
+                           min(binned.missing_bin, binned.max_nbins - 1),
                            dtype=bins_np.dtype)
             bins_np = np.concatenate([bins_np, fill], axis=0)
         sharding = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS, None))
         bins_dev = jax.device_put(bins_np, sharding)
         binned_p = BinnedMatrix(bins=bins_dev, cuts=binned.cuts,
-                                max_nbins=binned.max_nbins)
+                                max_nbins=binned.max_nbins,
+                                has_missing=binned.has_missing)
 
         info = dm.info
         labels = info.labels if info.labels is not None else np.zeros(n)

@@ -36,14 +36,22 @@ def _dtype_for(max_local_bins: int):
 class BinnedMatrix:
     """Quantized feature matrix resident in HBM.
 
-    bins: [n_rows, n_features] local bin indices (device array); value
-          ``max_nbins - 1`` means missing.
+    bins: [n_rows, n_features] local bin indices (device array); when
+          ``has_missing``, value ``max_nbins - 1`` means missing.
     cuts: ragged host-side cut values (for raw-threshold recovery).
+
+    When the source data contains no missing values the trailing missing slot
+    is dropped entirely (``has_missing=False``): ``max_nbins`` is then exactly
+    the max per-feature real-bin count (256 with default ``max_bin``, which
+    packs bins into uint8 and aligns the histogram's bin axis to the MXU
+    tile), and ``missing_bin`` becomes an out-of-range sentinel that no row
+    ever matches.
     """
 
     bins: jnp.ndarray
     cuts: HistogramCuts
-    max_nbins: int  # uniform per-feature slot count, incl. trailing missing slot
+    max_nbins: int  # uniform per-feature slot count (+1 missing slot if any)
+    has_missing: bool = True
 
     @property
     def n_rows(self) -> int:
@@ -55,7 +63,9 @@ class BinnedMatrix:
 
     @property
     def missing_bin(self) -> int:
-        return self.max_nbins - 1
+        """Bin id routed by the default direction; out-of-range sentinel
+        (never matched) when the matrix has no missing values."""
+        return self.max_nbins - 1 if self.has_missing else self.max_nbins
 
     def n_real_bins(self) -> jnp.ndarray:
         """[n_features] int32 count of real (non-missing) bins per feature."""
@@ -64,20 +74,26 @@ class BinnedMatrix:
     @staticmethod
     def from_dense(X: np.ndarray, cuts: HistogramCuts, device=None) -> "BinnedMatrix":
         local = cuts.search_bin(np.asarray(X, dtype=np.float32))
-        max_nbins = int(cuts.n_real_bins().max(initial=0)) + 1
-        local = np.where(local < 0, max_nbins - 1, local)
+        has_missing = bool((local < 0).any())
+        max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
+        if has_missing:
+            local = np.where(local < 0, max_nbins - 1, local)
         arr = local.astype(_dtype_for(max_nbins - 1))
         bins = (jax.device_put(arr, device) if device is not None
                 else jnp.asarray(arr))
-        return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins)
+        return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins,
+                            has_missing=has_missing)
 
     @staticmethod
     def from_local_bins(local: np.ndarray, cuts: HistogramCuts,
-                        max_nbins: Optional[int] = None, device=None) -> "BinnedMatrix":
+                        max_nbins: Optional[int] = None, device=None,
+                        has_missing: bool = True) -> "BinnedMatrix":
         """Wrap precomputed local bins (missing already mapped to max_nbins-1)."""
         if max_nbins is None:
-            max_nbins = int(cuts.n_real_bins().max(initial=0)) + 1
+            max_nbins = (int(cuts.n_real_bins().max(initial=0))
+                         + int(has_missing))
         arr = np.asarray(local).astype(_dtype_for(max_nbins - 1))
         bins = (jax.device_put(arr, device) if device is not None
                 else jnp.asarray(arr))
-        return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins)
+        return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins,
+                            has_missing=has_missing)

@@ -112,7 +112,11 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
     if method.startswith("pallas"):
         from .pallas.histogram import build_hist_pallas
 
-        precision = method.split(":", 1)[1] if ":" in method else "bf16x2"
+        # default is the 15-bit fixed-point int8 MXU path (the reference
+        # GradientQuantiser idea, src/tree/gpu_hist/histogram.cu:55-100):
+        # fastest per level and deterministic; bf16x2 is the higher-precision
+        # fallback selectable via "pallas:bf16x2"
+        precision = method.split(":", 1)[1] if ":" in method else "int8x2"
         if bins_t is None:
             bins_t = bins.T
         return build_hist_pallas(bins_t, gpair, rel_pos, n_nodes, max_nbins,

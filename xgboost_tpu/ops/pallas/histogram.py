@@ -156,7 +156,7 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
                      "feat_block", "interpret"))
 def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
                       rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
-                      precision: str = "int8x2", block_rows: int = 1024,
+                      precision: str = "int8x2", block_rows: int = 2048,
                       feat_block: int = 8,
                       interpret: bool = False) -> jnp.ndarray:
     """Fused histogram kernel.

@@ -61,8 +61,10 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                     monotone: Optional[jnp.ndarray] = None,
                     node_lower: Optional[jnp.ndarray] = None,
                     node_upper: Optional[jnp.ndarray] = None,
-                    cat: Optional[CatInfo] = None) -> SplitResult:
-    """hist: [N, F, B, 2] with missing mass in slot B-1; parent_sum: [N, 2];
+                    cat: Optional[CatInfo] = None,
+                    has_missing: bool = True) -> SplitResult:
+    """hist: [N, F, B, 2] with missing mass in slot B-1 when ``has_missing``
+    (all B slots are real bins otherwise); parent_sum: [N, 2];
     n_real_bins: [F]; feature_mask: [F] or [N, F] bool (colsample /
     interaction constraints), True = usable.
 
@@ -71,16 +73,23 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     sign-violating splits are rejected (reference ``TreeEvaluator``,
     ``src/tree/split_evaluator.h:28``)."""
     N, F, B, _ = hist.shape
-    present = hist[:, :, : B - 1, :]                      # [N,F,B-1,2]
-    miss = hist[:, :, B - 1, :]                           # [N,F,2]
+    nb = B - 1 if has_missing else B                      # real-bin slots
+    present = hist[:, :, :nb, :]                          # [N,F,nb,2]
+    if has_missing:
+        miss = hist[:, :, B - 1, :]                       # [N,F,2]
+    else:
+        miss = jnp.zeros(hist.shape[:2] + (2,), hist.dtype)
     cum = jnp.cumsum(present, axis=2)                     # left sums, missing->right
     parent = parent_sum[:, None, None, :]
-    bins_idx = jnp.arange(B - 1, dtype=jnp.int32)
+    bins_idx = jnp.arange(nb, dtype=jnp.int32)
 
-    # dir 0 = missing right (default_left=False), dir 1 = missing left
-    left = jnp.stack([cum, cum + miss[:, :, None, :]], axis=3)  # [N,F,B-1,2dir,2]
-    base_valid = bins_idx[None, :, None] < n_real_bins[:, None, None]  # [F,B-1,1]
-    base_valid = jnp.broadcast_to(base_valid[None], (N, F, B - 1, 2))
+    # dir 0 = missing right (default_left=False), dir 1 = missing left;
+    # without missing values both directions coincide, so only dir 0 is built
+    n_dirs = 2 if has_missing else 1
+    dir_stack = [cum, cum + miss[:, :, None, :]][:n_dirs]
+    left = jnp.stack(dir_stack, axis=3)                   # [N,F,nb,dirs,2]
+    base_valid = bins_idx[None, :, None] < n_real_bins[:, None, None]  # [F,nb,1]
+    base_valid = jnp.broadcast_to(base_valid[None], (N, F, nb, n_dirs))
 
     if cat is not None:
         ic4 = cat.is_cat[None, :, None, None]          # vs [N,F,B-1,2dir]
@@ -92,17 +101,18 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         ratio = present[..., 0] / (present[..., 1] + param.reg_lambda + 1e-10)
         empty = present[..., 1] <= 0.0
         ratio = jnp.where(empty, jnp.inf, ratio)  # empty cats sort last
-        order = jnp.argsort(ratio, axis=2)                       # [N,F,B-1]
+        order = jnp.argsort(ratio, axis=2)                       # [N,F,nb]
         ranks = jnp.argsort(order, axis=2).astype(jnp.int32)
         sorted_hist = jnp.take_along_axis(present, order[..., None], axis=2)
         cums = jnp.cumsum(sorted_hist, axis=2)
-        left_sorted = jnp.stack([cums, cums + miss[:, :, None, :]], axis=3)
+        left_sorted = jnp.stack(
+            [cums, cums + miss[:, :, None, :]][:n_dirs], axis=3)
         # one-hot: right child = {category c}; missing follows the default
         # direction: dir 0 -> left = parent - hist[c] - miss (missing right),
         # dir 1 -> left = parent - hist[c] (missing left)
         left_oh = jnp.stack(
-            [parent - miss[:, :, None, :] - present, parent - present],
-            axis=3)
+            [parent - miss[:, :, None, :] - present,
+             parent - present][:n_dirs], axis=3)
         left = jnp.where(ic5, jnp.where(oh5, left_oh, left_sorted), left)
         # validity: sorted prefixes capped by max_cat_threshold
         cat_valid = jnp.where(
@@ -147,10 +157,10 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     flat = loss_chg.reshape(N, -1)
     best = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-    f_idx = (best // ((B - 1) * 2)).astype(jnp.int32)
-    rem = best % ((B - 1) * 2)
-    b_idx = (rem // 2).astype(jnp.int32)
-    d_idx = (rem % 2).astype(jnp.int32)
+    f_idx = (best // (nb * n_dirs)).astype(jnp.int32)
+    rem = best % (nb * n_dirs)
+    b_idx = (rem // n_dirs).astype(jnp.int32)
+    d_idx = (rem % n_dirs).astype(jnp.int32)
 
     nn = jnp.arange(N)
     best_left = left[nn, f_idx, b_idx, d_idx]             # [N,2]
@@ -167,13 +177,13 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     chosen_cat = cat.is_cat[f_idx]
     chosen_oh = cat.is_onehot[f_idx]
     # left-set mask over real bins of the winning feature
-    real = bins_idx[None, :] < n_real_bins[f_idx][:, None]        # [N,B-1]
+    real = bins_idx[None, :] < n_real_bins[f_idx][:, None]        # [N,nb]
     oh_mask = (bins_idx[None, :] != b_idx[:, None]) & real
-    win_rank = ranks[nn, f_idx]                                    # [N,B-1]
+    win_rank = ranks[nn, f_idx]                                    # [N,nb]
     sort_mask = (win_rank <= b_idx[:, None]) & real
     mask = jnp.where(chosen_oh[:, None], oh_mask, sort_mask) \
         & chosen_cat[:, None]
-    n_words = (B - 2) // 32 + 1
+    n_words = (nb - 1) // 32 + 1
     return SplitResult(
         gain=best_gain, feature=f_idx, bin=b_idx,
         default_left=d_idx.astype(bool), left_sum=best_left,

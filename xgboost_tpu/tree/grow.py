@@ -68,18 +68,21 @@ def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("param", "max_nbins", "hist_method", "axis_name"))
+    static_argnames=("param", "max_nbins", "hist_method", "axis_name",
+                     "has_missing"))
 def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
           tree_mask: jnp.ndarray, key: jax.Array,
           monotone: Optional[jnp.ndarray] = None,
           constraint_sets: Optional[jnp.ndarray] = None,
           cat: Optional[CatInfo] = None, *,
           param: TrainParam, max_nbins: int, hist_method: str = "auto",
-          axis_name: Optional[str] = None) -> GrownTree:
+          axis_name: Optional[str] = None,
+          has_missing: bool = True) -> GrownTree:
     n, F = bins.shape
     max_depth = param.max_depth
     max_nodes = 2 ** (max_depth + 1) - 1
-    missing_bin = max_nbins - 1
+    # out-of-range sentinel when the matrix carries no missing slot
+    missing_bin = max_nbins - 1 if has_missing else max_nbins
 
     def allreduce(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -101,7 +104,8 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     if constraint_sets is not None:
         # features used on the path to each node (interaction constraints)
         node_path = jnp.zeros((max_nodes, F), bool)
-    n_words = (max_nbins - 2) // 32 + 1 if cat is not None else 1
+    n_real_slots = max_nbins - 1 if has_missing else max_nbins
+    n_words = (n_real_slots - 1) // 32 + 1 if cat is not None else 1
     is_cat_split = jnp.zeros((max_nodes,), bool)
     cat_words = jnp.zeros((max_nodes, n_words), jnp.uint32)
 
@@ -172,7 +176,7 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             if monotone is not None else None,
             node_upper=node_upper[lo:lo + n_level]
             if monotone is not None else None,
-            cat=cat)
+            cat=cat, has_missing=has_missing)
 
         # a node exists at this level iff its parent split; it expands unless
         # the best gain fails the gamma / kRtEps test (reference prune rule).
@@ -293,9 +297,11 @@ class TreeGrower:
                  hist_method: str = "auto",
                  mesh: Optional[jax.sharding.Mesh] = None,
                  monotone: Optional[np.ndarray] = None,
-                 constraint_sets: Optional[np.ndarray] = None) -> None:
+                 constraint_sets: Optional[np.ndarray] = None,
+                 has_missing: bool = True) -> None:
         self.param = param
         self.max_nbins = max_nbins
+        self.has_missing = has_missing
         self.cuts = cuts
         self.hist_method = hist_method
         self.mesh = mesh
@@ -325,7 +331,8 @@ class TreeGrower:
             g = _grow(bins, gpair, n_real_bins, tree_mask, key,
                       self.monotone, self.constraint_sets, self.cat,
                       param=self.param, max_nbins=self.max_nbins,
-                      hist_method=self.hist_method, axis_name=None)
+                      hist_method=self.hist_method, axis_name=None,
+                      has_missing=self.has_missing)
         else:
             g = self._sharded(bins, gpair, n_real_bins, tree_mask, key)
         if self.param.max_leaves > 0:
@@ -393,7 +400,8 @@ class TreeGrower:
                              self.constraint_sets, self.cat,
                              param=self.param, max_nbins=self.max_nbins,
                              hist_method=self.hist_method,
-                             axis_name=DATA_AXIS)
+                             axis_name=DATA_AXIS,
+                             has_missing=self.has_missing)
 
             out_specs = GrownTree(
                 split_feature=P(), split_bin=P(), default_left=P(),

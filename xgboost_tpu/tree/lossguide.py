@@ -52,7 +52,7 @@ class LossguideGrown(NamedTuple):
 def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
            node_lower, node_upper, n_real_bins, monotone, cat, *,
            param: TrainParam, max_nbins: int, hist_method: str,
-           axis_name: Optional[str]):
+           axis_name: Optional[str], has_missing: bool = True):
     """Histogram + split enumeration for (up to) two sibling nodes."""
     rel = jnp.where(positions == id0, 0,
                     jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
@@ -62,7 +62,7 @@ def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
     return evaluate_splits(hist, parent_sums, n_real_bins, param,
                            feature_mask=fmask, monotone=monotone,
                            node_lower=node_lower, node_upper=node_upper,
-                           cat=cat)
+                           cat=cat, has_missing=has_missing)
 
 
 def _apply1(bins, positions, nid, feat, sbin, dleft, is_cat, words,
@@ -96,12 +96,14 @@ class LossguideGrower:
                  hist_method: str = "auto",
                  mesh: Optional[jax.sharding.Mesh] = None,
                  monotone: Optional[np.ndarray] = None,
-                 constraint_sets: Optional[np.ndarray] = None) -> None:
+                 constraint_sets: Optional[np.ndarray] = None,
+                 has_missing: bool = True) -> None:
         if param.max_leaves <= 0 and param.max_depth <= 0:
             raise ValueError(
                 "grow_policy=lossguide needs max_leaves > 0 or max_depth > 0")
         self.param = param
         self.max_nbins = max_nbins
+        self.has_missing = has_missing
         self.cuts = cuts
         self.hist_method = hist_method
         self.mesh = mesh
@@ -116,7 +118,8 @@ class LossguideGrower:
                 is_cat=jnp.asarray(is_cat),
                 is_onehot=jnp.asarray(
                     is_cat & (n_real <= param.max_cat_to_onehot)))
-            self.n_words = (max_nbins - 2) // 32 + 1
+            n_real_slots = max_nbins - 1 if has_missing else max_nbins
+            self.n_words = (n_real_slots - 1) // 32 + 1
         else:
             self.cat = None
             self.n_words = 1
@@ -129,7 +132,8 @@ class LossguideGrower:
         import functools
 
         kw = dict(param=self.param, max_nbins=self.max_nbins,
-                  hist_method=self.hist_method)
+                  hist_method=self.hist_method,
+                  has_missing=self.has_missing)
         if self.mesh is None:
             ev = functools.partial(_eval2, monotone=self.monotone,
                                    cat=self.cat, axis_name=None, **kw)
@@ -333,7 +337,8 @@ class LossguideGrower:
                 bins, positions, np.int32(nid), np.int32(feat),
                 np.int32(rbin), np.bool_(rdl), np.bool_(ric),
                 jnp.asarray(cwords[nid]), np.int32(li), np.int32(ri),
-                np.int32(self.max_nbins - 1))
+                np.int32(self.max_nbins - 1 if self.has_missing
+                         else self.max_nbins))
             eval_nodes(li, ri)
 
         # ---- finalize: weights, leaf values, TreeModel -----------------
