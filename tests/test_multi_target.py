@@ -1,0 +1,111 @@
+"""multi_strategy=multi_output_tree — vector-leaf trees (reference
+``MultiTargetTree``, src/tree/multi_target_tree_model.cc; multi builder
+src/tree/updater_quantile_hist.cc:117)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _data(n=4000, f=12, k=3, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    W = rng.randn(f, k).astype(np.float32)
+    Y = (X @ W + 0.1 * rng.randn(n, k)).astype(np.float32)
+    return X, Y
+
+
+def test_multi_output_tree_regression():
+    X, Y = _data()
+    dm = xgb.DMatrix(X, label=Y)
+    bst = xgb.train({"objective": "reg:squarederror",
+                     "multi_strategy": "multi_output_tree",
+                     "max_depth": 5, "eta": 0.3}, dm, 20, verbose_eval=False)
+    # one tree per round, not one per target
+    assert len(bst.gbm.trees) == 20
+    pred = bst.predict(dm)
+    assert pred.shape == Y.shape
+    base_mse = float(np.mean((Y - Y.mean(axis=0)) ** 2))
+    mse = float(np.mean((pred - Y) ** 2))
+    assert mse < 0.35 * base_mse
+
+
+def test_multi_output_tree_matches_shape_of_per_tree_strategy():
+    X, Y = _data(n=2000, k=2)
+    dm = xgb.DMatrix(X, label=Y)
+    a = xgb.train({"objective": "reg:squarederror",
+                   "multi_strategy": "multi_output_tree",
+                   "max_depth": 4}, dm, 5, verbose_eval=False)
+    b = xgb.train({"objective": "reg:squarederror",
+                   "max_depth": 4}, dm, 5, verbose_eval=False)
+    assert a.predict(dm).shape == b.predict(dm).shape
+    assert len(a.gbm.trees) == 5 and len(b.gbm.trees) == 10
+
+
+def test_multi_output_tree_save_load_roundtrip(tmp_path):
+    X, Y = _data(n=1500)
+    dm = xgb.DMatrix(X, label=Y)
+    bst = xgb.train({"objective": "reg:squarederror",
+                     "multi_strategy": "multi_output_tree",
+                     "max_depth": 4}, dm, 3, verbose_eval=False)
+    p1 = bst.predict(dm)
+    path = str(tmp_path / "multi.json")
+    bst.save_model(path)
+    p2 = xgb.Booster(model_file=path).predict(dm)
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_multi_output_tree_softprob():
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 8).astype(np.float32)
+    y = (X @ rng.randn(8, 3)).argmax(axis=1).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "multi:softprob", "num_class": 3,
+                     "multi_strategy": "multi_output_tree",
+                     "max_depth": 4}, dm, 10, verbose_eval=False)
+    assert len(bst.gbm.trees) == 10
+    pred = bst.predict(dm)
+    assert pred.shape == (3000, 3)
+    acc = float(np.mean(pred.argmax(axis=1) == y))
+    assert acc > 0.8
+
+
+def test_multi_output_tree_rejects_constraints():
+    X, Y = _data(n=500)
+    dm = xgb.DMatrix(X, label=Y)
+    with pytest.raises(NotImplementedError):
+        xgb.train({"objective": "reg:squarederror",
+                   "multi_strategy": "multi_output_tree",
+                   "monotone_constraints": "(1)"}, dm, 1, verbose_eval=False)
+
+
+def test_multi_output_tree_sharded_matches_single():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device platform")
+    X, Y = _data(n=4000)
+    params = {"objective": "reg:squarederror",
+              "multi_strategy": "multi_output_tree", "max_depth": 4}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=Y), 3, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": xgb.make_data_mesh()},
+                   xgb.DMatrix(X, label=Y), 3, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_multi_output_tree_eval_metric_and_dump():
+    X, Y = _data(n=2000)
+    dm = xgb.DMatrix(X, label=Y)
+    res = {}
+    bst = xgb.train({"objective": "reg:squarederror",
+                     "multi_strategy": "multi_output_tree", "max_depth": 4,
+                     "eval_metric": "rmse"}, dm, 5,
+                    evals=[(dm, "train")], evals_result=res,
+                    verbose_eval=False)
+    hist = res["train"]["rmse"]
+    assert hist[-1] < hist[0]
+    dump = bst.get_dump()
+    assert len(dump) == 5 and "leaf=[" in dump[0]
+    assert len(bst.trees_to_dataframe()) > 0

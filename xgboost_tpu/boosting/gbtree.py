@@ -32,7 +32,8 @@ class _PendingTree:
     __slots__ = ("arrays", "grower")
 
     def __init__(self, grown, grower) -> None:
-        self.arrays = {f: getattr(grown, f) for f in _GROWN_FIELDS}
+        self.arrays = {f: getattr(grown, f) for f in _GROWN_FIELDS
+                       if hasattr(grown, f)}
         self.grower = grower
 
 
@@ -59,7 +60,8 @@ class GBTree:
     def __init__(self, tree_param: TrainParam, n_groups: int,
                  num_parallel_tree: int = 1, hist_method: str = "auto",
                  mesh=None, monotone=None, constraint_sets=None,
-                 tree_method: str = "hist") -> None:
+                 tree_method: str = "hist",
+                 multi_strategy: str = "one_output_per_tree") -> None:
         self.tree_param = tree_param
         self.n_groups = n_groups
         self.num_parallel_tree = num_parallel_tree
@@ -68,6 +70,7 @@ class GBTree:
         self.monotone = monotone
         self.constraint_sets = constraint_sets
         self.tree_method = tree_method
+        self.multi_strategy = multi_strategy
         self._trees: List = []  # TreeModel | _PendingTree (device-side)
         self.tree_info: List[int] = []
         self.iteration_indptr: List[int] = [0]
@@ -132,6 +135,15 @@ class GBTree:
         info = state["info"]
         n, K = gpair.shape[0], gpair.shape[1]
         adaptive = obj is not None and hasattr(obj, "update_tree_leaf")
+        if self.multi_strategy == "multi_output_tree" and K > 1:
+            if adaptive:
+                raise NotImplementedError(
+                    "multi_output_tree does not support adaptive-leaf "
+                    "objectives")
+            if self.tree_method in ("exact", "approx"):
+                raise NotImplementedError(
+                    "multi_output_tree requires tree_method=hist")
+            return self._do_boost_multi(state, gpair, key)
         eta = self.tree_param.eta / max(self.num_parallel_tree, 1)
         exact = self.tree_method == "exact"
         if exact:
@@ -216,6 +228,42 @@ class GBTree:
         self.iteration_indptr.append(len(self._trees))
         return jnp.stack(deltas, axis=1)
 
+    def _do_boost_multi(self, state: dict, gpair: jnp.ndarray,
+                        key: jax.Array) -> jnp.ndarray:
+        """One vector-leaf tree covering all K outputs per round (reference
+        ``MultiTargetHistBuilder``, ``src/tree/updater_quantile_hist.cc:117``).
+        """
+        from ..tree.multi import MultiTargetGrower
+
+        binned = state["binned"]
+        n = gpair.shape[0]
+        if self._grower is None:
+            param = self.tree_param
+            if self.num_parallel_tree > 1:
+                param = param.clone()
+                param.eta = param.eta / self.num_parallel_tree
+            self._grower = MultiTargetGrower(
+                param, binned.max_nbins, binned.cuts,
+                hist_method=self.hist_method, mesh=self.mesh,
+                has_missing=binned.has_missing)
+        grower = self._grower
+        n_real = binned.n_real_bins()
+        delta = jnp.zeros(gpair.shape[:2], jnp.float32)
+        for p in range(self.num_parallel_tree):
+            tkey = jax.random.fold_in(key, p)
+            gp = gpair
+            if self.tree_param.subsample < 1.0:
+                mask = jax.random.bernoulli(
+                    jax.random.fold_in(tkey, 0x5AB),
+                    self.tree_param.subsample, (n,))
+                gp = gp * mask[:, None, None].astype(gp.dtype)
+            grown = grower.grow(binned.bins, gp, n_real, tkey)
+            delta = delta + grown.delta
+            self._trees.append(_PendingTree(grown, grower))
+            self.tree_info.append(0)
+        self.iteration_indptr.append(len(self._trees))
+        return delta
+
     # -- prediction interface (used by core.Booster) --------------------------
     supports_margin_cache = True
 
@@ -250,10 +298,13 @@ class GBTree:
         return None
 
     def _predictor(self, lo: int, hi: int):
+        from ..tree.multi import MultiForestPredictor, MultiTargetTreeModel
         from ..tree.tree import stack_forest
         from .predict import ForestPredictor
 
         trees = self.trees[lo:hi]
+        if trees and isinstance(trees[0], MultiTargetTreeModel):
+            return MultiForestPredictor(trees, self.n_groups)
         forest = stack_forest(trees)
         if forest is None:
             return None
@@ -325,13 +376,19 @@ class GBTree:
         return {
             "name": self.name,
             "num_parallel_tree": self.num_parallel_tree,
+            "multi_strategy": self.multi_strategy,
             "trees": [t.to_json() for t in self.trees],
             "tree_info": list(self.tree_info),
             "iteration_indptr": list(self.iteration_indptr),
         }
 
     def from_json(self, obj: dict) -> None:
+        from ..tree.multi import MultiTargetTreeModel
+
         self.num_parallel_tree = int(obj.get("num_parallel_tree", 1))
-        self.trees = [TreeModel.from_json(t) for t in obj["trees"]]
+        self.multi_strategy = obj.get("multi_strategy",
+                                      "one_output_per_tree")
+        self.trees = [MultiTargetTreeModel.from_json(t) if "n_targets" in t
+                      else TreeModel.from_json(t) for t in obj["trees"]]
         self.tree_info = [int(x) for x in obj["tree_info"]]
         self.iteration_indptr = [int(x) for x in obj["iteration_indptr"]]

@@ -35,6 +35,7 @@ _LEARNER_KEYS = {
     "num_parallel_tree", "tree_method", "device", "seed", "random_state",
     "nthread", "n_jobs", "verbosity", "disable_default_eval_metric",
     "hist_method", "validate_parameters", "seed_per_iteration",
+    "multi_strategy",
     # objective-specific passthroughs
     "scale_pos_weight", "huber_slope", "tweedie_variance_power",
     "quantile_alpha", "aft_loss_distribution", "aft_loss_distribution_scale",
@@ -203,13 +204,23 @@ class Booster:
             self.tree_param.interaction_constraints or None, nf,
             self.feature_names)
         tm = self.learner_params.get("tree_method", "auto")
+        ms = self.learner_params.get("multi_strategy", "one_output_per_tree")
+        if ms not in ("one_output_per_tree", "multi_output_tree"):
+            raise ValueError(f"unknown multi_strategy: {ms}")
+        if ms == "multi_output_tree" and (mono is not None or ics is not None
+                                          or name == "dart"):
+            raise NotImplementedError(
+                "multi_output_tree does not support monotone/interaction "
+                "constraints or the dart booster")
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
             hist_method=self.learner_params.get("hist_method", "auto"),
             mesh=self.ctx.mesh, monotone=mono, constraint_sets=ics,
-            tree_method=tm if tm in ("approx", "exact") else "hist")
+            tree_method=tm if tm in ("approx", "exact") else "hist",
+            multi_strategy=ms)
         if name == "dart":
+            kwargs.pop("multi_strategy")
             gbm = Dart(self.tree_param, n_groups, **kwargs)
             gbm.configure(self.learner_params)
             return gbm
@@ -381,7 +392,13 @@ class Booster:
             for st in self._caches.values():
                 st["margin"] = st["base"]
                 st["n_trees"] = 0
+        from .tree.multi import MultiTargetTreeModel
+
         old_trees, old_info, old_indptr = self._trees_to_update
+        if old_trees and isinstance(old_trees[0], MultiTargetTreeModel):
+            raise NotImplementedError(
+                "process_type=update does not support multi_output_tree "
+                "models")
         it = self.gbm.num_boosted_rounds()
         if it >= len(old_indptr) - 1:
             raise ValueError(
@@ -484,6 +501,14 @@ class Booster:
                 ) -> np.ndarray:
         self._configure(data if data.info.labels is not None else None)
         if pred_contribs or pred_interactions:
+            from .tree.multi import MultiTargetTreeModel
+
+            first = self.gbm.trees[0] if getattr(
+                self.gbm, "trees", None) else None
+            if isinstance(first, MultiTargetTreeModel):
+                raise NotImplementedError(
+                    "SHAP contributions are not supported for "
+                    "multi_output_tree models")
             return self._predict_contribs(
                 data, approx=approx_contribs, interactions=pred_interactions,
                 iteration_range=iteration_range, strict_shape=strict_shape)
@@ -632,7 +657,9 @@ class Booster:
         import copy
         new = copy.copy(self)
         new.gbm = GBTree(self.tree_param, self.n_groups,
-                         num_parallel_tree=self.gbm.num_parallel_tree)
+                         num_parallel_tree=self.gbm.num_parallel_tree,
+                         multi_strategy=getattr(self.gbm, "multi_strategy",
+                                                "one_output_per_tree"))
         indptr = self.gbm.iteration_indptr
         new.gbm.trees = []
         new.gbm.tree_info = []

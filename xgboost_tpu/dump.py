@@ -34,6 +34,15 @@ def _node_condition(tree: TreeModel, c: int,
     return f"{name}<{float(tree.split_value[c]):.9g}"
 
 
+def _fmt_leaf(v) -> str:
+    """Scalar leaf -> '0.5'; vector leaf (multi-target trees) -> '[a,b,c]'."""
+    import numpy as np
+
+    if np.ndim(v) == 0:
+        return f"{v:.9g}"
+    return "[" + ",".join(f"{x:.9g}" for x in np.asarray(v)) + "]"
+
+
 def dump_text(tree: TreeModel, feature_names: Optional[List[str]] = None,
               with_stats: bool = False) -> str:
     lines: List[str] = []
@@ -43,7 +52,8 @@ def dump_text(tree: TreeModel, feature_names: Optional[List[str]] = None,
         indent = "\t" * depth
         if tree.is_leaf[c]:
             stats = f",cover={tree.sum_hess[c]:.9g}" if with_stats else ""
-            lines.append(f"{indent}{c}:leaf={tree.leaf_value[c]:.9g}{stats}")
+            lines.append(
+                f"{indent}{c}:leaf={_fmt_leaf(tree.leaf_value[c])}{stats}")
             continue
         cond = _node_condition(tree, c, feature_names)
         yes, no = int(tree.left_child[c]), int(tree.right_child[c])
@@ -61,7 +71,10 @@ def dump_json(tree: TreeModel, feature_names: Optional[List[str]] = None,
               with_stats: bool = False) -> dict:
     def node(c: int, depth: int) -> dict:
         if tree.is_leaf[c]:
-            out = {"nodeid": c, "leaf": float(tree.leaf_value[c])}
+            lv = tree.leaf_value[c]
+            out = {"nodeid": c,
+                   "leaf": (float(lv) if getattr(lv, "ndim", 0) == 0
+                            else [float(x) for x in lv])}
             if with_stats:
                 out["cover"] = float(tree.sum_hess[c])
             return out
@@ -97,7 +110,7 @@ def dump_dot(tree: TreeModel, feature_names: Optional[List[str]] = None,
         c = stack.pop()
         if tree.is_leaf[c]:
             lines.append(
-                f'    {c} [label="leaf={tree.leaf_value[c]:.6g}" '
+                f'    {c} [label="leaf={_fmt_leaf(tree.leaf_value[c])}" '
                 f"shape=box]")
             continue
         cond = _node_condition(tree, c, feature_names)
@@ -126,7 +139,9 @@ def trees_to_dataframe(trees: List[TreeModel],
                     "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
                     "Feature": "Leaf", "Split": np.nan, "Yes": np.nan,
                     "No": np.nan, "Missing": np.nan,
-                    "Gain": float(tree.leaf_value[c]),
+                    "Gain": (float(tree.leaf_value[c])
+                             if getattr(tree.leaf_value[c], "ndim", 0) == 0
+                             else float(np.asarray(tree.leaf_value[c]).sum())),
                     "Cover": float(tree.sum_hess[c]),
                     "Category": np.nan,
                 })

@@ -25,6 +25,10 @@ class _WeightedMean(Metric):
         p = np.asarray(preds, dtype=np.float64).reshape(y.shape)
         w = self.weights_of(info, len(y))
         loss = self.per_row(p, y)
+        if loss.ndim > 1:
+            # multi-output: rows weighted, targets averaged (reference
+            # treats the [n, K] residual matrix as n*K weighted samples)
+            w = np.broadcast_to(w[:, None], loss.shape)
         return float(self.finalize(np.sum(loss * w) / np.sum(w)))
 
 

@@ -189,3 +189,74 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         default_left=d_idx.astype(bool), left_sum=best_left,
         right_sum=best_right, is_cat=chosen_cat,
         cat_words=_pack_mask(mask, n_words))
+
+
+class MultiSplitResult(NamedTuple):
+    gain: jnp.ndarray          # [N] summed-over-targets loss_chg
+    feature: jnp.ndarray       # [N] int32
+    bin: jnp.ndarray           # [N] int32
+    default_left: jnp.ndarray  # [N] bool
+    left_sum: jnp.ndarray      # [N, K, 2]
+    right_sum: jnp.ndarray     # [N, K, 2]
+
+
+def evaluate_splits_multi(hist: jnp.ndarray, parent_sum: jnp.ndarray,
+                          n_real_bins: jnp.ndarray, param: TrainParam,
+                          feature_mask: Optional[jnp.ndarray] = None,
+                          has_missing: bool = True) -> MultiSplitResult:
+    """Split enumeration for vector-leaf trees (reference ``HistMultiEvaluator``,
+    ``src/tree/hist/evaluate_splits.h:478``): one split is shared by all K
+    targets and scored by the SUM of per-target gains. ``min_child_weight``
+    is tested against the hessian summed over targets (reduces to the scalar
+    rule at K=1).
+
+    hist: [N, F, B, K, 2] per-target (g, h) sums; parent_sum: [N, K, 2].
+    """
+    N, F, B, K, _ = hist.shape
+    nb = B - 1 if has_missing else B
+    present = hist[:, :, :nb]                              # [N,F,nb,K,2]
+    if has_missing:
+        miss = hist[:, :, B - 1]                           # [N,F,K,2]
+    else:
+        miss = jnp.zeros((N, F, K, 2), hist.dtype)
+    cum = jnp.cumsum(present, axis=2)
+    parent = parent_sum[:, None, None, :, :]               # [N,1,1,K,2]
+    bins_idx = jnp.arange(nb, dtype=jnp.int32)
+
+    n_dirs = 2 if has_missing else 1
+    left = jnp.stack([cum, cum + miss[:, :, None]][:n_dirs],
+                     axis=3)                               # [N,F,nb,dirs,K,2]
+    right = parent[..., None, :, :] - left
+
+    lg, lh = left[..., 0], left[..., 1]                    # [N,F,nb,dirs,K]
+    rg, rh = right[..., 0], right[..., 1]
+    pgain = jnp.sum(calc_gain(parent_sum[..., 0], parent_sum[..., 1], param),
+                    axis=1)                                # [N]
+    loss_chg = (jnp.sum(calc_gain(lg, lh, param), axis=4)
+                + jnp.sum(calc_gain(rg, rh, param), axis=4)
+                - pgain[:, None, None, None])              # [N,F,nb,dirs]
+
+    base_valid = bins_idx[None, :, None] < n_real_bins[:, None, None]
+    valid = jnp.broadcast_to(base_valid[None], (N, F, nb, n_dirs)) \
+        & (jnp.sum(lh, axis=4) >= param.min_child_weight) \
+        & (jnp.sum(rh, axis=4) >= param.min_child_weight)
+    if feature_mask is not None:
+        fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+        valid = valid & fm[:, :, None, None]
+    loss_chg = jnp.where(valid, loss_chg, -jnp.inf)
+
+    flat = loss_chg.reshape(N, -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    f_idx = (best // (nb * n_dirs)).astype(jnp.int32)
+    rem = best % (nb * n_dirs)
+    b_idx = (rem // n_dirs).astype(jnp.int32)
+    d_idx = (rem % n_dirs).astype(jnp.int32)
+
+    nn = jnp.arange(N)
+    best_left = left[nn, f_idx, b_idx, d_idx]              # [N,K,2]
+    best_right = parent_sum - best_left
+    return MultiSplitResult(
+        gain=best_gain, feature=f_idx, bin=b_idx,
+        default_left=d_idx.astype(bool), left_sum=best_left,
+        right_sum=best_right)

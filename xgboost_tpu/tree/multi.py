@@ -1,0 +1,419 @@
+"""Vector-leaf trees — ``multi_strategy=multi_output_tree``.
+
+Reference: ``MultiTargetTree`` (``src/tree/multi_target_tree_model.cc``,
+``include/xgboost/multi_target_tree_model.h:23``) and the multi-target hist
+builder (``HistMultiEvaluator``, ``src/tree/hist/evaluate_splits.h:478``;
+``MultiTargetHistBuilder``, ``src/tree/updater_quantile_hist.cc:117``): ONE
+tree per boosting round whose every leaf holds a K-vector; a split is shared
+by all targets and scored by the summed per-target gain.
+
+TPU shape: the depth-wise jitted loop of grow.py, with the gradient matrix
+``[n, K, 2]``, per-level histograms ``[N, F, B, K, 2]`` (one fused Pallas
+histogram pass per target), and the per-row margin delta accumulated as an
+``[n, K]`` matrix via one ``[n, N] @ [N, K]`` one-hot matmul per level.
+Categorical splits, monotone and interaction constraints are not supported in
+this mode (the reference multi-target updater has the same restrictions).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import build_hist
+from ..ops.partition import advance_positions_level, update_positions
+from ..ops.split import evaluate_splits_multi
+from .param import TrainParam, calc_weight
+from .tree import TreeModel
+
+_EPS = 1e-6
+
+
+class GrownMulti(NamedTuple):
+    split_feature: jnp.ndarray  # [max_nodes] int32
+    split_bin: jnp.ndarray      # [max_nodes] int32
+    default_left: jnp.ndarray   # [max_nodes] bool
+    is_leaf: jnp.ndarray        # [max_nodes] bool
+    active: jnp.ndarray         # [max_nodes] bool
+    leaf_value: jnp.ndarray     # [max_nodes, K] f32 (eta applied)
+    node_sum: jnp.ndarray       # [max_nodes, K, 2] f32
+    gain: jnp.ndarray           # [max_nodes] f32
+    positions: jnp.ndarray      # [n] int32 final heap position
+    delta: jnp.ndarray          # [n, K] f32 margin update
+    base_weight: jnp.ndarray    # [max_nodes, K] f32
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("param", "max_nbins", "hist_method", "axis_name",
+                     "has_missing"))
+def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
+                n_real_bins: jnp.ndarray, tree_mask: jnp.ndarray,
+                key: jax.Array, *, param: TrainParam, max_nbins: int,
+                hist_method: str = "auto",
+                axis_name: Optional[str] = None,
+                has_missing: bool = True) -> GrownMulti:
+    n, F = bins.shape
+    K = gpair.shape[1]
+    max_depth = param.max_depth
+    max_nodes = 2 ** (max_depth + 1) - 1
+    missing_bin = max_nbins - 1 if has_missing else max_nbins
+
+    def allreduce(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    split_feature = jnp.full((max_nodes,), -1, jnp.int32)
+    split_bin = jnp.zeros((max_nodes,), jnp.int32)
+    default_left = jnp.zeros((max_nodes,), bool)
+    is_leaf = jnp.ones((max_nodes,), bool)
+    active = jnp.zeros((max_nodes,), bool).at[0].set(True)
+    gain = jnp.zeros((max_nodes,), jnp.float32)
+    node_sum = jnp.zeros((max_nodes, K, 2), jnp.float32)
+    node_sum = node_sum.at[0].set(allreduce(jnp.sum(gpair, axis=0)))
+    positions = jnp.zeros((n,), jnp.int32)
+    bins_f32 = bins.astype(jnp.float32)
+    bins_t = bins.T
+
+    DENSE_LEVEL_MAX = 64
+    dense_delta = 2 ** max_depth <= DENSE_LEVEL_MAX
+    delta = jnp.zeros((n, K), jnp.float32)
+
+    def level_weight(lo, n_level):
+        s = node_sum[lo:lo + n_level]                      # [N,K,2]
+        return calc_weight(s[..., 0], s[..., 1], param) * param.eta
+
+    from .grow import _sample_features
+
+    for depth in range(max_depth):
+        lo = 2 ** depth - 1
+        n_level = 2 ** depth
+        idx = lo + jnp.arange(n_level)
+
+        in_level = (positions >= lo) & (positions < lo + n_level)
+        rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
+        # one fused histogram pass per target (each is an independent MXU
+        # contraction; XLA overlaps their DMA pipelines)
+        hist = jnp.stack(
+            [build_hist(bins, gpair[:, k], rel, n_level, max_nbins,
+                        method=hist_method, bins_t=bins_t)
+             for k in range(K)], axis=3)                   # [N,F,B,K,2]
+        hist = allreduce(hist)
+
+        level_key = jax.random.fold_in(key, depth)
+        level_mask = _sample_features(level_key, tree_mask,
+                                      param.colsample_bylevel)
+        if param.colsample_bynode < 1.0:
+            node_keys = jax.random.split(jax.random.fold_in(level_key, 1),
+                                         n_level)
+            fmask = jax.vmap(
+                lambda k: _sample_features(k, level_mask,
+                                           param.colsample_bynode))(node_keys)
+        else:
+            fmask = level_mask[None, :]
+
+        res = evaluate_splits_multi(hist, node_sum[lo:lo + n_level],
+                                    n_real_bins, param, feature_mask=fmask,
+                                    has_missing=has_missing)
+
+        can_split = (active[lo:lo + n_level]
+                     & (res.gain > max(param.gamma, _EPS))
+                     & jnp.isfinite(res.gain))
+
+        split_feature = split_feature.at[idx].set(
+            jnp.where(can_split, res.feature, -1))
+        split_bin = split_bin.at[idx].set(jnp.where(can_split, res.bin, 0))
+        default_left = default_left.at[idx].set(can_split & res.default_left)
+        is_leaf = is_leaf.at[idx].set(~can_split)
+        gain = gain.at[idx].set(jnp.where(can_split, res.gain, 0.0))
+
+        li, ri = 2 * idx + 1, 2 * idx + 2
+        active = active.at[li].set(can_split).at[ri].set(can_split)
+        zero = jnp.zeros_like(res.left_sum)
+        node_sum = node_sum.at[li].set(
+            jnp.where(can_split[:, None, None], res.left_sum, zero))
+        node_sum = node_sum.at[ri].set(
+            jnp.where(can_split[:, None, None], res.right_sum, zero))
+
+        if dense_delta:
+            leaf_now = active[idx] & ~can_split
+            w_level = jnp.where(leaf_now[:, None],
+                                level_weight(lo, n_level), 0.0)    # [N,K]
+            rel_oh = (rel[:, None]
+                      == jnp.arange(n_level, dtype=jnp.int32)[None, :])
+            delta = delta + jax.lax.dot_general(
+                rel_oh.astype(jnp.float32), w_level,
+                (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST)
+
+        if n_level <= DENSE_LEVEL_MAX:
+            positions = advance_positions_level(
+                bins_f32, positions, rel,
+                jnp.where(can_split, res.feature, -1),
+                jnp.where(can_split, res.bin, 0),
+                can_split & res.default_left, can_split, missing_bin)
+        else:
+            is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(
+                can_split)
+            positions = update_positions(
+                bins, positions, split_feature, split_bin, default_left,
+                is_split_full, missing_bin)
+
+    w = calc_weight(node_sum[..., 0], node_sum[..., 1], param) * param.eta
+    leaf_mask = (active & is_leaf)[:, None]
+    leaf_value = jnp.where(leaf_mask, w, 0.0).astype(jnp.float32)
+    base_weight = jnp.where(active[:, None], w, 0.0).astype(jnp.float32)
+
+    if dense_delta:
+        lo = 2 ** max_depth - 1
+        n_level = 2 ** max_depth
+        w_last = jnp.where(active[lo:lo + n_level, None],
+                           level_weight(lo, n_level), 0.0)
+        rel = jnp.where(positions >= lo, positions - lo,
+                        n_level).astype(jnp.int32)
+        rel_oh = rel[:, None] == jnp.arange(n_level, dtype=jnp.int32)[None, :]
+        delta = delta + jax.lax.dot_general(
+            rel_oh.astype(jnp.float32), w_last, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)
+    else:
+        delta = leaf_value[positions]
+
+    return GrownMulti(split_feature=split_feature, split_bin=split_bin,
+                      default_left=default_left, is_leaf=is_leaf,
+                      active=active, leaf_value=leaf_value,
+                      node_sum=node_sum, gain=gain, positions=positions,
+                      delta=delta, base_weight=base_weight)
+
+
+class MultiTargetTreeModel(TreeModel):
+    """Compact BFS tree whose ``leaf_value`` / ``base_weight`` are [n, K]
+    (reference ``MultiTargetTree``). ``sum_hess`` keeps the target-summed
+    hessian so cover-based importances stay defined."""
+
+    @property
+    def n_targets(self) -> int:
+        return self.leaf_value.shape[1]
+
+    def to_json(self) -> dict:
+        # the scalar schema mixes thresholds and leaf values in
+        # split_conditions; with vector leaves, thresholds stay there and the
+        # leaf/base-weight matrices ride in their own fields
+        return {
+            "n_targets": self.n_targets,
+            "left_children": self.left_child.tolist(),
+            "right_children": self.right_child.tolist(),
+            "parents": self.parent.tolist(),
+            "split_indices": [int(max(f, 0)) for f in self.split_feature],
+            "split_conditions": [float(v) for v in self.split_value],
+            "default_left": [int(d) for d in self.default_left],
+            "loss_changes": self.gain.tolist(),
+            "sum_hessian": self.sum_hess.tolist(),
+            "split_bins": self.split_bin.tolist(),
+            "leaf_values": self.leaf_value.tolist(),
+            "base_weights": self.base_weight.tolist(),
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "MultiTargetTreeModel":
+        base = TreeModel.from_json({**obj, "base_weights":
+                                    [0.0] * len(obj["left_children"])})
+        lv = np.asarray(obj["leaf_values"], np.float32)
+        bw = np.asarray(obj["base_weights"], np.float32)
+        return MultiTargetTreeModel(
+            left_child=base.left_child, right_child=base.right_child,
+            parent=base.parent, split_feature=base.split_feature,
+            split_bin=base.split_bin,
+            split_value=np.asarray(obj["split_conditions"], np.float32),
+            default_left=base.default_left, is_leaf=base.is_leaf,
+            leaf_value=np.where(base.is_leaf[:, None], lv, 0.0),
+            sum_hess=base.sum_hess, gain=base.gain, base_weight=bw)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_margin_multi(split_feature, split_value, default_left, is_leaf,
+                          left_child, right_child, leaf_value, X, base,
+                          max_depth: int):
+    """leaf_value: [T, M, K] -> (margin [n, K], leaf pos [n, T])."""
+    n = X.shape[0]
+    T, M, K = leaf_value.shape
+    pos = jnp.zeros((n, T), jnp.int32)
+    tofs = (jnp.arange(T, dtype=jnp.int32) * M)[None, :]
+    sf = split_feature.reshape(-1)
+    sv = split_value.reshape(-1)
+    dl = default_left.reshape(-1)
+    lf = is_leaf.reshape(-1)
+    lc = left_child.reshape(-1)
+    rc = right_child.reshape(-1)
+    for _ in range(max_depth):
+        gi = tofs + pos
+        feat = sf[gi]
+        x = jnp.take_along_axis(X, jnp.maximum(feat, 0), axis=1)
+        go_right = x > sv[gi]
+        go_right = jnp.where(jnp.isnan(x), ~dl[gi], go_right)
+        child = jnp.where(go_right, rc[gi], lc[gi])
+        pos = jnp.where(lf[gi], pos, child)
+    leaf = leaf_value.reshape(T * M, K)[tofs + pos]        # [n, T, K]
+    return jnp.sum(leaf, axis=1) + base[None, :], pos
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "missing_bin"))
+def _predict_margin_binned_multi(split_feature, split_bin, default_left,
+                                 is_leaf, left_child, right_child,
+                                 leaf_value, bins, base, max_depth: int,
+                                 missing_bin: int):
+    n = bins.shape[0]
+    T, M, K = leaf_value.shape
+    pos = jnp.zeros((n, T), jnp.int32)
+    tofs = (jnp.arange(T, dtype=jnp.int32) * M)[None, :]
+    sf = split_feature.reshape(-1)
+    sb = split_bin.reshape(-1)
+    dl = default_left.reshape(-1)
+    lf = is_leaf.reshape(-1)
+    lc = left_child.reshape(-1)
+    rc = right_child.reshape(-1)
+    for _ in range(max_depth):
+        gi = tofs + pos
+        feat = sf[gi]
+        b = jnp.take_along_axis(bins, jnp.maximum(feat, 0).astype(jnp.int32),
+                                axis=1).astype(jnp.int32)
+        go_right = b > sb[gi]
+        go_right = jnp.where(b == missing_bin, ~dl[gi], go_right)
+        child = jnp.where(go_right, rc[gi], lc[gi])
+        pos = jnp.where(lf[gi], pos, child)
+    leaf = leaf_value.reshape(T * M, K)[tofs + pos]
+    return jnp.sum(leaf, axis=1) + base[None, :], pos
+
+
+class MultiForestPredictor:
+    """Batched inference over a list of vector-leaf trees."""
+
+    def __init__(self, trees: List[MultiTargetTreeModel],
+                 n_groups: int) -> None:
+        cap = max(t.num_nodes() for t in trees)
+        K = trees[0].n_targets
+        T = len(trees)
+        self.max_depth = max(t.max_depth() for t in trees)
+
+        def pad1(vals, fill, dtype):
+            out = np.full((T, cap), fill, dtype)
+            for i, v in enumerate(vals):
+                out[i, : len(v)] = v
+            return out
+
+        lv = np.zeros((T, cap, K), np.float32)
+        for i, t in enumerate(trees):
+            lv[i, : t.num_nodes()] = t.leaf_value
+        self.dev: Dict[str, jnp.ndarray] = {
+            "split_feature": jnp.asarray(
+                pad1([t.split_feature for t in trees], -1, np.int32)),
+            "split_value": jnp.asarray(
+                pad1([t.split_value for t in trees], 0, np.float32)),
+            "split_bin": jnp.asarray(
+                pad1([t.split_bin for t in trees], 0, np.int32)),
+            "default_left": jnp.asarray(
+                pad1([t.default_left for t in trees], False, bool)),
+            "is_leaf": jnp.asarray(
+                pad1([t.is_leaf for t in trees], True, bool)),
+            "left_child": jnp.asarray(
+                pad1([t.left_child for t in trees], -1, np.int32)),
+            "right_child": jnp.asarray(
+                pad1([t.right_child for t in trees], -1, np.int32)),
+            "leaf_value": jnp.asarray(lv),
+        }
+
+    def margin(self, X, base):
+        d = self.dev
+        return _predict_margin_multi(
+            d["split_feature"], d["split_value"], d["default_left"],
+            d["is_leaf"], d["left_child"], d["right_child"], d["leaf_value"],
+            jnp.asarray(X, jnp.float32), jnp.asarray(base, jnp.float32),
+            self.max_depth)
+
+    def margin_binned(self, bins, missing_bin: int, base):
+        d = self.dev
+        return _predict_margin_binned_multi(
+            d["split_feature"], d["split_bin"], d["default_left"],
+            d["is_leaf"], d["left_child"], d["right_child"], d["leaf_value"],
+            bins, jnp.asarray(base, jnp.float32), self.max_depth,
+            missing_bin)
+
+
+class MultiTargetGrower:
+    """Host-side wrapper mirroring grow.TreeGrower for vector-leaf trees."""
+
+    def __init__(self, param: TrainParam, max_nbins: int, cuts,
+                 hist_method: str = "auto",
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 has_missing: bool = True) -> None:
+        if param.grow_policy == "lossguide":
+            raise NotImplementedError(
+                "multi_output_tree supports grow_policy=depthwise only")
+        if param.max_leaves > 0:
+            raise NotImplementedError(
+                "multi_output_tree does not support max_leaves")
+        self.param = param
+        self.max_nbins = max_nbins
+        self.cuts = cuts
+        self.hist_method = hist_method
+        self.mesh = mesh
+        self.has_missing = has_missing
+        self._sharded_fn = None
+
+    def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
+             n_real_bins: jnp.ndarray, key: jax.Array) -> GrownMulti:
+        from .grow import _sample_features
+
+        F = bins.shape[1]
+        tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
+                                     jnp.ones((F,), bool),
+                                     self.param.colsample_bytree)
+        key = jax.random.fold_in(key, 0x5EED)
+        if self.mesh is None:
+            return _grow_multi(bins, gpair, n_real_bins, tree_mask, key,
+                               param=self.param, max_nbins=self.max_nbins,
+                               hist_method=self.hist_method, axis_name=None,
+                               has_missing=self.has_missing)
+        return self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+
+    def _sharded(self, bins, gpair, n_real_bins, tree_mask, key):
+        from ..context import DATA_AXIS
+
+        if self._sharded_fn is None:
+            P = jax.sharding.PartitionSpec
+
+            def inner(b, g, nr, tm, k):
+                return _grow_multi(b, g, nr, tm, k, param=self.param,
+                                   max_nbins=self.max_nbins,
+                                   hist_method=self.hist_method,
+                                   axis_name=DATA_AXIS,
+                                   has_missing=self.has_missing)
+
+            out_specs = GrownMulti(
+                split_feature=P(), split_bin=P(), default_left=P(),
+                is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
+                gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS, None),
+                base_weight=P())
+            self._sharded_fn = jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None, None), P(),
+                          P(), P()),
+                out_specs=out_specs))
+        return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
+
+    def to_tree_model(self, g) -> MultiTargetTreeModel:
+        """Accepts a GrownMulti with device or host arrays (duck-typed)."""
+        sf = np.asarray(g.split_feature)
+        sb = np.asarray(g.split_bin)
+        node_sum = np.asarray(g.node_sum)
+        return MultiTargetTreeModel.from_heap(
+            split_feature=sf, split_bin=sb,
+            split_value=self.cuts.split_values(sf, sb),
+            default_left=np.asarray(g.default_left),
+            is_leaf=np.asarray(g.is_leaf), active=np.asarray(g.active),
+            leaf_value=np.asarray(g.leaf_value),
+            sum_hess=node_sum[:, :, 1].sum(axis=1),
+            gain=np.asarray(g.gain),
+            base_weight=np.asarray(g.base_weight))

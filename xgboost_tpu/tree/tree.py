@@ -77,13 +77,15 @@ class TreeModel:
         return int(self.depths().max(initial=0))
 
     # --- construction --------------------------------------------------------
-    @staticmethod
-    def from_heap(split_feature, split_bin, split_value, default_left,
+    @classmethod
+    def from_heap(cls, split_feature, split_bin, split_value, default_left,
                   is_leaf, active, leaf_value, sum_hess, gain,
                   is_cat_split=None, cat_words=None,
                   base_weight=None) -> "TreeModel":
         """Compact a heap-layout tree (node i children 2i+1/2i+2, ``active``
-        marks nodes that exist). Keeps BFS order, records ``heap_map``."""
+        marks nodes that exist). Keeps BFS order, records ``heap_map``.
+        ``leaf_value``/``base_weight`` may carry trailing target dims
+        (vector-leaf subclasses)."""
         cap = len(is_leaf)
         order: List[int] = []
         heap_map = np.full(cap, -1, np.int32)
@@ -110,7 +112,7 @@ class TreeModel:
         parent = np.full(n, -1, np.int32)
         parent[left[internal]] = np.nonzero(internal)[0]
         parent[right[internal]] = np.nonzero(internal)[0]
-        t = TreeModel(
+        t = cls(
             left_child=left, right_child=right, parent=parent,
             split_feature=np.where(internal,
                                    np.asarray(split_feature)[o],
