@@ -1,0 +1,159 @@
+"""CLI + file loading (reference src/cli_main.cc, DMatrix::Load)."""
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.cli import main as cli_main
+
+
+@pytest.fixture(scope="module")
+def libsvm_files(tmp_path_factory):
+    rng = np.random.RandomState(11)
+    d = tmp_path_factory.mktemp("cli")
+    paths = {}
+    w = rng.randn(6)
+    for name, n in (("train", 2000), ("test", 500)):
+        X = rng.randn(n, 6).astype(np.float32)
+        y = (X @ w > 0).astype(int)
+        mask = rng.rand(n, 6) < 0.3  # sparse: missing entries
+        p = d / f"{name}.libsvm"
+        with open(p, "w") as fh:
+            for i in range(n):
+                feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(6)
+                                 if not mask[i, j])
+                fh.write(f"{y[i]} {feats}\n")
+        paths[name] = (str(p), X, y, mask)
+    return paths
+
+
+def test_dmatrix_from_libsvm(libsvm_files):
+    path, X, y, mask = libsvm_files["train"]
+    dm = xgb.DMatrix(path)
+    assert dm.num_row() == len(y) and dm.num_col() == 6
+    np.testing.assert_array_equal(dm.info.labels, y.astype(np.float32))
+    got = dm.X
+    assert np.isnan(got[mask]).all()            # absent -> missing
+    np.testing.assert_allclose(got[~mask], X[~mask], atol=1e-5)
+
+
+def test_native_matches_python_parser(libsvm_files):
+    from xgboost_tpu.data.fileio import _parse_native, _parse_python
+
+    path = libsvm_files["train"][0]
+    nat = _parse_native(path, False, ",")
+    if nat is None:
+        pytest.skip("no native toolchain")
+    py = _parse_python(path, False, ",")
+    for a, b in zip(nat[:4], py[:4]):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    assert nat[5] == py[5]
+
+
+def test_dmatrix_from_csv(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.randn(300, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    p = tmp_path / "d.csv"
+    with open(p, "w") as fh:
+        for i in range(300):
+            fh.write(f"{y[i]:.1f}," + ",".join(
+                f"{v:.6f}" for v in X[i]) + "\n")
+    dm = xgb.DMatrix(f"{p}?format=csv&label_column=0")
+    assert dm.num_row() == 300 and dm.num_col() == 4
+    np.testing.assert_allclose(dm.info.labels, y, atol=1e-6)
+    np.testing.assert_allclose(dm.X, X, atol=1e-5)
+
+
+def test_cli_train_pred_dump(libsvm_files, tmp_path):
+    train_path = libsvm_files["train"][0]
+    test_path, Xt, yt, _ = libsvm_files["test"]
+    model = str(tmp_path / "m.json")
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\n"
+        "objective = binary:logistic\n"
+        "max_depth = 4\n"
+        "eta = 0.5\n"
+        "num_round = 8\n"
+        f"data = {train_path}\n"
+        f'eval[test] = "{test_path}"\n'
+        f"model_out = {model}\n"
+        "silent = 1\n")
+    assert cli_main([str(conf)]) == 0
+    assert os.path.exists(model)
+
+    pred_out = str(tmp_path / "pred.txt")
+    pconf = tmp_path / "pred.conf"
+    pconf.write_text(
+        "task = pred\n"
+        f"model_in = {model}\n"
+        f"test:data = {test_path}\n"
+        f"name_pred = {pred_out}\n"
+        "silent = 1\n")
+    assert cli_main([str(pconf)]) == 0
+    preds = np.loadtxt(pred_out)
+    assert preds.shape == (500,)
+    acc = float(np.mean((preds > 0.5) == yt))
+    assert acc > 0.75
+    # CLI prediction matches API prediction on the same model
+    api = xgb.Booster(model_file=model).predict(xgb.DMatrix(test_path))
+    np.testing.assert_allclose(preds, api, atol=1e-6)
+
+    dump_out = str(tmp_path / "dump.txt")
+    dconf = tmp_path / "dump.conf"
+    dconf.write_text(
+        "task = dump\n"
+        f"model_in = {model}\n"
+        f"name_dump = {dump_out}\n"
+        "dump_stats = 1\n"
+        "silent = 1\n")
+    assert cli_main([str(dconf)]) == 0
+    text = open(dump_out).read()
+    assert "booster[0]" in text and "leaf=" in text
+
+    # command-line override: retrain with fewer rounds
+    model2 = str(tmp_path / "m2.json")
+    assert cli_main([str(conf), "num_round=2", f"model_out={model2}"]) == 0
+    b2 = xgb.Booster(model_file=model2)
+    assert b2.num_boosted_rounds() == 2
+
+
+def test_cli_ranking_qid(tmp_path):
+    rng = np.random.RandomState(7)
+    p = tmp_path / "rank.libsvm"
+    with open(p, "w") as fh:
+        for q in range(50):
+            for _ in range(8):
+                rel = rng.randint(0, 3)
+                feats = " ".join(f"{j}:{rng.randn():.4f}" for j in range(4))
+                fh.write(f"{rel} qid:{q} {feats}\n")
+    dm = xgb.DMatrix(str(p))
+    assert dm.info.group_ptr is not None
+    assert len(dm.info.group_ptr) == 51
+    bst = xgb.train({"objective": "rank:ndcg", "max_depth": 3}, dm, 3,
+                    verbose_eval=False)
+    assert bst.num_boosted_rounds() == 3
+
+
+def test_tsv_and_trailing_separator(tmp_path):
+    from xgboost_tpu.data.fileio import _parse_native, _parse_python
+
+    p = tmp_path / "d.tsv"
+    p.write_text("1.0\t2.0\t3.0\n4.0\t\t6.0\n")
+    py = _parse_python(str(p), True, "\t")
+    assert py[5] == 3
+    nat = _parse_native(str(p), True, "\t")
+    if nat is not None:
+        assert nat[5] == 3
+        np.testing.assert_allclose(nat[2], py[2], atol=1e-6, equal_nan=True)
+    # trailing separator keeps an empty (missing) last field, both parsers
+    q = tmp_path / "t.csv"
+    q.write_text("1,2,\n3,4,\n")
+    py = _parse_python(str(q), True, ",")
+    assert py[5] == 3 and np.isnan(py[2][2])
+    nat = _parse_native(str(q), True, ",")
+    if nat is not None:
+        assert nat[5] == 3
+        np.testing.assert_allclose(nat[2], py[2], atol=1e-6, equal_nan=True)

@@ -1,0 +1,7 @@
+"""``python -m xgboost_tpu <config> [key=value ...]`` — the CLI entry point
+(reference ``src/cli_main.cc``)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

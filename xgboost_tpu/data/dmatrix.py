@@ -12,6 +12,7 @@ sharing as in ``GetCutsFromRef``, ``src/data/iterative_dmatrix.cc:54-93``).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Iterator, List, Optional
 
@@ -62,6 +63,23 @@ class DMatrix:
                  group: Any = None, qid: Any = None,
                  label_lower_bound: Any = None, label_upper_bound: Any = None,
                  enable_categorical: bool = False) -> None:
+        if isinstance(data, (str, os.PathLike)):
+            # URI load (reference DMatrix::Load, src/data/data.cc:853):
+            # libsvm/csv text through the native parser + aux sidecar files
+            from .fileio import load_uri
+
+            loaded = load_uri(str(data))
+            data = loaded["X"]
+            if label is None:
+                label = loaded.get("label")
+            if weight is None:
+                weight = loaded.get("weight")
+            if base_margin is None:
+                base_margin = loaded.get("base_margin")
+            if group is None and qid is None:
+                group = loaded.get("group")
+                if group is None:
+                    qid = loaded.get("qid")
         X, names, types = to_dense(data, missing, feature_names, feature_types)
         self.X = X
         self.info = MetaInfo(feature_names=names, feature_types=types)

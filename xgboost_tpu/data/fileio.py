@@ -1,0 +1,156 @@
+"""File loading for ``DMatrix(path)`` — libsvm / CSV URIs.
+
+Reference: ``DMatrix::Load`` (``src/data/data.cc:853``) routes URIs of the
+form ``path[?format=libsvm|csv[&label_column=k]][#cachename]`` through the
+dmlc-core text parsers; auxiliary ``path.group`` / ``path.weight`` /
+``path.base_margin`` files attach ranking groups, instance weights and base
+margins. The parse itself runs in the native C++ runtime
+(``native/text_parser.cc``, multi-threaded chunked scan) with a pure-Python
+fallback; absent entries in sparse (libsvm) input are MISSING — not zero —
+matching the reference's sparse semantics, so the dense matrix is filled
+with NaN.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+from urllib.parse import parse_qs
+
+import numpy as np
+
+
+def parse_uri(uri: str) -> Tuple[str, str, int]:
+    """-> (path, format, label_column). The '#cache' suffix (external-memory
+    cache prefix in the reference) is accepted and stripped: this framework
+    keeps pages in host RAM, so no disk cache is needed."""
+    cache_split = uri.split("#", 1)
+    rest = cache_split[0]
+    fmt = "auto"
+    label_column = 0
+    if "?" in rest:
+        rest, query = rest.split("?", 1)
+        q = parse_qs(query)
+        fmt = q.get("format", ["auto"])[0]
+        label_column = int(q.get("label_column", ["0"])[0])
+    if fmt == "auto":
+        ext = os.path.splitext(rest)[1].lower()
+        fmt = "csv" if ext in (".csv", ".tsv") else "libsvm"
+    return rest, fmt, label_column
+
+
+def _parse_native(path: str, csv: bool, sep: str):
+    from .. import native
+
+    lib = native.load()
+    if lib is None:
+        return None
+    lib.xtpu_parse_text.restype = ctypes.c_void_p
+    lib.xtpu_parse_text.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_char, ctypes.c_int]
+    h = lib.xtpu_parse_text(path.encode(), int(csv), sep.encode(), 0)
+    if not h:
+        raise FileNotFoundError(path)
+    try:
+        lib.xtpu_parsed_rows.restype = ctypes.c_int64
+        lib.xtpu_parsed_nnz.restype = ctypes.c_int64
+        lib.xtpu_parsed_cols.restype = ctypes.c_int32
+        lib.xtpu_parsed_has_qid.restype = ctypes.c_int32
+        for fn in (lib.xtpu_parsed_rows, lib.xtpu_parsed_nnz,
+                   lib.xtpu_parsed_cols, lib.xtpu_parsed_has_qid):
+            fn.argtypes = [ctypes.c_void_p]
+        rows = lib.xtpu_parsed_rows(h)
+        nnz = lib.xtpu_parsed_nnz(h)
+        cols = lib.xtpu_parsed_cols(h)
+        has_qid = bool(lib.xtpu_parsed_has_qid(h))
+        indptr = np.empty(rows + 1, np.int64)
+        indices = np.empty(nnz, np.int32)
+        values = np.empty(nnz, np.float32)
+        labels = np.empty(rows, np.float32)
+        qids = np.empty(rows, np.float32)
+        lib.xtpu_parsed_fill.argtypes = [ctypes.c_void_p] + \
+            [np.ctypeslib.ndpointer(dtype=d) for d in
+             (np.int64, np.int32, np.float32, np.float32, np.float32)]
+        lib.xtpu_parsed_fill(h, indptr, indices, values, labels, qids)
+    finally:
+        lib.xtpu_parsed_free.argtypes = [ctypes.c_void_p]
+        lib.xtpu_parsed_free(h)
+    return indptr, indices, values, labels, (qids if has_qid else None), cols
+
+
+def _parse_python(path: str, csv: bool, sep: str):
+    """Pure-Python fallback mirroring the native parser's semantics."""
+    indptr = [0]
+    indices: list = []
+    values: list = []
+    labels: list = []
+    qids: list = []
+    has_qid = False
+    cols = 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0]
+            # in CSV/TSV mode the separator may be '\t', which a plain
+            # strip() would eat off the end (dropping a trailing empty field)
+            line = line.strip("\n\r ") if csv else line.strip()
+            if not line:
+                continue
+            if csv:
+                parts = line.split(sep)
+                for j, tok in enumerate(parts):
+                    tok = tok.strip()
+                    indices.append(j)
+                    values.append(float(tok) if tok else np.nan)
+                cols = max(cols, len(parts))
+                labels.append(0.0)
+                qids.append(0.0)
+                indptr.append(len(values))
+            else:
+                toks = line.split()
+                labels.append(float(toks[0]))
+                qid = 0.0
+                for tok in toks[1:]:
+                    k, v = tok.split(":", 1)
+                    if k == "qid":
+                        qid = float(v)
+                        has_qid = True
+                        continue
+                    idx = int(k)
+                    indices.append(idx)
+                    values.append(float(v))
+                    cols = max(cols, idx + 1)
+                qids.append(qid)
+                indptr.append(len(values))
+    return (np.asarray(indptr, np.int64), np.asarray(indices, np.int32),
+            np.asarray(values, np.float32), np.asarray(labels, np.float32),
+            np.asarray(qids, np.float32) if has_qid else None, cols)
+
+
+def load_uri(uri: str):
+    """Load a data file URI -> dict with X (dense f32, NaN=missing), label,
+    qid, weight, group, base_margin (aux-file sidecars when present)."""
+    path, fmt, label_column = parse_uri(uri)
+    csv = fmt == "csv"
+    sep = "\t" if path.endswith(".tsv") else ","
+    if fmt not in ("csv", "libsvm"):
+        raise ValueError(f"unsupported data format: {fmt}")
+    parsed = _parse_native(path, csv, sep)
+    if parsed is None:
+        parsed = _parse_python(path, csv, sep)
+    indptr, indices, values, labels, qids, cols = parsed
+    n = len(indptr) - 1
+    X = np.full((n, cols), np.nan, np.float32)
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    X[rows, indices] = values
+    if csv:
+        # dense format: one column is the label (reference dense_parser
+        # label_column convention)
+        labels = X[:, label_column].copy()
+        X = np.delete(X, label_column, axis=1)
+    out = {"X": X, "label": labels, "qid": qids}
+    for key in ("group", "weight", "base_margin"):
+        side = f"{path}.{key}"
+        if os.path.exists(side):
+            out[key] = np.loadtxt(side, ndmin=1)
+    return out
