@@ -116,3 +116,52 @@ def test_distributed_sketch_matches_global():
         cdf_g = np.searchsorted(col, global_cuts.values[lo_g:hi_g - 1]) / len(col)
         k = min(len(cdf_d), len(cdf_g))
         assert np.abs(cdf_d[:k] - cdf_g[:k]).max() < 0.05
+
+
+def test_col_split_matches_single_device(mesh):
+    """data_split_mode=col (reference DataSplitMode::kCol): features sharded,
+    local split finding + best-split allgather + decision-psum broadcast."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(3000, 13).astype(np.float32)  # 13 -> pads to 16 columns
+    y = (X @ rng.randn(13) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_with_missing(mesh):
+    rng = np.random.RandomState(4)
+    X = rng.randn(2000, 10).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(10) > 0).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.25] = np.nan
+    params = {"objective": "reg:squarederror", "max_depth": 4}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_requires_mesh():
+    X = np.random.RandomState(0).randn(100, 4).astype(np.float32)
+    with pytest.raises(ValueError):
+        xgb.train({"data_split_mode": "col"},
+                  xgb.DMatrix(X, label=X[:, 0]), 1, verbose_eval=False)
+
+
+def test_gradient_based_sampling_trains(mesh):
+    rng = np.random.RandomState(9)
+    X = rng.randn(3000, 8).astype(np.float32)
+    y = (X @ rng.randn(8) > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    res = {}
+    xgb.train({"objective": "binary:logistic", "max_depth": 4,
+               "subsample": 0.3, "sampling_method": "gradient_based",
+               "eval_metric": "auc"}, dm, 10, evals=[(dm, "t")],
+              evals_result=res, verbose_eval=False)
+    assert res["t"]["auc"][-1] > 0.9

@@ -61,7 +61,8 @@ class GBTree:
                  num_parallel_tree: int = 1, hist_method: str = "auto",
                  mesh=None, monotone=None, constraint_sets=None,
                  tree_method: str = "hist",
-                 multi_strategy: str = "one_output_per_tree") -> None:
+                 multi_strategy: str = "one_output_per_tree",
+                 split_mode: str = "row") -> None:
         self.tree_param = tree_param
         self.n_groups = n_groups
         self.num_parallel_tree = num_parallel_tree
@@ -71,6 +72,7 @@ class GBTree:
         self.constraint_sets = constraint_sets
         self.tree_method = tree_method
         self.multi_strategy = multi_strategy
+        self.split_mode = split_mode
         self._trees: List = []  # TreeModel | _PendingTree (device-side)
         self.tree_info: List[int] = []
         self.iteration_indptr: List[int] = [0]
@@ -115,11 +117,14 @@ class GBTree:
                 cls = LossguideGrower
             else:
                 cls = TreeGrower
+            kw = {}
+            if cls is TreeGrower:
+                kw["split_mode"] = self.split_mode
             self._grower = cls(param, binned.max_nbins, binned.cuts,
                                hist_method=self.hist_method,
                                mesh=self.mesh, monotone=self.monotone,
                                constraint_sets=self.constraint_sets,
-                               has_missing=binned.has_missing)
+                               has_missing=binned.has_missing, **kw)
         return self._grower
 
     def do_boost(self, state: dict, gpair: jnp.ndarray,
@@ -190,10 +195,27 @@ class GBTree:
                 tkey = jax.random.fold_in(key, k * self.num_parallel_tree + p)
                 gp = gpair[:, k, :]
                 if self.tree_param.subsample < 1.0:
-                    mask = jax.random.bernoulli(
-                        jax.random.fold_in(tkey, 0x5AB),
-                        self.tree_param.subsample, (n,))
-                    gp = gp * mask[:, None].astype(gp.dtype)
+                    skey = jax.random.fold_in(tkey, 0x5AB)
+                    if self.tree_param.sampling_method == "gradient_based":
+                        # reference GradientBasedSampling (minimal-variance
+                        # sampling, src/tree/gpu_hist/
+                        # gradient_based_sampler.cuh:33-142): keep row i with
+                        # probability p_i ∝ sqrt(g_i² + λh_i²) targeting
+                        # subsample*n rows, and rescale kept gradients by
+                        # 1/p_i so histogram sums stay unbiased
+                        u = jnp.sqrt(gp[:, 0] ** 2
+                                     + self.tree_param.reg_lambda
+                                     * gp[:, 1] ** 2)
+                        p = jnp.minimum(
+                            1.0, self.tree_param.subsample * n * u
+                            / (jnp.sum(u) + 1e-30))
+                        keep = jax.random.bernoulli(skey, p)
+                        gp = gp * jnp.where(keep, 1.0 / jnp.maximum(p, 1e-30),
+                                            0.0)[:, None]
+                    else:
+                        mask = jax.random.bernoulli(
+                            skey, self.tree_param.subsample, (n,))
+                        gp = gp * mask[:, None].astype(gp.dtype)
                 if exact:
                     from ..tree.exact import ExactGrower
 

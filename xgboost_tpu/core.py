@@ -26,6 +26,8 @@ from .logging_utils import console, logger
 from .metric import get_metric
 from .objective import get_objective
 from .tree.param import TrainParam
+from .utils import observer
+from .utils.timer import Monitor
 
 _VERSION = (0, 1, 0)
 
@@ -35,7 +37,7 @@ _LEARNER_KEYS = {
     "num_parallel_tree", "tree_method", "device", "seed", "random_state",
     "nthread", "n_jobs", "verbosity", "disable_default_eval_metric",
     "hist_method", "validate_parameters", "seed_per_iteration",
-    "multi_strategy",
+    "multi_strategy", "data_split_mode",
     # objective-specific passthroughs
     "scale_pos_weight", "huber_slope", "tweedie_variance_power",
     "quantile_alpha", "aft_loss_distribution", "aft_loss_distribution_scale",
@@ -68,6 +70,7 @@ class Booster:
         self.gbm: Optional[GBTree] = None
         self.base_margin_: Optional[np.ndarray] = None  # [K] margin space
         self._configured = False
+        self._monitor = Monitor("Booster")
         self._caches: Dict[int, Dict[str, Any]] = {}
         self._eval_metrics: List = []
         self._explicit_params: set = set()
@@ -212,13 +215,25 @@ class Booster:
             raise NotImplementedError(
                 "multi_output_tree does not support monotone/interaction "
                 "constraints or the dart booster")
+        dsm = self.learner_params.get("data_split_mode", "row")
+        if dsm not in ("row", "col"):
+            raise ValueError(f"unknown data_split_mode: {dsm}")
+        if dsm == "col":
+            if self.ctx.mesh is None:
+                raise ValueError("data_split_mode=col requires a mesh")
+            if (tm in ("approx", "exact")
+                    or self.tree_param.grow_policy == "lossguide"
+                    or ms == "multi_output_tree"):
+                raise NotImplementedError(
+                    "data_split_mode=col supports tree_method=hist with "
+                    "depthwise scalar trees only")
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
             hist_method=self.learner_params.get("hist_method", "auto"),
             mesh=self.ctx.mesh, monotone=mono, constraint_sets=ics,
             tree_method=tm if tm in ("approx", "exact") else "hist",
-            multi_strategy=ms)
+            multi_strategy=ms, split_mode=dsm)
         if name == "dart":
             kwargs.pop("multi_strategy")
             gbm = Dart(self.tree_param, n_groups, **kwargs)
@@ -265,26 +280,33 @@ class Booster:
                                     ref_cuts=train_cuts)
                           if train_cuts is not None else None)
             n = dm.num_row()
-            if dm.info.base_margin is not None:
-                bm = np.asarray(dm.info.base_margin,
-                                dtype=np.float32).reshape(n, -1)
-                margin = jnp.asarray(np.broadcast_to(bm, (n, self.n_groups)))
-            else:
-                margin = jnp.broadcast_to(
-                    jnp.asarray(self.base_margin_, dtype=jnp.float32)[None, :],
-                    (n, self.n_groups))
+            margin = jnp.asarray(self._broadcast_base_margin(dm, n))
             self._caches[key] = {"binned": binned, "margin": margin,
                                  "base": margin, "n_trees": 0,
                                  "is_train": is_train, "dm": dm,
                                  "info": dm.info, "n_valid": n}
         return self._caches[key]
 
+    def _broadcast_base_margin(self, dm: DMatrix, n: int) -> np.ndarray:
+        """Per-row starting margin [n, n_groups]: the DMatrix's base_margin
+        when set, else the learner's global base score."""
+        if dm.info.base_margin is not None:
+            bm = np.asarray(dm.info.base_margin, np.float32).reshape(n, -1)
+            return np.broadcast_to(bm, (n, self.n_groups)).copy()
+        return np.broadcast_to(self.base_margin_[None, :],
+                               (n, self.n_groups)).copy()
+
     def _make_sharded_train_state(self, key: int, dm: DMatrix,
                                   binned) -> Dict[str, Any]:
         """Shard the quantized matrix / margin over the mesh ``data`` axis,
         padding rows to a multiple of the axis size. Padded rows carry weight 0
         so gradients vanish (the reference's row shards are simply unequal;
-        static XLA shapes want equal shards instead)."""
+        static XLA shapes want equal shards instead).
+
+        With ``data_split_mode=col`` the FEATURE axis is sharded instead
+        (reference ``DataSplitMode::kCol``): rows replicate, features pad to
+        the axis size with zero-bin columns whose real-bin count is 0 so they
+        can never win a split."""
         import jax.sharding as jsh
 
         from .context import DATA_AXIS
@@ -294,6 +316,28 @@ class Booster:
         mesh = self.ctx.mesh
         world = mesh.shape.get(DATA_AXIS, 1)
         n = dm.num_row()
+        if self.learner_params.get("data_split_mode", "row") == "col":
+            bins_np = np.asarray(binned.bins)
+            F = bins_np.shape[1]
+            f_pad = ((F + world - 1) // world) * world - F
+            n_real = np.asarray(binned.cuts.n_real_bins(), np.int32)
+            if f_pad:
+                bins_np = np.concatenate(
+                    [bins_np, np.zeros((n, f_pad), bins_np.dtype)], axis=1)
+                n_real = np.concatenate(
+                    [n_real, np.zeros(f_pad, np.int32)])
+            sharding = jsh.NamedSharding(
+                mesh, jsh.PartitionSpec(None, DATA_AXIS))
+            binned_p = BinnedMatrix(
+                bins=jax.device_put(bins_np, sharding), cuts=binned.cuts,
+                max_nbins=binned.max_nbins, has_missing=binned.has_missing,
+                n_real_override=n_real)
+            margin = jnp.asarray(self._broadcast_base_margin(dm, n))
+            self._caches[key] = {"binned": binned_p, "margin": margin,
+                                 "base": margin, "n_trees": 0,
+                                 "is_train": True, "dm": dm,
+                                 "info": dm.info, "n_valid": n}
+            return self._caches[key]
         n_pad = ((n + world - 1) // world) * world
         pad = n_pad - n
         bins_np = np.asarray(binned.bins)
@@ -331,12 +375,7 @@ class Booster:
             label_lower_bound=lb, label_upper_bound=ub,
             feature_names=info.feature_names, feature_types=info.feature_types)
 
-        if info.base_margin is not None:
-            bm = np.asarray(info.base_margin, np.float32).reshape(n, -1)
-            bm = np.broadcast_to(bm, (n, self.n_groups)).copy()
-        else:
-            bm = np.broadcast_to(self.base_margin_[None, :],
-                                 (n, self.n_groups)).copy()
+        bm = self._broadcast_base_margin(dm, n)
         if pad:
             bm = np.concatenate([bm, np.zeros((pad, self.n_groups),
                                               np.float32)])
@@ -355,22 +394,33 @@ class Booster:
             return
         state = self._state_of(dtrain, is_train=True)
         margin = self.gbm.training_margin(state)
-        if fobj is None:
-            gpair = self.obj.get_gradient(margin, state["info"], iteration)
-        else:
-            grad, hess = fobj(np.asarray(margin).squeeze(), dtrain)
-            gpair = jnp.stack([jnp.asarray(grad, dtype=jnp.float32).reshape(
-                margin.shape), jnp.asarray(hess, dtype=jnp.float32).reshape(
-                    margin.shape)], axis=-1)
+        with self._monitor.section("GetGradient"):
+            if fobj is None:
+                gpair = self.obj.get_gradient(margin, state["info"],
+                                              iteration)
+            else:
+                grad, hess = fobj(np.asarray(margin).squeeze(), dtrain)
+                gpair = jnp.stack(
+                    [jnp.asarray(grad, dtype=jnp.float32).reshape(
+                        margin.shape),
+                     jnp.asarray(hess, dtype=jnp.float32).reshape(
+                         margin.shape)], axis=-1)
+        if observer.enabled():
+            observer.observe("gpair", gpair, iteration)
         key = self.ctx.make_key(iteration)
-        delta = self.gbm.do_boost(state, gpair, iteration,
-                                  jax.random.fold_in(key, iteration),
-                                  obj=self.obj, margin=margin)
-        if self.gbm.supports_margin_cache:
-            state["margin"] = state["margin"] + delta
-        else:
-            state["margin"] = self.gbm.compute_margin(state)
+        with self._monitor.section("BoostOneIter"):
+            delta = self.gbm.do_boost(state, gpair, iteration,
+                                      jax.random.fold_in(key, iteration),
+                                      obj=self.obj, margin=margin)
+        with self._monitor.section("UpdateCache"):
+            if self.gbm.supports_margin_cache:
+                state["margin"] = state["margin"] + delta
+            else:
+                state["margin"] = self.gbm.compute_margin(state)
+        if observer.enabled():
+            observer.observe("margin", state["margin"], iteration)
         state["n_trees"] = self.gbm.version()
+        self._monitor.maybe_print()
 
     def _update_existing_trees(self, dtrain: DMatrix,
                                fobj: Optional[Callable] = None) -> None:

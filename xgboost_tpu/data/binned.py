@@ -52,6 +52,9 @@ class BinnedMatrix:
     cuts: HistogramCuts
     max_nbins: int  # uniform per-feature slot count (+1 missing slot if any)
     has_missing: bool = True
+    # set when the feature axis was padded for column-split sharding: real-bin
+    # counts per PADDED feature (padding columns get 0 -> never split on)
+    n_real_override: Optional[np.ndarray] = None
 
     @property
     def n_rows(self) -> int:
@@ -69,6 +72,8 @@ class BinnedMatrix:
 
     def n_real_bins(self) -> jnp.ndarray:
         """[n_features] int32 count of real (non-missing) bins per feature."""
+        if self.n_real_override is not None:
+            return jnp.asarray(self.n_real_override)
         return jnp.asarray(self.cuts.n_real_bins())
 
     @staticmethod

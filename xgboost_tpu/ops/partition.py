@@ -33,7 +33,8 @@ def advance_positions_level(bins_f32: jnp.ndarray, positions: jnp.ndarray,
                             dleft: jnp.ndarray, can_split: jnp.ndarray,
                             missing_bin: int,
                             is_cat: Optional[jnp.ndarray] = None,
-                            cat_words: Optional[jnp.ndarray] = None
+                            cat_words: Optional[jnp.ndarray] = None,
+                            decision_axis: Optional[str] = None
                             ) -> jnp.ndarray:
     """Advance rows below one freshly evaluated level — gather-free.
 
@@ -71,6 +72,11 @@ def advance_positions_level(bins_f32: jnp.ndarray, positions: jnp.ndarray,
     go_right = jnp.where(missing, ~dleft[None, :], go_right)
     rel_oh = rel[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]
     gr = jnp.any(rel_oh & go_right, axis=1)
+    if decision_axis is not None:
+        # column split: each node's decision is known only to the shard
+        # owning its split feature (others contribute 0) — one psum fans the
+        # boolean decisions out to every shard
+        gr = jax.lax.psum(gr.astype(jnp.int32), decision_axis) > 0
     splitting = jnp.any(rel_oh & can_split[None, :], axis=1)
     return jnp.where(splitting,
                      2 * positions + 1 + gr.astype(positions.dtype),

@@ -69,7 +69,7 @@ def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
 @functools.partial(
     jax.jit,
     static_argnames=("param", "max_nbins", "hist_method", "axis_name",
-                     "has_missing"))
+                     "has_missing", "split_mode"))
 def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
           tree_mask: jnp.ndarray, key: jax.Array,
           monotone: Optional[jnp.ndarray] = None,
@@ -77,15 +77,27 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
           cat: Optional[CatInfo] = None, *,
           param: TrainParam, max_nbins: int, hist_method: str = "auto",
           axis_name: Optional[str] = None,
-          has_missing: bool = True) -> GrownTree:
+          has_missing: bool = True,
+          split_mode: str = "row") -> GrownTree:
+    """``split_mode="row"``: rows sharded over ``axis_name``, histograms
+    psum'd (reference ``DataSplitMode::kRow``). ``split_mode="col"``:
+    FEATURES sharded, rows replicated — split finding is local per feature
+    shard, the best split is all-gathered and the owner's row decisions are
+    broadcast via psum, mirroring the reference's column-split protocol
+    (``src/tree/hist/evaluate_splits.h:399-409`` best-split allgather +
+    ``common_row_partitioner.h`` decision-bitvector sync)."""
     n, F = bins.shape
+    col_split = split_mode == "col"
     max_depth = param.max_depth
     max_nodes = 2 ** (max_depth + 1) - 1
     # out-of-range sentinel when the matrix carries no missing slot
     missing_bin = max_nbins - 1 if has_missing else max_nbins
 
     def allreduce(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+        # column split: every shard already sees all rows -> no hist psum
+        if axis_name is None or col_split:
+            return x
+        return jax.lax.psum(x, axis_name)
 
     split_feature = jnp.full((max_nodes,), -1, jnp.int32)
     split_bin = jnp.zeros((max_nodes,), jnp.int32)
@@ -178,6 +190,34 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             if monotone is not None else None,
             cat=cat, has_missing=has_missing)
 
+        if col_split:
+            # column-split best-split exchange: all-gather per-shard best
+            # gains, pick the winning shard per node, and psum-select the
+            # winner's split fields (its feature index is globalised by the
+            # shard offset; equal shard widths are guaranteed by padding)
+            my = jax.lax.axis_index(axis_name)
+            gains = jax.lax.all_gather(res.gain, axis_name)      # [P, N]
+            mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
+
+            def _sel(x):
+                return jax.lax.psum(
+                    jnp.where(mine, x, jnp.zeros_like(x)), axis_name)
+
+            def _sel2(x):
+                return jax.lax.psum(
+                    jnp.where(mine[:, None], x, jnp.zeros_like(x)),
+                    axis_name)
+
+            local_feat, local_bin = res.feature, res.bin
+            local_dl = res.default_left
+            res = res._replace(
+                gain=jnp.max(gains, axis=0),
+                feature=_sel(res.feature + my * F),
+                bin=_sel(res.bin),
+                default_left=_sel(res.default_left.astype(jnp.int32)) > 0,
+                left_sum=_sel2(res.left_sum),
+                right_sum=_sel2(res.right_sum))
+
         # a node exists at this level iff its parent split; it expands unless
         # the best gain fails the gamma / kRtEps test (reference prune rule).
         can_split = (active[lo:lo + n_level]
@@ -241,7 +281,17 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             delta = delta + jnp.sum(
                 jnp.where(rel_oh, w_level[None, :], 0.0), axis=1)
 
-        if n_level <= DENSE_LEVEL_MAX:
+        if col_split:
+            # only the owning shard can route rows at each node; its local
+            # decisions reach every shard through one boolean psum (the
+            # reference's partition-bitvector broadcast)
+            positions = advance_positions_level(
+                bins_f32, positions, rel,
+                jnp.where(can_split & mine, local_feat, -1),
+                jnp.where(can_split & mine, local_bin, 0),
+                can_split & mine & local_dl, can_split, missing_bin,
+                decision_axis=axis_name)
+        elif n_level <= DENSE_LEVEL_MAX:
             positions = advance_positions_level(
                 bins_f32, positions, rel,
                 jnp.where(can_split, res.feature, -1),
@@ -298,10 +348,31 @@ class TreeGrower:
                  mesh: Optional[jax.sharding.Mesh] = None,
                  monotone: Optional[np.ndarray] = None,
                  constraint_sets: Optional[np.ndarray] = None,
-                 has_missing: bool = True) -> None:
+                 has_missing: bool = True,
+                 split_mode: str = "row") -> None:
+        if split_mode == "col":
+            if mesh is None:
+                raise ValueError("data_split_mode=col requires a mesh")
+            if param.max_depth > 7:
+                # the owner-shard decision exchange uses the dense
+                # [rows, level] advance at every level; past 2^7 nodes the
+                # intermediates would dominate HBM (row mode switches to a
+                # gather walk there, which cannot express the cross-shard
+                # decision broadcast)
+                raise NotImplementedError(
+                    "data_split_mode=col supports max_depth <= 7")
+            if monotone is not None or constraint_sets is not None:
+                raise NotImplementedError(
+                    "data_split_mode=col does not support monotone/"
+                    "interaction constraints yet")
+            if cuts.is_cat().any():
+                raise NotImplementedError(
+                    "data_split_mode=col does not support categorical "
+                    "features yet")
         self.param = param
         self.max_nbins = max_nbins
         self.has_missing = has_missing
+        self.split_mode = split_mode
         self.cuts = cuts
         self.hist_method = hist_method
         self.mesh = mesh
@@ -322,9 +393,11 @@ class TreeGrower:
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
              n_real_bins: jnp.ndarray, key: jax.Array) -> GrownTree:
-        F = bins.shape[1]
+        # features with no real bins (col-split padding columns) are never
+        # candidates, so they must not consume colsample draws either
+        base_mask = jnp.asarray(n_real_bins) > 0
         tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
-                                     jnp.ones((F,), bool),
+                                     base_mask,
                                      self.param.colsample_bytree)
         key = jax.random.fold_in(key, 0x5EED)
         if self.mesh is None:
@@ -401,18 +474,35 @@ class TreeGrower:
                              param=self.param, max_nbins=self.max_nbins,
                              hist_method=self.hist_method,
                              axis_name=DATA_AXIS,
-                             has_missing=self.has_missing)
+                             has_missing=self.has_missing,
+                             split_mode=self.split_mode)
 
-            out_specs = GrownTree(
-                split_feature=P(), split_bin=P(), default_left=P(),
-                is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
-                gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS),
-                is_cat_split=P(), cat_words=P(), base_weight=P())
+            if self.split_mode == "col":
+                # features sharded over the axis, rows replicated; every
+                # output (positions/delta included) is replicated
+                in_specs = (P(None, DATA_AXIS), P(), P(DATA_AXIS),
+                            P(DATA_AXIS), P())
+                out_specs = GrownTree(
+                    split_feature=P(), split_bin=P(), default_left=P(),
+                    is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
+                    gain=P(), positions=P(), delta=P(),
+                    is_cat_split=P(), cat_words=P(), base_weight=P())
+            else:
+                in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(),
+                            P(), P())
+                out_specs = GrownTree(
+                    split_feature=P(), split_bin=P(), default_left=P(),
+                    is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
+                    gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS),
+                    is_cat_split=P(), cat_words=P(), base_weight=P())
+            # col mode: outputs ARE replicated (every split field passes
+            # through a psum / all_gather), but the static replication
+            # checker cannot prove it through the owner-shard select chain
             self._sharded_fn = jax.jit(jax.shard_map(
                 inner, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P(),
-                          P()),
-                out_specs=out_specs))
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=self.split_mode != "col"))
         return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
 
     def to_tree_model(self, g: GrownTree) -> TreeModel:

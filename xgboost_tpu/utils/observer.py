@@ -1,0 +1,25 @@
+"""Training observer (reference ``TrainingObserver``,
+``src/common/observer.h:38``): when the ``XGBOOST_TPU_DEBUG_OUTPUT``
+environment variable is set, each boosting iteration dumps gradient and
+prediction summaries so numerical divergence between runs/backends can be
+localised. The reference compiles this in under ``XGBOOST_USE_DEBUG_OUTPUT``;
+here it is an env-var gate with near-zero cost when disabled."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("XGBOOST_TPU_DEBUG_OUTPUT"))
+
+
+def observe(name: str, array, iteration: int = -1) -> None:
+    if not enabled():
+        return
+    a = np.asarray(array, dtype=np.float64).reshape(-1)
+    head = ", ".join(f"{v:.6g}" for v in a[:8])
+    print(f"[observer] iter={iteration} {name}: shape={np.shape(array)} "
+          f"sum={a.sum():.9g} mean={a.mean():.9g} [{head}...]")
