@@ -165,3 +165,19 @@ def test_gradient_based_sampling_trains(mesh):
                "eval_metric": "auc"}, dm, 10, evals=[(dm, "t")],
               evals_result=res, verbose_eval=False)
     assert res["t"]["auc"][-1] > 0.9
+
+
+def test_launch_train_per_host_single_process():
+    """parallel.launch: the Dask/Spark-analog driver (single-process path)."""
+    from xgboost_tpu.parallel import launch
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1500, 8).astype(np.float32)
+    y = (X @ rng.randn(8) > 0).astype(np.float32)
+    launch.init_distributed()
+    with launch.CommunicatorContext():
+        bst = launch.train_per_host(
+            {"objective": "binary:logistic", "max_depth": 4}, X, y, 5,
+            verbose_eval=False)
+    p = bst.predict(xgb.DMatrix(X))
+    assert float(np.mean((p > 0.5) == y)) > 0.85

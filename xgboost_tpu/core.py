@@ -281,10 +281,16 @@ class Booster:
                           if train_cuts is not None else None)
             n = dm.num_row()
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
-            self._caches[key] = {"binned": binned, "margin": margin,
-                                 "base": margin, "n_trees": 0,
-                                 "is_train": is_train, "dm": dm,
-                                 "info": dm.info, "n_valid": n}
+            self._store_cache(key, binned, margin, is_train, dm, dm.info, n)
+        return self._caches[key]
+
+    def _store_cache(self, key, binned, margin, is_train, dm, info,
+                     n_valid):
+        """One schema for every training/prediction cache entry."""
+        self._caches[key] = {"binned": binned, "margin": margin,
+                             "base": margin, "n_trees": 0,
+                             "is_train": is_train, "dm": dm, "info": info,
+                             "n_valid": n_valid}
         return self._caches[key]
 
     def _broadcast_base_margin(self, dm: DMatrix, n: int) -> np.ndarray:
@@ -333,11 +339,8 @@ class Booster:
                 max_nbins=binned.max_nbins, has_missing=binned.has_missing,
                 n_real_override=n_real)
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
-            self._caches[key] = {"binned": binned_p, "margin": margin,
-                                 "base": margin, "n_trees": 0,
-                                 "is_train": True, "dm": dm,
-                                 "info": dm.info, "n_valid": n}
-            return self._caches[key]
+            return self._store_cache(key, binned_p, margin, True, dm,
+                                     dm.info, n)
         n_pad = ((n + world - 1) // world) * world
         pad = n_pad - n
         bins_np = np.asarray(binned.bins)
@@ -380,10 +383,7 @@ class Booster:
             bm = np.concatenate([bm, np.zeros((pad, self.n_groups),
                                               np.float32)])
         margin = jax.device_put(bm, sharding)
-        self._caches[key] = {"binned": binned_p, "margin": margin,
-                             "base": margin, "n_trees": 0, "is_train": True,
-                             "dm": dm, "info": info_p, "n_valid": n}
-        return self._caches[key]
+        return self._store_cache(key, binned_p, margin, True, dm, info_p, n)
 
     def update(self, dtrain: DMatrix, iteration: int,
                fobj: Optional[Callable] = None) -> None:
@@ -420,7 +420,6 @@ class Booster:
         if observer.enabled():
             observer.observe("margin", state["margin"], iteration)
         state["n_trees"] = self.gbm.version()
-        self._monitor.maybe_print()
 
     def _update_existing_trees(self, dtrain: DMatrix,
                                fobj: Optional[Callable] = None) -> None:
@@ -950,6 +949,7 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
         if container.after_iteration(bst, i, list(evals)):
             break
     bst = container.after_training(bst)
+    bst._monitor.maybe_print()  # one cumulative table (reference: destructor)
 
     if evals_result is not None:
         evals_result.update(container.history)
