@@ -393,6 +393,23 @@ class Booster:
             self._update_existing_trees(dtrain, fobj=fobj)
             return
         state = self._state_of(dtrain, is_train=True)
+        # training continuation (xgb_model= / loaded checkpoint): a fresh
+        # cache starts at the base margin, so fold the existing trees'
+        # contribution in before computing gradients (reference PredictRaw
+        # with the version cache, src/gbm/gbtree.cc:506-544)
+        total = self.gbm.version()
+        if state["n_trees"] < total:
+            if self.gbm.supports_margin_cache:
+                # raw-threshold walk, NOT the binned fast path: loaded trees
+                # may have been grown against different quantile cuts, so
+                # their split_bin indices are meaningless here (same reason
+                # the eval path falls back to raw for loaded models)
+                delta = self.gbm.margin_delta_raw(
+                    np.asarray(state["dm"].X), state["n_trees"], total)
+                state["margin"] = state["margin"] + jnp.asarray(delta)
+            else:
+                state["margin"] = self.gbm.compute_margin(state)
+            state["n_trees"] = total
         margin = self.gbm.training_margin(state)
         with self._monitor.section("GetGradient"):
             if fobj is None:

@@ -55,53 +55,46 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
         if not any(os.environ.get(v) for v in
                    ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-                    "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")):
+                    "MEGASCALE_COORDINATOR_ADDRESS")):
             return  # no cluster configured: stay single-controller
-        try:
-            jax.distributed.initialize()
-        except Exception:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            # too late to join a cluster in this interpreter — common in
+            # notebooks/tests that imported jax first; single-host is the
+            # only consistent outcome, so continue with a warning
             from ..logging_utils import logger
 
             logger.warning(
-                "jax.distributed.initialize() failed although a cluster "
-                "appears configured — continuing single-controller; THIS "
-                "HOST WILL TRAIN ALONE", exc_info=True)
+                "init_distributed(): JAX backends already initialized; "
+                "staying single-controller")
             return
+        try:
+            jax.distributed.initialize()
+        except Exception as e:
+            # a cluster IS configured: proceeding alone would silently train
+            # N divergent models, so abort (the reference tracker rendezvous
+            # fails the job the same way)
+            raise RuntimeError(
+                "jax.distributed.initialize() failed although a cluster "
+                "appears configured; refusing to continue "
+                "single-controller") from e
     else:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
 
 
-class CommunicatorContext:
-    """Scoped host-side communicator (reference
-    ``xgboost.collective.CommunicatorContext``): inside the block,
-    ``collective.get()`` returns the process-group communicator used for
-    sketch merges and small-object broadcasts."""
-
-    def __init__(self, **args: Any) -> None:
-        self.args = args
-
-    def __enter__(self):
-        import jax
-
-        kind = "jax" if jax.process_count() > 1 else "noop"
-        kwargs = {k: v for k, v in self.args.items() if k != "communicator"}
-        collective.init(self.args.get("communicator", kind), **kwargs)
-        return self
-
-    def __exit__(self, *exc):
-        collective.finalize()
-        return False
+# re-exported: one CommunicatorContext for the whole package
+# (JaxProcessCommunicator already degrades to a no-op at world size 1)
+CommunicatorContext = collective.CommunicatorContext
 
 
 def global_data_mesh():
     """One mesh over every device of every process (the 'world')."""
-    import jax
+    from ..context import make_data_mesh
 
-    from ..context import DATA_AXIS
-
-    return jax.sharding.Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+    return make_data_mesh()
 
 
 def train_per_host(params: Dict[str, Any], X_local: np.ndarray,
@@ -133,11 +126,27 @@ def train_per_host(params: Dict[str, Any], X_local: np.ndarray,
     comm = collective.get_communicator()
     w = (np.ones(len(X_local), np.float32) if weight_local is None
          else np.asarray(weight_local, np.float32))
-    parts = comm.allgather_objects((np.asarray(X_local),
-                                    np.asarray(y_local), w))
-    X = np.concatenate([p[0] for p in parts])
-    y = np.concatenate([p[1] for p in parts])
-    wg = np.concatenate([p[2] for p in parts])
+    # the process allgather stacks arrays, so shards must be equal-shaped:
+    # pad each to the global max row count, gather, then trim by true counts
+    n_local = len(X_local)
+    n_max = int(comm.allreduce(np.asarray([n_local]), op="max")[0])
+    pad = n_max - n_local
+    Xp = np.concatenate([np.asarray(X_local, np.float32),
+                         np.full((pad, X_local.shape[1]), np.nan,
+                                 np.float32)]) if pad else np.asarray(
+        X_local, np.float32)
+    yp = np.concatenate([np.asarray(y_local, np.float32),
+                         np.zeros(pad, np.float32)]) if pad else np.asarray(
+        y_local, np.float32)
+    wp = np.concatenate([w, np.zeros(pad, np.float32)]) if pad else w
+    counts = comm.allgather_objects(np.asarray([n_local]))
+    parts = comm.allgather_objects((Xp, yp, wp))
+    X = np.concatenate([p[0][: int(c[0])]
+                        for p, c in zip(parts, counts)])
+    y = np.concatenate([p[1][: int(c[0])]
+                        for p, c in zip(parts, counts)])
+    wg = np.concatenate([p[2][: int(c[0])]
+                         for p, c in zip(parts, counts)])
     dm = DMatrix(X, label=y, weight=wg)
     return train({**params, "mesh": mesh}, dm, num_boost_round,
                  **train_kwargs)
