@@ -153,3 +153,21 @@ def test_feature_importance():
         scores = bst.get_score(importance_type=t)
         assert scores, t
         assert all(v >= 0 for v in scores.values())
+
+
+def test_fused_round_matches_general_path():
+    """The single-dispatch fused round must produce bit-identical models to
+    the general do_boost path (same PRNG folding, same numerics)."""
+    rng = np.random.RandomState(12)
+    X = rng.randn(3000, 9).astype(np.float32)
+    y = (X @ rng.randn(9) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 4,
+              "subsample": 0.8, "colsample_bytree": 0.9, "seed": 5}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    assert b1._fused_round is not None  # fast path was taken
+    b2 = xgb.Booster(params=params)
+    b2._fused_blocked = True            # force the general path
+    for i in range(5):
+        b2.update(xgb.DMatrix(X, label=y) if i == 0 else dm2, i)
+        dm2 = list(b2._caches.values())[0]["dm"]
+    assert bytes(b1.save_raw("json")) == bytes(b2.save_raw("json"))

@@ -26,6 +26,31 @@ _GROWN_FIELDS = ("split_feature", "split_bin", "default_left", "is_leaf",
                  "cat_words", "base_weight")
 
 
+def sample_gradients(gp: jnp.ndarray, tkey: jax.Array,
+                     param: TrainParam) -> jnp.ndarray:
+    """Row subsampling on a [n, 2] gradient matrix — shared by the general
+    boost loop and the fused round so their PRNG folding and numerics can
+    never diverge. ``uniform``: bernoulli zeroing (reference
+    ``SampleGradient``, src/tree/hist/sampler.h:48). ``gradient_based``:
+    minimal-variance sampling — keep row i with probability
+    p_i ∝ sqrt(g_i² + λh_i²) targeting subsample*n rows and rescale kept
+    gradients by 1/p_i so histogram sums stay unbiased (reference
+    ``GradientBasedSampling``, src/tree/gpu_hist/
+    gradient_based_sampler.cuh:33-142)."""
+    if param.subsample >= 1.0:
+        return gp
+    skey = jax.random.fold_in(tkey, 0x5AB)
+    n = gp.shape[0]
+    if param.sampling_method == "gradient_based":
+        u = jnp.sqrt(gp[:, 0] ** 2 + param.reg_lambda * gp[:, 1] ** 2)
+        p = jnp.minimum(1.0, param.subsample * n * u / (jnp.sum(u) + 1e-30))
+        keep = jax.random.bernoulli(skey, p)
+        return gp * jnp.where(keep, 1.0 / jnp.maximum(p, 1e-30),
+                              0.0)[:, None]
+    mask = jax.random.bernoulli(skey, param.subsample, (n,))
+    return gp * mask[:, None].astype(gp.dtype)
+
+
 class _PendingTree:
     """A grown tree whose per-node arrays still live on device."""
 
@@ -194,28 +219,7 @@ class GBTree:
             for p in range(self.num_parallel_tree):
                 tkey = jax.random.fold_in(key, k * self.num_parallel_tree + p)
                 gp = gpair[:, k, :]
-                if self.tree_param.subsample < 1.0:
-                    skey = jax.random.fold_in(tkey, 0x5AB)
-                    if self.tree_param.sampling_method == "gradient_based":
-                        # reference GradientBasedSampling (minimal-variance
-                        # sampling, src/tree/gpu_hist/
-                        # gradient_based_sampler.cuh:33-142): keep row i with
-                        # probability p_i ∝ sqrt(g_i² + λh_i²) targeting
-                        # subsample*n rows, and rescale kept gradients by
-                        # 1/p_i so histogram sums stay unbiased
-                        u = jnp.sqrt(gp[:, 0] ** 2
-                                     + self.tree_param.reg_lambda
-                                     * gp[:, 1] ** 2)
-                        p = jnp.minimum(
-                            1.0, self.tree_param.subsample * n * u
-                            / (jnp.sum(u) + 1e-30))
-                        keep = jax.random.bernoulli(skey, p)
-                        gp = gp * jnp.where(keep, 1.0 / jnp.maximum(p, 1e-30),
-                                            0.0)[:, None]
-                    else:
-                        mask = jax.random.bernoulli(
-                            skey, self.tree_param.subsample, (n,))
-                        gp = gp * mask[:, None].astype(gp.dtype)
+                gp = sample_gradients(gp, tkey, self.tree_param)
                 if exact:
                     from ..tree.exact import ExactGrower
 

@@ -51,6 +51,43 @@ _LEARNER_KEYS = {
 }
 
 
+import functools as _functools
+
+
+@_functools.partial(
+    jax.jit,
+    static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
+                     "hist_method", "has_missing"))
+def _fused_round_fn(bins, margin, labels, weights, n_real, key,
+                    monotone, constraint_sets, cat, *,
+                    obj_cls, obj_params, param, max_nbins, hist_method,
+                    has_missing):
+    """One boosting round (gradient -> grow -> margin update) as a single
+    compiled program. Module-level so the compile cache is shared across
+    Booster instances; PRNG key folding replicates ``do_boost`` exactly so
+    fused and general paths produce identical models."""
+    import types
+
+    from .tree.grow import _grow, _sample_features
+
+    from .boosting.gbtree import sample_gradients
+
+    obj = obj_cls(dict(obj_params))
+    sinfo = types.SimpleNamespace(labels=labels, weights=weights)
+    gpair = obj.get_gradient(margin, sinfo, 0)
+    gp = gpair[:, 0, :]
+    tkey = jax.random.fold_in(key, 0)
+    gp = sample_gradients(gp, tkey, param)
+    tree_mask = _sample_features(jax.random.fold_in(tkey, 0xC0),
+                                 n_real > 0, param.colsample_bytree)
+    gkey = jax.random.fold_in(tkey, 0x5EED)
+    grown = _grow(bins, gp, n_real, tree_mask, gkey, monotone,
+                  constraint_sets, cat, param=param, max_nbins=max_nbins,
+                  hist_method=hist_method, axis_name=None,
+                  has_missing=has_missing)
+    return margin + grown.delta[:, None], grown
+
+
 class Booster:
     """A trained / in-training gradient-boosting model."""
 
@@ -71,6 +108,8 @@ class Booster:
         self.base_margin_: Optional[np.ndarray] = None  # [K] margin space
         self._configured = False
         self._monitor = Monitor("Booster")
+        self._fused_round = None   # (jitted fn, grower) fast path
+        self._fused_blocked = False
         self._caches: Dict[int, Dict[str, Any]] = {}
         self._eval_metrics: List = []
         self._explicit_params: set = set()
@@ -118,6 +157,8 @@ class Booster:
             if self.gbm is not None:
                 self.gbm.tree_param = self.tree_param
                 self.gbm._grower = None  # rebind with new params
+            self._fused_round = None     # re-derive objective/tree config
+            self._fused_blocked = False
 
     # --------------------------------------------------------------- configure
     def _configure(self, dtrain: Optional[DMatrix]) -> None:
@@ -410,6 +451,8 @@ class Booster:
             else:
                 state["margin"] = self.gbm.compute_margin(state)
             state["n_trees"] = total
+        if fobj is None and self._fused_step(state, iteration):
+            return
         margin = self.gbm.training_margin(state)
         with self._monitor.section("GetGradient"):
             if fobj is None:
@@ -437,6 +480,77 @@ class Booster:
         if observer.enabled():
             observer.observe("margin", state["margin"], iteration)
         state["n_trees"] = self.gbm.version()
+
+    def _fused_step(self, state: Dict[str, Any], iteration: int) -> bool:
+        """One whole boosting round as a SINGLE jitted dispatch (gradient ->
+        grow -> margin update): host dispatch latency is material against a
+        remote TPU, so the common single-target hist case fuses the
+        per-round op chain. Returns False when the configuration needs the
+        general path; numerics and PRNG key derivation replicate do_boost
+        exactly, so fused and unfused runs produce identical models."""
+        gbm = self.gbm
+        if (self._fused_blocked or type(gbm) is not GBTree
+                or not gbm.supports_margin_cache
+                or gbm.tree_method in ("approx", "exact")
+                or gbm.num_parallel_tree != 1 or gbm.n_groups != 1
+                or gbm.split_mode != "row"
+                or self.tree_param.grow_policy != "depthwise"
+                or self.tree_param.max_leaves > 0
+                or hasattr(self.obj, "update_tree_leaf")
+                or state.get("binned") is None
+                or self.ctx.mesh is not None
+                or observer.enabled()):
+            return False
+        from .objective.base import Objective
+
+        # custom get_gradient overrides may be host-side or
+        # iteration-dependent (lambdarank pair sampling) — general path
+        if type(self.obj).get_gradient is not Objective.get_gradient:
+            return False
+        from .boosting.gbtree import _PendingTree
+
+        binned = state["binned"]
+        if self._fused_round is None or self._fused_round[0] is not state:
+            # (re)bind to THIS training cache — a different dtrain gets
+            # fresh labels/weights/bins; set_param resets this cache too
+            scalars = {k: v for k, v in self.obj.params.items()
+                       if k != "eval_metric"}  # metric list: not a gradient
+                       # input, never read by any objective
+            if not all(isinstance(v, (int, float, str, bool))
+                       for v in scalars.values()):
+                self._fused_blocked = True  # non-scalar objective params
+                return False                # can't be static jit args
+            obj_params = tuple(sorted(scalars.items()))
+            grower = gbm._grower_for(binned)
+            info = state["info"]
+            self._fused_round = (
+                state, obj_params, grower,
+                jnp.asarray(info.labels, jnp.float32),
+                None if info.weights is None
+                else jnp.asarray(info.weights, jnp.float32),
+                binned.n_real_bins())
+        _, obj_params, grower, labels, weights, n_real = self._fused_round
+        key = jax.random.fold_in(self.ctx.make_key(iteration), iteration)
+        try:
+            new_margin, grown = _fused_round_fn(
+                binned.bins, state["margin"], labels, weights, n_real, key,
+                grower.monotone, grower.constraint_sets, grower.cat,
+                obj_cls=type(self.obj), obj_params=obj_params,
+                param=grower.param, max_nbins=grower.max_nbins,
+                hist_method=grower.hist_method,
+                has_missing=grower.has_missing)
+        except Exception:
+            logger.warning("fused boosting round failed; falling back to "
+                           "the general path permanently", exc_info=True)
+            self._fused_blocked = True
+            self._fused_round = None
+            return False
+        gbm._trees.append(_PendingTree(grown, grower))
+        gbm.tree_info.append(0)
+        gbm.iteration_indptr.append(len(gbm._trees))
+        state["margin"] = new_margin
+        state["n_trees"] = gbm.version()
+        return True
 
     def _update_existing_trees(self, dtrain: DMatrix,
                                fobj: Optional[Callable] = None) -> None:
