@@ -181,3 +181,28 @@ def test_launch_train_per_host_single_process():
             verbose_eval=False)
     p = bst.predict(xgb.DMatrix(X))
     assert float(np.mean((p > 0.5) == y)) > 0.85
+
+
+def test_aggregator_helpers():
+    """reference src/collective/aggregator.h: GlobalSum / GlobalRatio /
+    ApplyWithLabels over the in-memory multi-worker communicator."""
+    from xgboost_tpu.parallel.collective import (
+        InMemoryCommunicator, apply_with_labels, global_ratio, global_sum)
+    import threading
+
+    comms = InMemoryCommunicator.make_world(3)
+    out = {}
+
+    def worker(rank):
+        c = comms[rank]
+        out[("sum", rank)] = global_sum(np.asarray([rank + 1.0]), c)
+        out[("ratio", rank)] = global_ratio(rank + 1.0, 2.0, c)
+        out[("awl", rank)] = apply_with_labels(lambda: "labels!", c)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    for r in range(3):
+        assert out[("sum", r)][0] == 6.0          # 1+2+3
+        assert out[("ratio", r)] == 1.0           # 6 / 6
+        assert out[("awl", r)] == "labels!"       # broadcast from rank 0

@@ -108,7 +108,10 @@ class Booster:
         self.base_margin_: Optional[np.ndarray] = None  # [K] margin space
         self._configured = False
         self._monitor = Monitor("Booster")
-        self._fused_round = None   # (jitted fn, grower) fast path
+        # fast-path cache: (state_dict, obj_params, grower, labels, weights,
+        # n_real); element 0's IDENTITY is the staleness check — a different
+        # training DMatrix produces a different state dict and forces rebind
+        self._fused_round = None
         self._fused_blocked = False
         self._caches: Dict[int, Dict[str, Any]] = {}
         self._eval_metrics: List = []

@@ -136,6 +136,17 @@ class DMatrix:
         return self.info.labels
 
     # --- quantization --------------------------------------------------------
+    def get_quantile_cut(self, max_bin: int = 256):
+        """-> (indptr [n_features+1] int64, values f32): the quantile cut
+        boundaries of the EXISTING quantized representation when one was
+        already built (what the trained trees' split_bins index — matching
+        the reference ``XGDMatrixGetQuantileCut``); only an unbinned matrix
+        sketches fresh cuts with ``max_bin``."""
+        cuts = (self._binned.cuts if self._binned is not None
+                else self.binned(max_bin).cuts)
+        return (np.asarray(cuts.ptrs, np.int64),
+                np.asarray(cuts.values, np.float32))
+
     def binned(self, max_bin: int = 256,
                ref_cuts: Optional[HistogramCuts] = None) -> BinnedMatrix:
         """Lazily build (and cache) the quantized representation. A cached

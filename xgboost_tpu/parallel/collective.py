@@ -247,3 +247,47 @@ def distributed_sketch(X_local: np.ndarray, max_bin: int,
                   for a, (v, w) in zip(merged, remote)]
     merged = [s.prune(max_bin * 8) for s in merged]
     return cuts_from_summaries(merged, max_bin)
+
+
+# -- aggregator helpers (reference src/collective/aggregator.h) ---------------
+
+def global_sum(values: np.ndarray,
+               comm: Optional[Communicator] = None) -> np.ndarray:
+    """Sum across workers (reference ``collective::GlobalSum``,
+    aggregator.h:91)."""
+    comm = comm or get_communicator()
+    return comm.allreduce(np.asarray(values, np.float64), op="sum")
+
+
+def global_ratio(numerator: float, denominator: float,
+                 comm: Optional[Communicator] = None) -> float:
+    """Sum both sides across workers, then divide (reference
+    ``collective::GlobalRatio``, aggregator.h:115 — how distributed metrics
+    aggregate their PackedReduceResult)."""
+    s = global_sum(np.asarray([numerator, denominator], np.float64), comm)
+    return float(s[0] / s[1]) if s[1] != 0 else float("nan")
+
+
+def apply_with_labels(fn, comm: Optional[Communicator] = None,
+                      label_rank: int = 0):
+    """Vertical-federated helper (reference ``collective::ApplyWithLabels``,
+    aggregator.h:36): only ``label_rank`` holds labels, so it computes
+    ``fn()`` and the result is broadcast to everyone else. In the TPU
+    column-split world every shard replicates labels, so this degrades to a
+    plain call unless a label-private communicator topology is in use."""
+    comm = comm or get_communicator()
+    if not comm.is_distributed():
+        return fn()
+    # symmetric-collective broadcast: process-group backends only support
+    # identically-shaped arrays on every rank, so the object is pickled on
+    # the label rank, its length maxed, and the zero-padded byte buffer
+    # sum-reduced (all other ranks contribute zeros)
+    import pickle
+
+    payload = (pickle.dumps(fn()) if comm.get_rank() == label_rank else b"")
+    n = int(comm.allreduce(np.asarray([len(payload)], np.int64),
+                           op="max")[0])
+    buf = np.zeros(n, np.int64)
+    buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    buf = comm.allreduce(buf, op="sum")
+    return pickle.loads(buf.astype(np.uint8).tobytes())
