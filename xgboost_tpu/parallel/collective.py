@@ -252,19 +252,27 @@ def distributed_sketch(X_local: np.ndarray, max_bin: int,
 # -- aggregator helpers (reference src/collective/aggregator.h) ---------------
 
 def global_sum(values: np.ndarray,
-               comm: Optional[Communicator] = None) -> np.ndarray:
+               comm: Optional[Communicator] = None,
+               row_split: bool = True) -> np.ndarray:
     """Sum across workers (reference ``collective::GlobalSum``,
-    aggregator.h:91)."""
+    aggregator.h:91). With ``row_split=False`` (column split: rows/labels
+    replicated on every worker) the reduction is skipped, mirroring the
+    reference's ``IsRowSplit`` guard — summing replicated partials would
+    double-count by the world size."""
     comm = comm or get_communicator()
+    if not row_split:
+        return np.asarray(values, np.float64)
     return comm.allreduce(np.asarray(values, np.float64), op="sum")
 
 
 def global_ratio(numerator: float, denominator: float,
-                 comm: Optional[Communicator] = None) -> float:
+                 comm: Optional[Communicator] = None,
+                 row_split: bool = True) -> float:
     """Sum both sides across workers, then divide (reference
     ``collective::GlobalRatio``, aggregator.h:115 — how distributed metrics
     aggregate their PackedReduceResult)."""
-    s = global_sum(np.asarray([numerator, denominator], np.float64), comm)
+    s = global_sum(np.asarray([numerator, denominator], np.float64), comm,
+                   row_split=row_split)
     return float(s[0] / s[1]) if s[1] != 0 else float("nan")
 
 
@@ -287,7 +295,8 @@ def apply_with_labels(fn, comm: Optional[Communicator] = None,
     payload = (pickle.dumps(fn()) if comm.get_rank() == label_rank else b"")
     n = int(comm.allreduce(np.asarray([len(payload)], np.int64),
                            op="max")[0])
-    buf = np.zeros(n, np.int64)
+    buf = np.zeros(n, np.uint8)  # only one rank contributes: no overflow
     buf[: len(payload)] = np.frombuffer(payload, np.uint8)
-    buf = comm.allreduce(buf, op="sum")
-    return pickle.loads(buf.astype(np.uint8).tobytes())
+    # reductions may promote the dtype; the values still fit a byte
+    buf = comm.allreduce(buf, op="sum").astype(np.uint8)
+    return pickle.loads(buf.tobytes())
