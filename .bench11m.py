@@ -1,0 +1,26 @@
+import time, numpy as np
+print("start", flush=True)
+N, F, ROUNDS = 11_000_000, 28, 10
+rng = np.random.RandomState(42)
+X = rng.randn(N, F).astype(np.float32)
+w = rng.randn(F).astype(np.float32)
+y = (X @ w + rng.randn(N).astype(np.float32) > 0).astype(np.float32)
+print("data made", flush=True)
+import jax
+import xgboost_tpu as xgb
+params = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1, "max_bin": 256}
+t0 = time.perf_counter()
+dm = xgb.DMatrix(X, label=y)
+dm.binned()
+print(f"DMatrix+binning: {time.perf_counter()-t0:.1f}s", flush=True)
+bst = xgb.train(params, dm, 2, verbose_eval=False)
+for st in bst._caches.values(): jax.block_until_ready(st["margin"])
+print("compiled", flush=True)
+t0 = time.perf_counter()
+bst = xgb.train(params, dm, ROUNDS, verbose_eval=False)
+for st in bst._caches.values(): jax.block_until_ready(st["margin"])
+dt = time.perf_counter() - t0
+print(f"11M rows: {ROUNDS/dt:.3f} rounds/s ({dt/ROUNDS*1e3:.0f} ms/round)", flush=True)
+from xgboost_tpu.metric.auc import binary_roc_auc
+p = bst.predict(dm)
+print("auc:", round(binary_roc_auc(y.astype(float), p.astype(float), np.ones(N)), 4), flush=True)
