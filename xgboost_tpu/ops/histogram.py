@@ -129,6 +129,23 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
     raise ValueError(f"unknown hist method {method}")
 
 
+def build_hist_multi(bins: jnp.ndarray, gpair3: jnp.ndarray,
+                     rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
+                     method: str = "auto",
+                     bins_t: jnp.ndarray = None) -> jnp.ndarray:
+    """K-target histogram [n_nodes, F, max_nbins, K, 2] from gpair [n, K, 2].
+
+    Loops single-target builds: a fused all-components kernel pass was
+    measured 2x SLOWER on TPU (the widened output spills past one MXU
+    column tile — see the note in ops/pallas/histogram.py), so per-target
+    passes are the fast path."""
+    K = gpair3.shape[1]
+    return jnp.stack(
+        [build_hist(bins, gpair3[:, k], rel_pos, n_nodes, max_nbins,
+                    method=method, bins_t=bins_t) for k in range(K)],
+        axis=3)
+
+
 def subtract_siblings(parent_hist: jnp.ndarray, child_hist: jnp.ndarray,
                       built_is_left: jnp.ndarray) -> jnp.ndarray:
     """Sibling subtraction trick (reference ``src/tree/hist/histogram.h:192-207``):

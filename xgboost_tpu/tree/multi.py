@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import build_hist
+from ..ops.histogram import build_hist_multi
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits_multi
 from .param import TrainParam, calc_weight
@@ -95,13 +95,11 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
 
         in_level = (positions >= lo) & (positions < lo + n_level)
         rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
-        # one fused histogram pass per target (each is an independent MXU
-        # contraction; XLA overlaps their DMA pipelines)
-        hist = jnp.stack(
-            [build_hist(bins, gpair[:, k], rel, n_level, max_nbins,
-                        method=hist_method, bins_t=bins_t)
-             for k in range(K)], axis=3)                   # [N,F,B,K,2]
-        hist = allreduce(hist)
+        # K per-target kernel passes (a fused all-components pass measured
+        # slower on TPU — see ops/histogram.build_hist_multi)
+        hist = build_hist_multi(bins, gpair, rel, n_level, max_nbins,
+                                method=hist_method, bins_t=bins_t)
+        hist = allreduce(hist)                             # [N,F,B,K,2]
 
         level_key = jax.random.fold_in(key, depth)
         level_mask = _sample_features(level_key, tree_mask,
