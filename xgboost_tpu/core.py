@@ -771,6 +771,11 @@ class Booster:
                             strict_shape=strict_shape)
 
     # ------------------------------------------------------------------- eval
+    def eval(self, data: DMatrix, name: str = "eval",
+             iteration: int = 0) -> str:
+        """Evaluate one DMatrix (reference ``Booster.eval``)."""
+        return self.eval_set([(data, name)], iteration)
+
     def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,
                  feval: Optional[Callable] = None,
                  output_margin: bool = True) -> str:
