@@ -32,6 +32,35 @@ def _dtype_for(max_local_bins: int):
     return np.int32
 
 
+def _search_bin_native(X: np.ndarray, cuts: HistogramCuts):
+    """Threaded bin assignment (native/sketch.cc); None -> pure-Python path."""
+    import ctypes
+
+    from .. import native
+
+    lib = native.load()
+    n, nf = X.shape
+    if lib is None or n == 0 or nf == 0:
+        return None
+    fptr = ctypes.POINTER(ctypes.c_float)
+    has_missing = bool(lib.xtpu_has_nan(
+        X.ctypes.data_as(fptr), ctypes.c_int64(n * nf)))
+    max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
+    dtype = _dtype_for(max_nbins - 1)
+    dcode = {np.uint8: 0, np.uint16: 1, np.int32: 2}[dtype]
+    out = np.empty((n, nf), dtype)
+    values = np.ascontiguousarray(cuts.values, np.float32)
+    ptrs = np.ascontiguousarray(cuts.ptrs, np.int32)
+    fn = lib.xtpu_search_bin
+    fn.restype = None
+    fn(X.ctypes.data_as(fptr), ctypes.c_int64(n), ctypes.c_int64(nf),
+       values.ctypes.data_as(fptr),
+       ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       ctypes.c_int32(max_nbins - 1), ctypes.c_int32(dcode),
+       out.ctypes.data_as(ctypes.c_void_p))
+    return out, has_missing, max_nbins
+
+
 @dataclass
 class BinnedMatrix:
     """Quantized feature matrix resident in HBM.
@@ -78,12 +107,17 @@ class BinnedMatrix:
 
     @staticmethod
     def from_dense(X: np.ndarray, cuts: HistogramCuts, device=None) -> "BinnedMatrix":
-        local = cuts.search_bin(np.asarray(X, dtype=np.float32))
-        has_missing = bool((local < 0).any())
-        max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
-        if has_missing:
-            local = np.where(local < 0, max_nbins - 1, local)
-        arr = local.astype(_dtype_for(max_nbins - 1))
+        X = np.ascontiguousarray(X, dtype=np.float32)
+        arr = _search_bin_native(X, cuts)
+        if arr is not None:
+            arr, has_missing, max_nbins = arr
+        else:
+            local = cuts.search_bin(X)
+            has_missing = bool((local < 0).any())
+            max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
+            if has_missing:
+                local = np.where(local < 0, max_nbins - 1, local)
+            arr = local.astype(_dtype_for(max_nbins - 1))
         bins = (jax.device_put(arr, device) if device is not None
                 else jnp.asarray(arr))
         return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins,

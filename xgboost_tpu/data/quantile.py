@@ -233,10 +233,82 @@ def cuts_from_summaries(summaries: Sequence[FeatureSummary], max_bin: int,
                          max_bin=max_bin, feature_types=feature_types)
 
 
+def _sketch_matrix_native(X: np.ndarray, max_bin: int,
+                          weights: Optional[np.ndarray],
+                          feature_types: Optional[List[str]]
+                          ) -> Optional[HistogramCuts]:
+    """Threaded C++ sketch (native/sketch.cc) — same cuts as the Python path.
+    Categorical features are overridden host-side (their cuts are just
+    ``arange(n_cat)``)."""
+    import ctypes
+
+    from .. import native
+
+    lib = native.load()
+    n, nf = X.shape
+    # f64 input keeps full precision only on the Python path — don't narrow
+    if lib is None or n == 0 or nf == 0 or max_bin < 1 \
+            or X.dtype != np.float32:
+        return None
+    X = np.ascontiguousarray(X)
+    w = None
+    if weights is not None:
+        weights = np.asarray(weights)
+        if weights.shape[0] != n:
+            raise ValueError(
+                f"weights has {weights.shape[0]} entries, expected {n}")
+        if weights.dtype.itemsize > 8:
+            return None
+        w = np.ascontiguousarray(weights, np.float64)
+    skip = None
+    if feature_types is not None:
+        skip = np.asarray([f < len(feature_types) and feature_types[f] == "c"
+                           for f in range(nf)], dtype=np.uint8)
+        if not skip.any():
+            skip = None
+    vals = np.empty((nf, max_bin), np.float32)
+    counts = np.empty(nf, np.int32)
+    mins = np.empty(nf, np.float32)
+    fn = lib.xtpu_sketch_cuts
+    fn.restype = None
+    fn(X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       ctypes.c_int64(n), ctypes.c_int64(nf),
+       (w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) if w is not None
+        else None),
+       (skip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if skip is not None else None),
+       ctypes.c_int(max_bin),
+       vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       mins.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    values: List[np.ndarray] = []
+    ptrs = [0]
+    min_vals: List[float] = []
+    for f in range(nf):
+        if feature_types is not None and f < len(feature_types) \
+                and feature_types[f] == "c":
+            col = X[:, f]
+            finite = col[~np.isnan(col)]
+            n_cat = int(finite.max()) + 1 if finite.size else 1
+            values.append(np.arange(n_cat, dtype=np.float32))
+            min_vals.append(-0.5)
+        else:
+            values.append(vals[f, :counts[f]].copy())
+            min_vals.append(float(mins[f]))
+        ptrs.append(ptrs[-1] + len(values[-1]))
+    return HistogramCuts(values=np.concatenate(values).astype(np.float32),
+                         ptrs=np.asarray(ptrs, dtype=np.int32),
+                         min_vals=np.asarray(min_vals, dtype=np.float32),
+                         max_bin=max_bin, feature_types=feature_types)
+
+
 def sketch_matrix(X: np.ndarray, max_bin: int,
                   weights: Optional[np.ndarray] = None,
                   feature_types: Optional[List[str]] = None) -> HistogramCuts:
     """``SketchOnDMatrix`` analogue (reference ``src/common/hist_util.cc:32-69``)
     for an in-memory dense matrix with NaN as missing."""
+    out = _sketch_matrix_native(X, max_bin, weights, feature_types)
+    if out is not None:
+        return out
     summaries = [FeatureSummary.from_data(X[:, f], weights) for f in range(X.shape[1])]
     return cuts_from_summaries(summaries, max_bin, feature_types)
