@@ -1,0 +1,123 @@
+"""Reference API-surface parity: DMatrix info getters/setters, get_data,
+save_binary round-trip, Booster copy/config/get_fscore/split-value-histogram
+(reference python-package/xgboost/core.py)."""
+
+import copy as copy_mod
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 8).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 3] > 0).astype(np.float32)
+    dtr = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4}, dtr, 5)
+    return bst, dtr, X, y
+
+
+def test_dmatrix_info_getters_setters():
+    X = np.arange(12, dtype=np.float32).reshape(4, 3)
+    dm = xgb.DMatrix(X)
+    assert dm.get_label() is None
+    assert dm.get_weight().size == 0
+    assert dm.get_base_margin().size == 0
+    dm.set_label([0, 1, 0, 1])
+    dm.set_weight([1, 2, 3, 4])
+    dm.set_base_margin([0.5] * 4)
+    np.testing.assert_array_equal(dm.get_label(), [0, 1, 0, 1])
+    np.testing.assert_array_equal(dm.get_weight(), [1, 2, 3, 4])
+    np.testing.assert_array_equal(dm.get_float_info("base_margin"), [0.5] * 4)
+    dm.set_group([2, 2])
+    np.testing.assert_array_equal(dm.get_group(), [2, 2])
+    np.testing.assert_array_equal(dm.get_uint_info("group_ptr"), [0, 2, 4])
+    with pytest.raises(ValueError):
+        dm.get_float_info("nope")
+    with pytest.raises(ValueError):
+        dm.set_label([0, 1])  # wrong length
+
+
+def test_dmatrix_feature_info_properties():
+    dm = xgb.DMatrix(np.zeros((2, 3), np.float32))
+    dm.feature_names = ["a", "b", "c"]
+    assert dm.feature_names == ["a", "b", "c"]
+    with pytest.raises(ValueError):
+        dm.feature_names = ["a", "b"]
+    with pytest.raises(ValueError):
+        dm.feature_names = ["a", "a", "b"]
+    dm.feature_types = "float"
+    assert dm.feature_types == ["float"] * 3
+    with pytest.raises(ValueError):
+        dm.feature_types = ["q"]
+    dm.feature_names = None
+    assert dm.feature_names is None
+
+
+def test_num_nonmissing_and_get_data():
+    X = np.asarray([[1.0, np.nan], [np.nan, 2.0], [3.0, 4.0]], np.float32)
+    dm = xgb.DMatrix(X)
+    assert dm.num_nonmissing() == 4
+    csr = dm.get_data()
+    assert csr.shape == (3, 2)
+    assert csr.nnz == 4
+    dense = csr.toarray()
+    assert dense[0, 0] == 1.0 and dense[1, 1] == 2.0
+    assert dense[0, 1] == 0.0  # missing -> absent
+
+
+def test_save_binary_round_trip(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(50, 4).astype(np.float32)
+    X[X < -1.5] = np.nan
+    y = rng.rand(50).astype(np.float32)
+    w = rng.rand(50).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y, weight=w,
+                     feature_names=["a", "b", "c", "d"])
+    fname = os.path.join(tmp_path, "dm.buffer")
+    dm.save_binary(fname)
+    dm2 = xgb.DMatrix(fname)
+    np.testing.assert_array_equal(dm2.X, X)
+    np.testing.assert_array_equal(dm2.get_label(), y)
+    np.testing.assert_array_equal(dm2.get_weight(), w)
+    assert dm2.feature_names == ["a", "b", "c", "d"]
+
+
+def test_booster_copy(trained):
+    bst, dtr, _, _ = trained
+    for clone in (bst.copy(), copy_mod.copy(bst), copy_mod.deepcopy(bst)):
+        np.testing.assert_array_equal(clone.predict(dtr), bst.predict(dtr))
+        assert clone is not bst
+
+
+def test_booster_config_round_trip(trained):
+    bst, _, _, _ = trained
+    cfg = bst.save_config()
+    import json
+
+    obj = json.loads(cfg)
+    assert obj["learner"]["learner_train_param"]["objective"] \
+        == "binary:logistic"
+    assert obj["learner"]["gradient_booster"]["tree_train_param"][
+        "max_depth"] == "4"
+    fresh = xgb.Booster()
+    fresh.load_config(cfg)
+    assert fresh.learner_params["objective"] == "binary:logistic"
+    assert fresh.tree_param.max_depth == 4
+
+
+def test_get_fscore_and_split_value_histogram(trained):
+    bst, _, _, _ = trained
+    fs = bst.get_fscore()
+    assert fs and all(v > 0 for v in fs.values())
+    assert fs == bst.get_score(importance_type="weight")
+    hist = bst.get_split_value_histogram("f0", as_pandas=False)
+    assert hist.ndim == 2 and hist.shape[1] == 2
+    assert hist[:, 1].sum() == fs.get("f0", 0)
+    # pandas variant
+    df = bst.get_split_value_histogram("f0")
+    assert list(df.columns) == ["SplitValue", "Count"]

@@ -964,6 +964,49 @@ class Booster:
         self.__init__()
         self.load_model(state["raw"])
 
+    def __copy__(self) -> "Booster":
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _: Any) -> "Booster":
+        out = Booster()
+        out.load_model(self.save_raw("json"))
+        out.set_param({k: v for k, v in self.learner_params.items()
+                       if _jsonable(v)})
+        return out
+
+    def copy(self) -> "Booster":
+        """Copy the booster (reference ``Booster.copy``, core.py:1869)."""
+        return self.__copy__()
+
+    # ------------------------------------------------------------------ config
+    def save_config(self) -> str:
+        """Internal parameter configuration as a JSON string (reference
+        ``XGBoosterSaveJsonConfig``, core.py:1836)."""
+        import json as _json
+
+        return _json.dumps({
+            "version": [2, 0, 0],
+            "learner": {
+                "learner_train_param": {
+                    k: v for k, v in self.learner_params.items()
+                    if _jsonable(v)},
+                "gradient_booster": {
+                    "name": self.learner_params.get("booster", "gbtree"),
+                    "tree_train_param": self.tree_param.to_json(),
+                },
+            },
+        })
+
+    def load_config(self, config: str) -> None:
+        """Load configuration returned by :meth:`save_config`."""
+        import json as _json
+
+        obj = _json.loads(config)
+        learner = obj.get("learner", {})
+        self.set_param(learner.get("learner_train_param", {}))
+        gbm = learner.get("gradient_booster", {})
+        self.set_param(gbm.get("tree_train_param", {}))
+
     # ------------------------------------------------------------------- dump
     def get_dump(self, fmap: str = "", with_stats: bool = False,
                  dump_format: str = "text") -> List[str]:
@@ -1035,6 +1078,49 @@ class Booster:
             return f"f{f}"
 
         return {fname(f): v for f, v in scores.items()}
+
+    def get_fscore(self, fmap: str = "") -> Dict[str, float]:
+        """Split counts per feature (reference ``get_fscore``, core.py:2720 —
+        an alias of weight importance; zero-importance features omitted)."""
+        return self.get_score(fmap, importance_type="weight")
+
+    def get_split_value_histogram(self, feature: str, fmap: str = "",
+                                  bins: Optional[int] = None,
+                                  as_pandas: bool = True):
+        """Histogram of a feature's used split thresholds (reference
+        ``get_split_value_histogram``, core.py:2967)."""
+        import re
+
+        xgdump = self.get_dump(fmap=fmap)
+        regexp = re.compile(r"\[{0}<([\d.Ee+-]+)\]".format(re.escape(feature)))
+        values: List[float] = []
+        for val in xgdump:
+            values.extend(float(x) for x in re.findall(regexp, val))
+
+        n_unique = len(np.unique(values))
+        nbins = max(min(n_unique, bins) if bins is not None else n_unique, 1)
+        nph = np.histogram(values, bins=nbins)
+        nph_stacked = np.column_stack((nph[1][1:], nph[0]))
+        nph_stacked = nph_stacked[nph_stacked[:, 1] > 0]
+        if nph_stacked.size == 0:
+            fn = self.feature_names or [f"f{i}"
+                                        for i in range(self.num_features())]
+            try:
+                index = fn.index(feature)
+                feature_t = (self.feature_types or [])[index]
+            except (ValueError, IndexError, TypeError):
+                feature_t = None
+            if feature_t == "c":
+                raise ValueError(
+                    "Split value histogram doesn't support categorical split.")
+        if as_pandas:
+            try:
+                from pandas import DataFrame
+
+                return DataFrame(nph_stacked, columns=["SplitValue", "Count"])
+            except ImportError:
+                pass
+        return nph_stacked
 
 
 def _jsonable(v: Any) -> bool:

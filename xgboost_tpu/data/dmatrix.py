@@ -80,6 +80,16 @@ class DMatrix:
                 group = loaded.get("group")
                 if group is None:
                     qid = loaded.get("qid")
+            if label_lower_bound is None:
+                label_lower_bound = loaded.get("label_lower_bound")
+            if label_upper_bound is None:
+                label_upper_bound = loaded.get("label_upper_bound")
+            if feature_names is None:
+                feature_names = loaded.get("feature_names")
+            if feature_types is None:
+                feature_types = loaded.get("feature_types")
+                if feature_types is not None and "c" in feature_types:
+                    enable_categorical = True
         X, names, types = to_dense(data, missing, feature_names, feature_types)
         self.X = X
         self.info = MetaInfo(feature_names=names, feature_types=types)
@@ -115,9 +125,46 @@ class DMatrix:
     def num_col(self) -> int:
         return self.X.shape[1]
 
+    def num_nonmissing(self) -> int:
+        """Count of present (non-NaN) entries (reference core.py:1222)."""
+        return int(np.count_nonzero(~np.isnan(self.X)))
+
     @property
     def shape(self):
         return self.X.shape
+
+    # --- feature info (reference core.py:1266-1361) --------------------------
+    @property
+    def feature_names(self) -> Optional[List[str]]:
+        return self.info.feature_names
+
+    @feature_names.setter
+    def feature_names(self, names: Optional[List[str]]) -> None:
+        if names is not None:
+            names = [str(n) for n in names]
+            if len(names) != self.num_col():
+                raise ValueError(
+                    f"feature_names has {len(names)} entries, "
+                    f"expected {self.num_col()}")
+            if len(set(names)) != len(names):
+                raise ValueError("feature_names must be unique")
+        self.info.feature_names = names
+
+    @property
+    def feature_types(self) -> Optional[List[str]]:
+        return self.info.feature_types
+
+    @feature_types.setter
+    def feature_types(self, types: Optional[List[str]]) -> None:
+        if types is not None:
+            if isinstance(types, str):
+                types = [types] * self.num_col()
+            types = list(types)
+            if len(types) != self.num_col():
+                raise ValueError(
+                    f"feature_types has {len(types)} entries, "
+                    f"expected {self.num_col()}")
+        self.info.feature_types = types
 
     # --- meta setters (reference set_info style) ------------------------------
     def set_info(self, **kwargs: Any) -> None:
@@ -134,6 +181,90 @@ class DMatrix:
 
     def get_label(self) -> Optional[np.ndarray]:
         return self.info.labels
+
+    _FLOAT_FIELDS = {"label": "labels", "weight": "weights",
+                     "base_margin": "base_margin",
+                     "label_lower_bound": "label_lower_bound",
+                     "label_upper_bound": "label_upper_bound"}
+
+    def get_float_info(self, field: str) -> np.ndarray:
+        """Reference ``XGDMatrixGetFloatInfo`` (core.py:950): unset fields
+        come back as empty arrays."""
+        if field not in self._FLOAT_FIELDS:
+            raise ValueError(f"unknown float field: {field}")
+        v = getattr(self.info, self._FLOAT_FIELDS[field])
+        return (np.empty(0, np.float32) if v is None
+                else np.asarray(v, np.float32))
+
+    def get_uint_info(self, field: str) -> np.ndarray:
+        if field != "group_ptr":
+            raise ValueError(f"unknown uint field: {field}")
+        v = self.info.group_ptr
+        return np.empty(0, np.uint32) if v is None else np.asarray(v, np.uint32)
+
+    def set_float_info(self, field: str, data: Any) -> None:
+        if field not in self._FLOAT_FIELDS:
+            raise ValueError(f"unknown float field: {field}")
+        self.set_info(**{field: data})
+
+    def set_uint_info(self, field: str, data: Any) -> None:
+        if field != "group_ptr":
+            raise ValueError(f"unknown uint field: {field}")
+        self.info.group_ptr = np.asarray(data, np.int64)
+        self.info.validate(self.num_row())
+
+    def set_label(self, label: Any) -> None:
+        self.set_info(label=label)
+
+    def set_weight(self, weight: Any) -> None:
+        self.set_info(weight=weight)
+
+    def set_base_margin(self, margin: Any) -> None:
+        self.set_info(base_margin=margin)
+
+    def set_group(self, group: Any) -> None:
+        self.set_info(group=group)
+
+    def get_weight(self) -> np.ndarray:
+        return self.get_float_info("weight")
+
+    def get_base_margin(self) -> np.ndarray:
+        return self.get_float_info("base_margin")
+
+    def get_group(self) -> np.ndarray:
+        """Per-query group sizes (inverse of ``set_group``)."""
+        ptr = self.info.group_ptr
+        return (np.empty(0, np.int64) if ptr is None
+                else np.diff(np.asarray(ptr, np.int64)))
+
+    def get_data(self):
+        """Feature payload as scipy CSR with missing entries absent
+        (reference ``get_data``, core.py:1155)."""
+        import scipy.sparse
+
+        present = ~np.isnan(self.X)
+        indptr = np.concatenate(
+            [[0], np.cumsum(present.sum(axis=1))]).astype(np.int64)
+        indices = np.nonzero(present)[1].astype(np.int32)
+        return scipy.sparse.csr_matrix(
+            (self.X[present], indices, indptr), shape=self.X.shape)
+
+    def save_binary(self, fname: str, silent: bool = True) -> None:
+        """Persist this DMatrix for later ``DMatrix(fname)`` loading
+        (reference ``XGDMatrixSaveBinary``, core.py:1040; the format here is
+        an npz container rather than the reference's internal page format)."""
+        payload = {"X": self.X}
+        for attr in ("labels", "weights", "base_margin", "group_ptr",
+                     "label_lower_bound", "label_upper_bound"):
+            v = getattr(self.info, attr)
+            if v is not None:
+                payload[attr] = v
+        if self.info.feature_names is not None:
+            payload["feature_names"] = np.asarray(self.info.feature_names)
+        if self.info.feature_types is not None:
+            payload["feature_types"] = np.asarray(self.info.feature_types)
+        with open(fname, "wb") as fh:
+            np.savez(fh, **payload)
 
     # --- quantization --------------------------------------------------------
     def get_quantile_cut(self, max_bin: int = 256):

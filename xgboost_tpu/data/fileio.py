@@ -127,10 +127,34 @@ def _parse_python(path: str, csv: bool, sep: str):
             np.asarray(qids, np.float32) if has_qid else None, cols)
 
 
+def _load_binary(path: str):
+    """Load a DMatrix.save_binary npz container."""
+    with np.load(path, allow_pickle=False) as z:
+        out = {"X": z["X"].astype(np.float32, copy=False)}
+        for key, field in (("labels", "label"), ("weights", "weight"),
+                           ("base_margin", "base_margin"),
+                           ("label_lower_bound", "label_lower_bound"),
+                           ("label_upper_bound", "label_upper_bound")):
+            if key in z.files:
+                out[field] = z[key]
+        if "group_ptr" in z.files:
+            out["group"] = np.diff(z["group_ptr"].astype(np.int64))
+        if "feature_names" in z.files:
+            out["feature_names"] = [str(s) for s in z["feature_names"]]
+        if "feature_types" in z.files:
+            out["feature_types"] = [str(s) for s in z["feature_types"]]
+    return out
+
+
 def load_uri(uri: str):
     """Load a data file URI -> dict with X (dense f32, NaN=missing), label,
     qid, weight, group, base_margin (aux-file sidecars when present)."""
     path, fmt, label_column = parse_uri(uri)
+    # binary DMatrix saved by DMatrix.save_binary (npz = zip magic "PK")
+    if os.path.exists(path):
+        with open(path, "rb") as fh:
+            if fh.read(2) == b"PK":
+                return _load_binary(path)
     csv = fmt == "csv"
     sep = "\t" if path.endswith(".tsv") else ","
     if fmt not in ("csv", "libsvm"):
