@@ -1,0 +1,76 @@
+"""Input adapter dispatch (reference src/data/adapter.h + arrow-cdi.h):
+numpy, lists, scipy sparse, pandas (incl. categorical), pyarrow tables."""
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.data.adapters import to_dense
+
+
+def test_numpy_and_list():
+    X, names, types = to_dense([[1, 2], [3, 4]])
+    assert X.dtype == np.float32 and X.shape == (2, 2)
+    X1, _, _ = to_dense(np.arange(3.0))
+    assert X1.shape == (3, 1)
+
+
+def test_custom_missing_value():
+    X, _, _ = to_dense(np.asarray([[0.0, 1.0], [2.0, 0.0]]), missing=0.0)
+    assert np.isnan(X[0, 0]) and np.isnan(X[1, 1]) and X[1, 0] == 2.0
+
+
+def test_scipy_sparse():
+    import scipy.sparse as sp
+
+    csr = sp.csr_matrix(np.asarray([[1.0, 0.0], [0.0, 2.0]]))
+    X, _, _ = to_dense(csr)
+    assert X[0, 0] == 1.0 and X[1, 1] == 2.0
+    assert np.isnan(X[0, 1]) and np.isnan(X[1, 0])  # absent = missing
+
+
+def test_pandas_categorical():
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "num": [1.0, 2.0, 3.0],
+        "cat": pd.Categorical(["a", "b", None]),
+        "i": np.asarray([1, 2, 3], np.int64),
+    })
+    X, names, types = to_dense(df)
+    assert names == ["num", "cat", "i"]
+    assert types == ["float", "c", "int"]
+    assert X[1, 1] == 1.0 and np.isnan(X[2, 1])
+
+
+def test_pyarrow_table():
+    pa = pytest.importorskip("pyarrow")
+
+    t = pa.table({
+        "a": [1.0, 2.0, None],
+        "b": np.asarray([4, 5, 6], np.int32),
+        "c": pa.array(["x", "y", None]).dictionary_encode(),
+    })
+    X, names, types = to_dense(t)
+    assert names == ["a", "b", "c"]
+    assert types == ["float", "int", "c"]
+    assert np.isnan(X[2, 0]) and np.isnan(X[2, 2])
+    assert X[1, 2] == 1.0 and X[0, 1] == 4.0
+    # chunked table (concat produces multi-chunk columns)
+    t2 = pa.concat_tables([t, t])
+    X2, _, _ = to_dense(t2)
+    assert X2.shape == (6, 3)
+    np.testing.assert_array_equal(X2[:3], X)
+
+
+def test_pyarrow_in_dmatrix_train():
+    pa = pytest.importorskip("pyarrow")
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    Xn = rng.randn(500, 3).astype(np.float32)
+    y = (Xn[:, 0] > 0).astype(np.float32)
+    t = pa.table({f"f{i}": Xn[:, i] for i in range(3)})
+    dm = xgb.DMatrix(t, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, dm, 3)
+    auc_pred = bst.predict(dm)
+    assert ((auc_pred > 0.5) == y).mean() > 0.8

@@ -20,6 +20,34 @@ def to_dense(data: Any, missing: float = np.nan,
              feature_types: Optional[List[str]] = None,
              ) -> Tuple[np.ndarray, Optional[List[str]], Optional[List[str]]]:
     """Returns (X float32 with NaN missing, feature_names, feature_types)."""
+    # pyarrow Table / RecordBatch (reference consumes Arrow via the C data
+    # interface, src/data/arrow-cdi.h; here columns convert directly)
+    if hasattr(data, "schema") and hasattr(data, "column_names"):
+        import pyarrow as pa  # soft dep, baked in
+        names = [str(c) for c in data.column_names]
+        types = []
+        cols = []
+        for i, name in enumerate(data.column_names):
+            col = data.column(i)
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            if pa.types.is_dictionary(col.type):
+                codes = col.indices.to_numpy(zero_copy_only=False).astype(
+                    np.float32)
+                if col.null_count:
+                    mask = col.is_null().to_numpy(zero_copy_only=False)
+                    codes[mask] = np.nan
+                cols.append(codes)
+                types.append("c")
+            else:
+                arr = col.to_numpy(zero_copy_only=False).astype(np.float32)
+                cols.append(arr)
+                types.append("int" if pa.types.is_integer(col.type)
+                             else "float")
+        X = np.stack(cols, axis=1) if cols else np.empty((0, 0), np.float32)
+        return (_mask_missing(X, missing), feature_names or names,
+                feature_types or types)
+
     # pandas
     if hasattr(data, "dtypes") and hasattr(data, "columns"):
         import pandas as pd  # soft dep, baked in

@@ -171,6 +171,13 @@ def init(communicator: str = "noop", **kwargs: Any) -> None:
         _comm = NoOpCommunicator()
     elif communicator in ("jax", "rabit"):  # rabit name kept for API parity
         _comm = JaxProcessCommunicator()
+    elif communicator == "federated":
+        from .federated import FederatedCommunicator
+
+        _comm = FederatedCommunicator(
+            kwargs.pop("federated_server_address"),
+            int(kwargs.pop("federated_world_size")),
+            int(kwargs.pop("federated_rank")), **kwargs)
     else:
         raise ValueError(f"unknown communicator type: {communicator}")
 
