@@ -1,0 +1,175 @@
+"""Reference-format model interop (doc/model.schema): hand-built reference
+fixtures decode with exact decision semantics (x < cond left, in-set right),
+and our models round-trip through the reference schema bit-exactly."""
+
+import json
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.interop import (is_reference_model, native_to_reference_json,
+                                 save_xgboost_model)
+
+
+def _ref_model(trees, objective=None, base_score="5E-1", num_class=0,
+               booster="gbtree", extra_gb=None):
+    gb = {"name": booster,
+          "model": {"gbtree_model_param": {
+                        "num_trees": str(len(trees)),
+                        "num_parallel_tree": "1"},
+                    "trees": trees,
+                    "tree_info": [0] * len(trees),
+                    "iteration_indptr": list(range(len(trees) + 1))}}
+    if extra_gb:
+        gb.update(extra_gb)
+    return {
+        "version": [2, 0, 0],
+        "learner": {
+            "attributes": {},
+            "feature_names": [],
+            "feature_types": [],
+            "learner_model_param": {"base_score": base_score,
+                                    "num_class": str(num_class),
+                                    "num_feature": "2",
+                                    "num_target": "1"},
+            "objective": objective or {"name": "reg:squarederror",
+                                       "reg_loss_param": {
+                                           "scale_pos_weight": "1"}},
+            "gradient_booster": gb,
+        },
+    }
+
+
+def _stump(cond=2.0, left=1.0, right=-1.0, default_left=1):
+    return {
+        "tree_param": {"num_nodes": "3", "num_feature": "2",
+                       "size_leaf_vector": "1"},
+        "id": 0,
+        "left_children": [1, -1, -1],
+        "right_children": [2, -1, -1],
+        "parents": [2147483647, 0, 0],
+        "split_indices": [0, 0, 0],
+        "split_conditions": [cond, left, right],
+        "split_type": [0, 0, 0],
+        "default_left": [default_left, 0, 0],
+        "loss_changes": [10.0, 0.0, 0.0],
+        "sum_hessian": [6.0, 3.0, 3.0],
+        "base_weights": [0.0, left, right],
+        "categories": [],
+        "categories_nodes": [],
+        "categories_segments": [],
+        "categories_sizes": [],
+    }
+
+
+def test_reference_stump_decision_semantics(tmp_path):
+    """x < 2.0 goes left in the reference; the boundary x == 2.0 goes right,
+    NaN follows default_left."""
+    ref = _ref_model([_stump()], base_score="0")
+    fname = str(tmp_path / "ref.json")
+    with open(fname, "w") as fh:
+        json.dump(ref, fh)
+    bst = xgb.Booster(model_file=fname)
+    X = np.asarray([[1.9999999, 0.0], [2.0, 0.0], [2.0000001, 0.0],
+                    [np.nan, 0.0]], np.float32)
+    preds = bst.predict(xgb.DMatrix(X), output_margin=True)
+    np.testing.assert_allclose(preds, [1.0, -1.0, -1.0, 1.0])
+
+
+def test_reference_base_score_logistic(tmp_path):
+    """base_score is user-space in the file: 0.5 -> margin 0 for logistic."""
+    ref = _ref_model([_stump(left=0.0, right=0.0)],
+                     objective={"name": "binary:logistic",
+                                "reg_loss_param": {"scale_pos_weight": "1"}},
+                     base_score="5E-1")
+    bst = xgb.Booster()
+    bst.load_model(json.dumps(ref).encode())
+    p = bst.predict(xgb.DMatrix(np.zeros((1, 2), np.float32)))
+    np.testing.assert_allclose(p, [0.5], atol=1e-7)
+
+
+def test_reference_categorical_right_set():
+    """Reference stores the RIGHT-branch category set."""
+    t = _stump()
+    t["split_type"] = [1, 0, 0]
+    t["categories"] = [1, 3]
+    t["categories_nodes"] = [0]
+    t["categories_segments"] = [0]
+    t["categories_sizes"] = [2]
+    bst = xgb.Booster()
+    bst.load_model(json.dumps(_ref_model([t], base_score="0")).encode())
+    X = np.asarray([[0.0, 0], [1.0, 0], [2.0, 0], [3.0, 0]], np.float32)
+    preds = bst.predict(xgb.DMatrix(X), output_margin=True)
+    np.testing.assert_allclose(preds, [1.0, -1.0, 1.0, -1.0])
+
+
+def test_reference_gblinear():
+    ref = _ref_model([], base_score="0")
+    ref["learner"]["gradient_booster"] = {
+        "name": "gblinear",
+        # [(num_feature+1) x 1]: w0, w1, bias
+        "model": {"weights": [2.0, -1.0, 0.5]}}
+    bst = xgb.Booster()
+    bst.load_model(json.dumps(ref).encode())
+    X = np.asarray([[1.0, 1.0], [2.0, 0.0]], np.float32)
+    preds = bst.predict(xgb.DMatrix(X), output_margin=True)
+    np.testing.assert_allclose(preds, [2.0 - 1.0 + 0.5, 4.0 + 0.5])
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(9)
+    X = rng.randn(3000, 6).astype(np.float32)
+    X[rng.rand(3000, 6) < 0.05] = np.nan
+    y = (np.nansum(X[:, :3], axis=1) > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 5,
+                     "eta": 0.3}, dm, 8)
+    return bst, dm
+
+
+def test_export_round_trip(trained, tmp_path):
+    """ours -> reference schema -> ours: identical predictions."""
+    bst, dm = trained
+    ref = native_to_reference_json(bst)
+    assert is_reference_model(ref)
+    assert ref["learner"]["gradient_booster"]["name"] == "gbtree"
+    fname = str(tmp_path / "export.json")
+    save_xgboost_model(bst, fname)
+    back = xgb.Booster(model_file=fname)
+    np.testing.assert_allclose(back.predict(dm), bst.predict(dm),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_export_round_trip_dart(tmp_path):
+    rng = np.random.RandomState(2)
+    X = rng.randn(1000, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "booster": "dart",
+                     "rate_drop": 0.2, "max_depth": 3}, dm, 5)
+    fname = str(tmp_path / "dart.json")
+    save_xgboost_model(bst, fname)
+    back = xgb.Booster(model_file=fname)
+    np.testing.assert_allclose(back.predict(dm), bst.predict(dm),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_multiclass_import():
+    trees = []
+    for g in range(3):
+        t = _stump(left=float(g), right=-float(g))
+        trees.append(t)
+    ref = _ref_model(trees,
+                     objective={"name": "multi:softprob",
+                                "softmax_multiclass_param": {
+                                    "num_class": "3"}},
+                     base_score="5E-1", num_class=3)
+    ref["learner"]["gradient_booster"]["model"]["tree_info"] = [0, 1, 2]
+    ref["learner"]["gradient_booster"]["model"]["iteration_indptr"] = [0, 3]
+    bst = xgb.Booster()
+    bst.load_model(json.dumps(ref).encode())
+    p = bst.predict(xgb.DMatrix(np.asarray([[0.0, 0.0]], np.float32)))
+    assert p.shape == (1, 3)
+    np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-6)

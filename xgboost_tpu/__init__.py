@@ -12,6 +12,7 @@ from .config import config_context, get_config, set_config
 from .context import Context, make_data_mesh
 from .core import Booster, train
 from .data.dmatrix import DataIter, DMatrix, QuantileDMatrix
+from .interop import load_xgboost_model, save_xgboost_model
 from .parallel import collective
 from .plotting import plot_importance, plot_tree, to_graphviz
 from .sklearn import (XGBClassifier, XGBModel, XGBRanker, XGBRegressor,
@@ -27,5 +28,6 @@ __all__ = [
     "XGBModel", "XGBRegressor", "XGBClassifier", "XGBRanker",
     "XGBRFRegressor", "XGBRFClassifier",
     "plot_importance", "plot_tree", "to_graphviz",
-    "config_context", "set_config", "get_config", "__version__",
+    "config_context", "set_config", "get_config",
+    "load_xgboost_model", "save_xgboost_model", "__version__",
 ]

@@ -925,6 +925,10 @@ class Booster:
         # (reference re-queues trees_to_update on LoadModel, gbtree.cc:364)
         if hasattr(self, "_trees_to_update"):
             del self._trees_to_update
+        from .interop import is_reference_model, reference_to_native_json
+
+        if is_reference_model(obj):
+            obj = reference_to_native_json(obj)
         learner = obj["learner"]
         cfg = obj.get("config", {})
         self.tree_param = TrainParam.from_dict(cfg.get("tree_param", {}))

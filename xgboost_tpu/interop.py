@@ -1,0 +1,308 @@
+"""Reference-format model interop.
+
+Load and save models in the reference XGBoost JSON/UBJSON schema
+(``/root/reference/doc/model.schema``; writer ``src/tree/tree_model.cc:1169``,
+reader ``:1030``), so models move between the reference implementation and
+this framework in both directions. ``Booster.load_model`` auto-detects the
+format; ``save_xgboost_model`` exports.
+
+Semantics bridged here:
+
+- Split comparison: the reference routes ``x < split_condition`` left
+  (``include/xgboost/tree_model.h`` ``Node::cindex``); this framework routes
+  ``x <= split_value`` left. Conversion nudges thresholds one f32 ulp
+  (``nextafter``), which preserves the decision for every float input.
+- Leaf values ride in ``split_conditions`` on leaf rows (reference
+  ``LoadModelImpl``, tree_model.cc:1030-1084) — same convention as our
+  native tree JSON.
+- Categorical splits: the reference stores the RIGHT-branch category set
+  (in-set goes right, ``src/common/categorical.h:55``); our trees store the
+  LEFT set, so sets are complemented over the observed category domain.
+  Categories beyond every split set's maximum follow the missing direction
+  here but go left in the reference — only reachable for category codes
+  never seen in any split.
+- ``base_score`` is user-space in the reference file (margin =
+  ``ObjFunction::ProbToMargin``, src/learner.cc:395); our boosters hold the
+  margin, so the objective's transform is applied on load and inverted on
+  save.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .objective import get_objective
+
+
+def is_reference_model(obj: Dict[str, Any]) -> bool:
+    """True when a model dict follows the reference schema (booster payload
+    nested under ``gradient_booster.model`` / dart's ``gbtree``)."""
+    gb = obj.get("learner", {}).get("gradient_booster", {})
+    return isinstance(gb, dict) and ("model" in gb or "gbtree" in gb)
+
+
+def _f(x: Any) -> float:
+    return float(x)
+
+
+def _convert_tree(t: Dict[str, Any]) -> Dict[str, Any]:
+    """Reference per-tree arrays -> our native tree JSON dict."""
+    left = np.asarray(t["left_children"], np.int32)
+    n = len(left)
+    is_leaf = left < 0
+    conds = np.asarray([_f(c) for c in t["split_conditions"]], np.float64)
+    # reference: x < cond -> left; ours: x <= value -> left
+    adj = np.where(is_leaf, conds,
+                   np.nextafter(conds.astype(np.float32), np.float32("-inf")))
+    split_type = [int(x) for x in t.get("split_type", [0] * n)]
+
+    cats: Dict[str, List[int]] = {}
+    cat_nodes = [int(x) for x in t.get("categories_nodes", [])]
+    if cat_nodes:
+        segments = [int(x) for x in t.get("categories_segments", [])]
+        sizes = [int(x) for x in t.get("categories_sizes", [])]
+        members = [int(x) for x in t.get("categories", [])]
+        n_cats = max(members, default=0) + 1
+        for node, seg, size in zip(cat_nodes, segments, sizes):
+            right_set = set(members[seg:seg + size])
+            cats[str(node)] = [c for c in range(n_cats)
+                               if c not in right_set]
+    return {
+        "left_children": left.tolist(),
+        "right_children": [int(x) for x in t["right_children"]],
+        "split_indices": [int(x) for x in t["split_indices"]],
+        "split_conditions": adj.tolist(),
+        "default_left": [int(x) for x in t["default_left"]],
+        "loss_changes": [_f(x) for x in t.get("loss_changes", [0] * n)],
+        "sum_hessian": [_f(x) for x in t.get("sum_hessian", [0] * n)],
+        "base_weights": [_f(x) for x in t.get("base_weights", [0] * n)],
+        "split_type": split_type,
+        "categories": cats,
+    }
+
+
+def _flatten_objective(objective: Dict[str, Any]) -> Dict[str, Any]:
+    """Reference nests objective params one level (e.g. ``reg_loss_param``)."""
+    out: Dict[str, Any] = {}
+    for v in objective.values():
+        if isinstance(v, dict):
+            out.update(v)
+    return out
+
+
+def _gbtree_payload(gb: Dict[str, Any]) -> Dict[str, Any]:
+    model = gb["model"]
+    trees = [_convert_tree(t) for t in model["trees"]]
+    for t, ref in zip(trees, model["trees"]):
+        slv = int(ref.get("tree_param", {}).get("size_leaf_vector", 1) or 1)
+        if slv > 1:
+            raise NotImplementedError(
+                "vector-leaf (multi_output_tree) reference models are not "
+                "supported yet")
+    mp = model.get("gbtree_model_param", {})
+    n_trees = len(trees)
+    indptr = [int(x) for x in model.get("iteration_indptr", [])]
+    if not indptr:
+        per_iter = max(1, int(mp.get("num_parallel_tree", 1) or 1))
+        indptr = list(range(0, n_trees + 1, per_iter)) or [0, n_trees]
+    return {
+        "name": "gbtree",
+        "num_parallel_tree": int(mp.get("num_parallel_tree", 1) or 1),
+        "multi_strategy": "one_output_per_tree",
+        "trees": trees,
+        "tree_info": [int(x) for x in model.get("tree_info", [0] * n_trees)],
+        "iteration_indptr": indptr,
+    }
+
+
+def reference_to_native_json(ref: Dict[str, Any]) -> Dict[str, Any]:
+    """Reference model dict -> our native model dict (Booster JSON schema)."""
+    learner = ref["learner"]
+    gb = learner["gradient_booster"]
+    name = gb.get("name", "gbtree")
+
+    objective = learner.get("objective", {})
+    obj_name = objective.get("name", "reg:squarederror")
+    obj_params = _flatten_objective(objective)
+    lmp = learner.get("learner_model_param", {})
+    num_class = int(lmp.get("num_class", 0) or 0)
+    num_target = int(lmp.get("num_target", 1) or 1)
+    if num_class:
+        obj_params["num_class"] = num_class
+    obj = get_objective(obj_name, dict(obj_params))
+    base_user = float(lmp.get("base_score", 0.5) or 0.5)
+    n_groups = max(num_class, num_target, 1)
+    margin = np.asarray(
+        obj.prob_to_margin(np.full((1,), base_user, np.float64))
+    ).reshape(-1)
+    base = np.broadcast_to(margin.astype(np.float32), (n_groups,)) \
+        if margin.size == 1 else margin.astype(np.float32)
+
+    if name == "gbtree":
+        booster = _gbtree_payload(gb)
+    elif name == "dart":
+        booster = _gbtree_payload(gb["gbtree"])
+        booster["name"] = "dart"
+        booster["weight_drop"] = [_f(w) for w in gb["weight_drop"]]
+    elif name == "gblinear":
+        # reference layout (src/gbm/gblinear_model.h): flat
+        # [(num_feature + 1) x num_group], bias row last
+        weights = np.asarray([_f(w) for w in gb["model"]["weights"]],
+                             np.float32)
+        W = weights.reshape(-1, n_groups)
+        booster = {"name": "gblinear", "updater": "shotgun",
+                   "weights": W[:-1].tolist(), "bias": W[-1].tolist(),
+                   "rounds": 0}
+    else:
+        raise ValueError(f"unknown reference booster: {name}")
+
+    return {
+        "version": [int(v) for v in ref.get("version", [2, 0, 0])],
+        "learner": {
+            "attributes": dict(learner.get("attributes", {})),
+            "feature_names": list(learner.get("feature_names", [])),
+            "feature_types": list(learner.get("feature_types", [])),
+            "learner_model_param": {
+                "base_score": base.tolist(),
+                "num_class": num_class,
+                "num_target": n_groups,
+            },
+            "objective": {"name": obj_name, **obj_params},
+            "gradient_booster": booster,
+        },
+        "config": {"learner_params": {"objective": obj_name,
+                                      "booster": booster["name"]}},
+    }
+
+
+# --------------------------------------------------------------------- export
+
+def _tree_to_reference(t, num_feature: int) -> Dict[str, Any]:
+    n = t.num_nodes()
+    is_leaf = t.is_leaf
+    conds = np.where(
+        is_leaf, t.leaf_value.astype(np.float64),
+        np.nextafter(t.split_value.astype(np.float32), np.float32("inf"))
+        .astype(np.float64))
+    cat_nodes = [int(c) for c in np.nonzero(t.is_cat_split)[0]]
+    categories: List[int] = []
+    segments: List[int] = []
+    sizes: List[int] = []
+    n_cats = t.cat_words.shape[1] * 32
+    for c in cat_nodes:
+        w = t.cat_words[c]
+        left_set = {b for b in range(n_cats) if (w[b // 32] >> (b % 32)) & 1}
+        right = sorted(set(range(n_cats)) - left_set)
+        segments.append(len(categories))
+        sizes.append(len(right))
+        categories.extend(right)
+    return {
+        "tree_param": {"num_nodes": str(n), "num_feature": str(num_feature),
+                       "size_leaf_vector": "1",
+                       "num_deleted": "0"},
+        "id": 0,
+        "left_children": t.left_child.tolist(),
+        "right_children": t.right_child.tolist(),
+        "parents": [int(p) if p >= 0 else 2147483647 for p in t.parent],
+        "split_indices": [int(max(f, 0)) for f in t.split_feature],
+        "split_conditions": conds.tolist(),
+        "split_type": [int(x) for x in t.is_cat_split],
+        "default_left": [int(d) for d in t.default_left],
+        "loss_changes": t.gain.astype(np.float64).tolist(),
+        "sum_hessian": t.sum_hess.astype(np.float64).tolist(),
+        "base_weights": t.base_weight.astype(np.float64).tolist(),
+        "categories": categories,
+        "categories_nodes": cat_nodes,
+        "categories_segments": segments,
+        "categories_sizes": sizes,
+    }
+
+
+def native_to_reference_json(booster) -> Dict[str, Any]:
+    """Our Booster -> reference-schema model dict (gbtree/dart only)."""
+    from .boosting.dart import Dart
+    from .boosting.gblinear import GBLinear
+    from .boosting.gbtree import GBTree
+
+    booster._configure(None)
+    gbm = booster.gbm
+    obj = booster.obj
+    nf = booster.num_features()
+    n_groups = booster.n_groups
+
+    if isinstance(gbm, GBLinear):
+        W = np.asarray(gbm.W) if gbm.W is not None \
+            else np.zeros((nf, n_groups), np.float32)
+        b = np.asarray(gbm.bias) if gbm.bias is not None \
+            else np.zeros((n_groups,), np.float32)
+        flat = np.concatenate([W, b[None, :]], axis=0).reshape(-1)
+        gb_json: Dict[str, Any] = {
+            "name": "gblinear",
+            "model": {"weights": flat.astype(np.float64).tolist()}}
+    elif isinstance(gbm, GBTree):
+        trees = []
+        for i, t in enumerate(gbm.trees):
+            tj = _tree_to_reference(t, nf)
+            tj["id"] = i
+            trees.append(tj)
+        model = {
+            "gbtree_model_param": {
+                "num_trees": str(len(trees)),
+                "num_parallel_tree": str(gbm.num_parallel_tree)},
+            "trees": trees,
+            "tree_info": [int(x) for x in gbm.tree_info],
+            "iteration_indptr": [int(x) for x in gbm.iteration_indptr],
+        }
+        if isinstance(gbm, Dart):
+            gb_json = {"name": "dart",
+                       "gbtree": {"name": "gbtree", "model": model},
+                       "weight_drop": [float(w) for w in gbm.weight_drop]}
+        else:
+            gb_json = {"name": "gbtree", "model": model}
+    else:
+        raise NotImplementedError(type(gbm).__name__)
+
+    margin = (booster.base_margin_ if booster.base_margin_ is not None
+              else np.zeros(n_groups, np.float32))
+    import jax.numpy as jnp
+
+    user = np.asarray(obj.pred_transform(
+        jnp.asarray(margin, jnp.float32)[None, :])).reshape(-1)
+    base_score = float(user[0])
+
+    return {
+        "version": [2, 0, 0],
+        "learner": {
+            "attributes": dict(booster.attributes_),
+            "feature_names": booster.feature_names or [],
+            "feature_types": booster.feature_types or [],
+            "learner_model_param": {
+                "base_score": f"{base_score:.17g}",
+                "boost_from_average": "1",
+                "num_class": str(int(
+                    booster.learner_params.get("num_class", 0))),
+                "num_feature": str(nf),
+                "num_target": str(n_groups),
+            },
+            "objective": obj.to_json() if obj else {"name": "reg:squarederror"},
+            "gradient_booster": gb_json,
+        },
+    }
+
+
+def load_xgboost_model(source) -> "Booster":  # noqa: F821
+    """Build a Booster from a reference-format model (path / bytes / dict)."""
+    from .core import Booster
+
+    bst = Booster()
+    bst.load_model(source)
+    return bst
+
+
+def save_xgboost_model(booster, fname: str) -> None:
+    """Write a Booster as a reference-schema JSON model file."""
+    with open(fname, "w") as fh:
+        json.dump(native_to_reference_json(booster), fh)

@@ -174,6 +174,11 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
     F, n = bins_t.shape
     B, N = max_nbins, n_nodes
 
+    if precision == "bf16x2":
+        # two bf16 operand planes + two matmul intermediates: the default
+        # 2048-row block busts the 16M scoped-VMEM limit at 256 bins (the
+        # feature block can't shrink below 8 — sublane minimum)
+        block_rows = min(block_rows, 1024)
     R = min(block_rows, max(_round_up(n, 128), 128))
     n_pad = _round_up(max(n, R), R)
     F_blk = min(feat_block, F)
