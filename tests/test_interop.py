@@ -173,3 +173,75 @@ def test_multiclass_import():
     p = bst.predict(xgb.DMatrix(np.asarray([[0.0, 0.0]], np.float32)))
     assert p.shape == (1, 3)
     np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-6)
+
+
+def _encode_ubj_typed(obj):
+    """Reference-style UBJSON encoder: numeric lists as strongly-typed
+    arrays ([$d#... / [$l#...), the layout UBJWriter produces."""
+    import io
+    import struct
+
+    out = io.BytesIO()
+
+    def w_int(n):
+        out.write(b"l" + struct.pack(">i", n))
+
+    def w_key(s):
+        b = s.encode()
+        w_int(len(b))
+        out.write(b)
+
+    def w(o):
+        if isinstance(o, dict):
+            out.write(b"{")
+            for k, v in o.items():
+                w_key(str(k))
+                w(v)
+            out.write(b"}")
+        elif isinstance(o, list):
+            if o and all(isinstance(x, float) for x in o):
+                out.write(b"[$d#")
+                w_int(len(o))
+                for x in o:
+                    out.write(struct.pack(">f", x))
+            elif o and all(isinstance(x, int) for x in o):
+                out.write(b"[$l#")
+                w_int(len(o))
+                for x in o:
+                    out.write(struct.pack(">i", x))
+            else:
+                out.write(b"[")
+                for x in o:
+                    w(x)
+                out.write(b"]")
+        elif isinstance(o, bool):
+            out.write(b"T" if o else b"F")
+        elif isinstance(o, int):
+            w_int(o)
+        elif isinstance(o, float):
+            out.write(b"D" + struct.pack(">d", o))
+        elif isinstance(o, str):
+            out.write(b"S")
+            w_key(o)
+        else:
+            raise TypeError(type(o))
+
+    w(obj)
+    return out.getvalue()
+
+
+def test_reference_ubjson_typed_arrays():
+    """Reference .ubj models use strongly-typed sized arrays; loading the
+    binary buffer must match the JSON load."""
+    t = _stump()
+    # make numeric arrays float-typed like the reference writer does
+    for k in ("split_conditions", "loss_changes", "sum_hessian",
+              "base_weights"):
+        t[k] = [float(x) for x in t[k]]
+    ref = _ref_model([t], base_score="0")
+    raw = _encode_ubj_typed(ref)
+    bst = xgb.Booster()
+    bst.load_model(raw)
+    X = np.asarray([[1.0, 0.0], [3.0, 0.0]], np.float32)
+    preds = bst.predict(xgb.DMatrix(X), output_margin=True)
+    np.testing.assert_allclose(preds, [1.0, -1.0])

@@ -882,9 +882,11 @@ class Booster:
     def load_model(self, fname: Union[str, bytes, bytearray]) -> None:
         if isinstance(fname, (bytes, bytearray)):
             raw = bytes(fname)
-            if raw[:1] in (b"{",):
+            # a UBJSON object also begins with the byte '{' — sniff JSON
+            # first, fall back to the binary codec
+            try:
                 obj = json.loads(raw.decode())
-            else:
+            except (UnicodeDecodeError, ValueError):
                 from .utils.ubjson import loads_ubjson
                 obj = loads_ubjson(raw)
         elif str(fname).endswith(".ubj"):

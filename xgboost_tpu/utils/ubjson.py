@@ -91,6 +91,10 @@ def _write(obj: Any, out: io.BytesIO) -> None:
 _INT_FMT = {b"i": (">b", 1), b"U": (">B", 1), b"I": (">h", 2),
             b"l": (">i", 4), b"L": (">q", 8)}
 
+# strongly-typed array payload dtypes (big-endian per the UBJSON spec)
+_TYPED_DTYPE = {b"i": ">i1", b"U": ">u1", b"I": ">i2", b"l": ">i4",
+                b"L": ">i8", b"d": ">f4", b"D": ">f8"}
+
 
 def _read_int(raw: bytes, pos: int):
     tag = raw[pos:pos + 1]
@@ -121,17 +125,50 @@ def _read(raw: bytes, pos: int):
         return _read_str_payload(raw, pos + 1)
     if tag == b"{":
         pos += 1
+        count = None
+        if raw[pos:pos + 1] == b"#":  # sized object
+            count, pos = _read_int(raw, pos + 1)
         obj = {}
-        while raw[pos:pos + 1] != b"}":
+        while (len(obj) < count) if count is not None \
+                else (raw[pos:pos + 1] != b"}"):
             key, pos = _read_str_payload(raw, pos)
             val, pos = _read(raw, pos)
             obj[key] = val
-        return obj, pos + 1
+        return obj, pos + (count is None)
     if tag == b"[":
         pos += 1
+        typ = None
+        count = None
+        if raw[pos:pos + 1] == b"$":  # strongly-typed array (reference
+            typ = raw[pos + 1:pos + 2]  # UBJWriter writes these for model
+            pos += 2                    # arrays, include/xgboost/json_io.h)
+            if raw[pos:pos + 1] != b"#":
+                raise ValueError("typed UBJSON array missing count")
+        if raw[pos:pos + 1] == b"#":
+            count, pos = _read_int(raw, pos + 1)
+        if typ is not None:
+            if typ in _TYPED_DTYPE:
+                import numpy as np
+
+                dt = np.dtype(_TYPED_DTYPE[typ])
+                end = pos + count * dt.itemsize
+                arr = np.frombuffer(raw, dt, count, pos)
+                return arr.astype(dt.newbyteorder("=")), end
+            if typ == b"S":
+                out = []
+                for _ in range(count):
+                    s, pos = _read_str_payload(raw, pos)
+                    out.append(s)
+                return out, pos
+            if typ in (b"T", b"F", b"Z"):
+                return [{b"T": True, b"F": False, b"Z": None}[typ]] * count, pos
+            if typ == b"C":
+                return [chr(c) for c in raw[pos:pos + count]], pos + count
+            raise ValueError(f"unsupported typed-array tag {typ!r}")
         arr = []
-        while raw[pos:pos + 1] != b"]":
+        while (len(arr) < count) if count is not None \
+                else (raw[pos:pos + 1] != b"]"):
             val, pos = _read(raw, pos)
             arr.append(val)
-        return arr, pos + 1
+        return arr, pos + (count is None)
     raise ValueError(f"bad UBJSON tag {tag!r} at {pos}")
