@@ -150,11 +150,24 @@ class JaxProcessCommunicator(Communicator):
         raise ValueError(f"unknown op {op}")
 
     def allgather_objects(self, obj: Any) -> List[Any]:
+        """Per-rank objects, any picklable payload. process_allgather only
+        stacks identically-shaped array leaves, so ranks exchange padded
+        pickle buffers instead (same symmetric-collective trick as
+        apply_with_labels)."""
         if self._world == 1:
             return [obj]
+        import pickle
+
         from jax.experimental import multihost_utils
 
-        return list(multihost_utils.process_allgather(obj, tiled=False))
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        lengths = multihost_utils.process_allgather(
+            np.asarray([len(payload)], np.int64), tiled=False).reshape(-1)
+        buf = np.zeros(int(lengths.max()), np.uint8)
+        buf[: len(payload)] = payload
+        mat = multihost_utils.process_allgather(buf, tiled=False)
+        return [pickle.loads(mat[r, : int(lengths[r])].tobytes())
+                for r in range(self._world)]
 
 
 # --- global communicator (reference collective::Init / CommunicatorContext) --
@@ -214,6 +227,9 @@ class CommunicatorContext:
 
     def __init__(self, communicator: Optional[Communicator] = None,
                  **init_kwargs: Any) -> None:
+        if isinstance(communicator, str):  # name, not instance: route to init
+            init_kwargs["communicator"] = communicator
+            communicator = None
         self._explicit = communicator
         self._init_kwargs = init_kwargs
 

@@ -48,7 +48,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
     ``DMLC_TRACKER_URI``/``DMLC_TRACKER_PORT``)."""
     import jax
 
-    if jax.process_count() > 1:
+    # Detect an existing distributed session WITHOUT touching
+    # jax.process_count(): that call initializes the backends, after which
+    # jax.distributed.initialize() can no longer join a cluster.
+    from jax._src import distributed as _jdist
+
+    if getattr(_jdist.global_state, "coordinator_address", None):
         return  # already initialized
     if coordinator_address is None and num_processes is None:
         import os
