@@ -104,3 +104,53 @@ def test_iterator_weights_respected():
     p = bst.predict(xgb.DMatrix(X))
     # heavy positive weights skew predictions positive
     assert float(np.mean(p)) > 0.55
+
+
+def test_iterator_built_matrix_is_external_memory(tmp_path):
+    """Iterator construction must not retain the raw float matrix, and
+    cache_prefix spills the quantized pages to a disk memmap (reference
+    SparsePageDMatrix tier)."""
+    import os
+
+    X, y = _data(seed=7)
+    prefix = os.path.join(tmp_path, "cache")
+    qdm = xgb.QuantileDMatrix(BatchIter(X, y, 4), max_bin=64)
+    assert qdm.X is None
+    assert qdm.shape == X.shape
+    assert qdm.num_nonmissing() == X.size
+    ext = xgb.DMatrix(BatchIter(X, y, 4))  # plain DMatrix from iterator
+    assert ext.X is None and ext.num_row() == len(X)
+
+    class CachedIter(BatchIter):
+        def __init__(self):
+            BatchIter.__init__(self, X, y, 4)
+            self.cache_prefix = prefix
+
+    dm = xgb.DMatrix(CachedIter())
+    assert os.path.exists(prefix + ".bins")
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4},
+                    dm, 5, verbose_eval=False)
+    p = bst.predict(dm)  # predict from quantized-only data
+    assert float(np.mean((p > 0.5) == y)) > 0.9
+
+
+def test_iterator_matrix_predict_and_guards():
+    X, y = _data(seed=8)
+    qdm = xgb.QuantileDMatrix(BatchIter(X, y, 3), max_bin=96)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "max_bin": 96}, qdm, 6, verbose_eval=False)
+    # predicting on the X-less matrix reconstructs values from bins:
+    # quality must match predicting on the raw matrix
+    p_binned = bst.predict(qdm)
+    p_raw = bst.predict(xgb.DMatrix(X))
+    assert float(np.mean((p_binned > 0.5) == (p_raw > 0.5))) > 0.99
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        qdm.slice(np.arange(5))
+    with _pytest.raises(ValueError):
+        qdm.get_data()
+    with _pytest.raises(ValueError):
+        qdm.save_binary("/tmp/x.buffer")
+    with _pytest.raises(ValueError):
+        qdm.binned(17)  # re-quantization impossible without raw data

@@ -449,7 +449,7 @@ class Booster:
                 # their split_bin indices are meaningless here (same reason
                 # the eval path falls back to raw for loaded models)
                 delta = self.gbm.margin_delta_raw(
-                    np.asarray(state["dm"].X), state["n_trees"], total)
+                    np.asarray(state["dm"].values()), state["n_trees"], total)
                 state["margin"] = state["margin"] + jnp.asarray(delta)
             else:
                 state["margin"] = self.gbm.compute_margin(state)
@@ -613,7 +613,7 @@ class Booster:
         if gpair.ndim == 2:
             gpair = gpair[:, None, :]
         n = dtrain.num_row()
-        X = np.asarray(dtrain.X, np.float32)
+        X = np.asarray(dtrain.values(), np.float32)
         for t_idx in range(old_indptr[it], old_indptr[it + 1]):
             tree = old_trees[t_idx]
             k = old_info[t_idx]
@@ -671,7 +671,7 @@ class Booster:
                 state["binned"], state["n_trees"], total)
         else:
             state["margin"] = state["margin"] + self.gbm.margin_delta_raw(
-                dm.X, state["n_trees"], total)
+                dm.values(), state["n_trees"], total)
         state["n_trees"] = total
         return state["margin"]
 
@@ -695,7 +695,7 @@ class Booster:
             return self._predict_contribs(
                 data, approx=approx_contribs, interactions=pred_interactions,
                 iteration_range=iteration_range, strict_shape=strict_shape)
-        X = data.X
+        X = data.values()
         base = self.base_margin_ if self.base_margin_ is not None else \
             np.zeros(self.n_groups, np.float32)
         m, pos, trees = self.gbm.predict_margin(
@@ -725,7 +725,7 @@ class Booster:
         from .boosting import shap as shap_mod
         from .boosting.gblinear import GBLinear
 
-        X = np.asarray(data.X, np.float32)
+        X = np.asarray(data.values(), np.float32)
         n, F = X.shape
         base = (self.base_margin_ if self.base_margin_ is not None
                 else np.zeros(self.n_groups, np.float32))

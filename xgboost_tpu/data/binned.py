@@ -61,6 +61,37 @@ def _search_bin_native(X: np.ndarray, cuts: HistogramCuts):
     return out, has_missing, max_nbins
 
 
+def search_bin_into(X: np.ndarray, cuts: HistogramCuts, missing_bin: int,
+                    out: np.ndarray) -> None:
+    """Bin one batch into a preallocated (possibly memmap) slice, using the
+    native sweep when available. ``out`` must be C-contiguous [n, F] of
+    uint8/uint16/int32; NaN -> ``missing_bin``."""
+    import ctypes
+
+    from .. import native
+
+    X = np.ascontiguousarray(X, np.float32)
+    n, nf = X.shape
+    lib = native.load()
+    dcode = {np.dtype(np.uint8): 0, np.dtype(np.uint16): 1,
+             np.dtype(np.int32): 2}.get(out.dtype)
+    if lib is not None and n and nf and dcode is not None \
+            and out.flags.c_contiguous:
+        fptr = ctypes.POINTER(ctypes.c_float)
+        values = np.ascontiguousarray(cuts.values, np.float32)
+        ptrs = np.ascontiguousarray(cuts.ptrs, np.int32)
+        fn = lib.xtpu_search_bin
+        fn.restype = None
+        fn(X.ctypes.data_as(fptr), ctypes.c_int64(n), ctypes.c_int64(nf),
+           values.ctypes.data_as(fptr),
+           ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+           ctypes.c_int32(missing_bin), ctypes.c_int32(dcode),
+           out.ctypes.data_as(ctypes.c_void_p))
+        return
+    b = cuts.search_bin(X)
+    out[:] = np.where(b < 0, missing_bin, b)
+
+
 @dataclass
 class BinnedMatrix:
     """Quantized feature matrix resident in HBM.
@@ -104,6 +135,21 @@ class BinnedMatrix:
         if self.n_real_override is not None:
             return jnp.asarray(self.n_real_override)
         return jnp.asarray(self.cuts.n_real_bins())
+
+    def to_values(self) -> jnp.ndarray:
+        """Reconstruct representative feature values from bin ids (the
+        reference predicts on quantized pages the same way —
+        ``GHistIndexMatrix::GetFvalue`` returns the bin's cut value): device
+        f32 [n, F], missing slots -> NaN."""
+        cuts = self.cuts
+        ptrs = jnp.asarray(np.asarray(cuts.ptrs[:-1], np.int32))[None, :]
+        vals = jnp.asarray(np.asarray(cuts.values, np.float32))
+        local = self.bins.astype(jnp.int32)
+        n_real = jnp.asarray(self.n_real_bins())[None, :]
+        miss = local >= n_real  # missing slot (or out-of-range sentinel)
+        gb = jnp.clip(ptrs + jnp.minimum(local, n_real - 1), 0,
+                      len(cuts.values) - 1)
+        return jnp.where(miss, jnp.nan, vals[gb])
 
     @staticmethod
     def from_dense(X: np.ndarray, cuts: HistogramCuts, device=None) -> "BinnedMatrix":

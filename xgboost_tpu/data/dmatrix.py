@@ -62,7 +62,16 @@ class DMatrix:
                  feature_types: Optional[List[str]] = None,
                  group: Any = None, qid: Any = None,
                  label_lower_bound: Any = None, label_upper_bound: Any = None,
-                 enable_categorical: bool = False) -> None:
+                 enable_categorical: bool = False,
+                 max_bin: int = 256) -> None:
+        if isinstance(data, DataIter):
+            # external-memory path (reference DMatrix-from-DataIter ->
+            # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): stream
+            # two passes, keep only the quantized pages (memmap-backed
+            # when the iterator carries cache_prefix)
+            self._init_from_iter(data, max_bin, None, missing,
+                                 cache_prefix=data.cache_prefix)
+            return
         if isinstance(data, (str, os.PathLike)):
             # URI load (reference DMatrix::Load, src/data/data.cc:853):
             # libsvm/csv text through the native parser + aux sidecar files
@@ -120,18 +129,24 @@ class DMatrix:
 
     # --- shape --------------------------------------------------------------
     def num_row(self) -> int:
-        return self.X.shape[0]
+        return self.X.shape[0] if self.X is not None else self._n_rows
 
     def num_col(self) -> int:
-        return self.X.shape[1]
+        return self.X.shape[1] if self.X is not None else self._n_cols
 
     def num_nonmissing(self) -> int:
         """Count of present (non-NaN) entries (reference core.py:1222)."""
-        return int(np.count_nonzero(~np.isnan(self.X)))
+        if self.X is not None:
+            return int(np.count_nonzero(~np.isnan(self.X)))
+        b = self._binned
+        if not b.has_missing:
+            return b.n_rows * b.n_features
+        return int(np.count_nonzero(
+            np.asarray(b.bins) != b.missing_bin))
 
     @property
     def shape(self):
-        return self.X.shape
+        return (self.num_row(), self.num_col())
 
     # --- feature info (reference core.py:1266-1361) --------------------------
     @property
@@ -242,6 +257,10 @@ class DMatrix:
         (reference ``get_data``, core.py:1155)."""
         import scipy.sparse
 
+        if self.X is None:
+            raise ValueError(
+                "raw data is not retained by an iterator-built matrix "
+                "(reference IterativeDMatrix has no SparsePage either)")
         present = ~np.isnan(self.X)
         indptr = np.concatenate(
             [[0], np.cumsum(present.sum(axis=1))]).astype(np.int64)
@@ -253,6 +272,10 @@ class DMatrix:
         """Persist this DMatrix for later ``DMatrix(fname)`` loading
         (reference ``XGDMatrixSaveBinary``, core.py:1040; the format here is
         an npz container rather than the reference's internal page format)."""
+        if self.X is None:
+            raise ValueError(
+                "save_binary needs raw data; iterator-built matrices only "
+                "hold the quantized representation")
         payload = {"X": self.X}
         for attr in ("labels", "weights", "base_margin", "group_ptr",
                      "label_lower_bound", "label_upper_bound"):
@@ -288,6 +311,11 @@ class DMatrix:
                  or (ref_cuts is not None and self._binned.cuts is not ref_cuts)
                  or (ref_cuts is None and self._binned_max_bin != max_bin))
         if stale:
+            if self.X is None:
+                raise ValueError(
+                    "an iterator-built matrix is quantized once at "
+                    "construction; rebuild it with the desired max_bin or "
+                    "pass ref= to share cuts")
             cuts = ref_cuts if ref_cuts is not None else sketch_matrix(
                 self.X, max_bin, self.info.weights,
                 self.info.feature_types)
@@ -295,7 +323,106 @@ class DMatrix:
             self._binned_max_bin = max_bin
         return self._binned
 
+    def _init_from_iter(self, it: DataIter, max_bin: int,
+                        ref: Optional[DMatrix], missing: float,
+                        cache_prefix: Optional[str] = None) -> None:
+        """Two streaming passes (reference ``IterativeDMatrix``,
+        ``src/data/iterative_dmatrix.cc:24-52``): pass 1 sketches cuts and
+        gathers metadata, pass 2 quantizes each batch into a preallocated
+        bin matrix. The raw float matrix is NEVER materialised whole —
+        with ``cache_prefix`` the bin matrix itself is a disk-backed
+        memmap (the SparsePageDMatrix disk-spill tier,
+        ``src/data/sparse_page_dmatrix.h``)."""
+        from .binned import _dtype_for
+
+        # pass 1: metadata + per-batch summaries (or copy ref cuts)
+        labels, weights, margins, qids = [], [], [], []
+        lbound, ubound = [], []
+        summaries = None
+        n_rows = 0
+        n_feat = 0
+        has_missing = False
+        need_sketch = ref is None
+        for batch in it.collect():
+            X, _, _ = to_dense(batch["data"], missing)
+            n_rows += X.shape[0]
+            n_feat = X.shape[1]
+            has_missing = has_missing or bool(np.isnan(X).any())
+            for key, dest in (("label", labels), ("weight", weights),
+                              ("base_margin", margins),
+                              ("label_lower_bound", lbound),
+                              ("label_upper_bound", ubound)):
+                if batch.get(key) is not None:
+                    dest.append(np.asarray(batch[key], dtype=np.float32))
+            if batch.get("qid") is not None:
+                qids.append(np.asarray(batch["qid"]))
+            if need_sketch:
+                batch_s = [FeatureSummary.from_data(X[:, f])
+                           for f in range(X.shape[1])]
+                if summaries is None:
+                    summaries = batch_s
+                else:
+                    summaries = [a.merge(b).prune(max_bin * 8)
+                                 for a, b in zip(summaries, batch_s)]
+        self.X = None  # external-memory: no whole raw matrix
+        self.info = MetaInfo()
+        if labels:
+            self.info.labels = np.concatenate(labels)
+        if weights:
+            self.info.weights = np.concatenate(weights)
+        if margins:
+            self.info.base_margin = np.concatenate(margins)
+        if lbound:
+            self.info.label_lower_bound = np.concatenate(lbound)
+        if ubound:
+            self.info.label_upper_bound = np.concatenate(ubound)
+        if qids:
+            q = np.concatenate(qids)
+            _, counts = np.unique(q, return_counts=True)
+            self.info.set_group(counts)
+        if ref is not None:
+            cuts = ref.binned(max_bin).cuts
+        else:
+            cuts = cuts_from_summaries(summaries or [], max_bin)
+
+        # pass 2: quantize batch-by-batch into one preallocated matrix
+        max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
+        dtype = _dtype_for(max(max_nbins - 1, 0))
+        if cache_prefix:
+            local = np.memmap(f"{cache_prefix}.bins", mode="w+",
+                              dtype=dtype, shape=(n_rows, n_feat))
+        else:
+            local = np.empty((n_rows, n_feat), dtype)
+        from .binned import search_bin_into
+
+        row = 0
+        for batch in it.collect():
+            X, _, _ = to_dense(batch["data"], missing)
+            search_bin_into(X, cuts, max_nbins - 1,
+                            local[row:row + X.shape[0]])
+            row += X.shape[0]
+        self._binned = BinnedMatrix.from_local_bins(
+            np.asarray(local), cuts, max_nbins=max_nbins,
+            has_missing=has_missing)
+        self._binned_max_bin = max_bin
+        self._n_rows = n_rows
+        self._n_cols = n_feat
+        self.info.validate(self.num_row())
+
+    def values(self) -> np.ndarray:
+        """Raw features when retained; otherwise representative values
+        reconstructed from the quantized bins (reference
+        ``GHistIndexMatrix::GetFvalue`` — how it predicts on quantized-only
+        data). Note the reconstruction materialises an [n, F] f32 matrix."""
+        if self.X is not None:
+            return self.X
+        return np.asarray(self._binned.to_values())
+
     def slice(self, rindex: np.ndarray) -> "DMatrix":
+        if self.X is None:
+            raise ValueError(
+                "slice needs raw data; iterator-built matrices only hold "
+                "the quantized representation")
         rindex = np.asarray(rindex)
         out = DMatrix(self.X[rindex])
         info = self.info
@@ -317,10 +444,13 @@ class DataIter:
 
     Subclasses implement ``next(input_data)`` calling ``input_data(data=..,
     label=.., ...)`` per batch and returning 1, or returning 0 at the end, plus
-    ``reset()``."""
+    ``reset()``. ``cache_prefix`` requests the disk-spill tier: the quantized
+    bin matrix lives in a memmap at ``<cache_prefix>.bins`` (reference
+    ``SparsePageDMatrix`` page cache)."""
 
-    def __init__(self) -> None:
+    def __init__(self, cache_prefix: Optional[str] = None) -> None:
         self._batches: List[dict] = []
+        self.cache_prefix = cache_prefix
 
     def next(self, input_data) -> int:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -358,7 +488,8 @@ class QuantileDMatrix(DMatrix):
                  enable_categorical: bool = False) -> None:
         self.max_bin = max_bin
         if isinstance(data, DataIter):
-            self._init_from_iter(data, max_bin, ref, missing)
+            self._init_from_iter(data, max_bin, ref, missing,
+                                 cache_prefix=data.cache_prefix)
         else:
             super().__init__(data, label, weight=weight, base_margin=base_margin,
                              missing=missing, feature_names=feature_names,
@@ -369,51 +500,3 @@ class QuantileDMatrix(DMatrix):
                 ref_cuts = ref.binned(max_bin).cuts
             self.binned(max_bin, ref_cuts=ref_cuts)
 
-    def _init_from_iter(self, it: DataIter, max_bin: int,
-                        ref: Optional[DMatrix], missing: float) -> None:
-        # pass 1: sketch (or copy ref cuts)
-        raw: List[np.ndarray] = []
-        labels, weights, margins, qids = [], [], [], []
-        for batch in it.collect():
-            X, _, _ = to_dense(batch["data"], missing)
-            raw.append(X)
-            if batch.get("label") is not None:
-                labels.append(np.asarray(batch["label"], dtype=np.float32))
-            if batch.get("weight") is not None:
-                weights.append(np.asarray(batch["weight"], dtype=np.float32))
-            if batch.get("base_margin") is not None:
-                margins.append(np.asarray(batch["base_margin"], dtype=np.float32))
-            if batch.get("qid") is not None:
-                qids.append(np.asarray(batch["qid"]))
-        X = np.concatenate(raw, axis=0) if raw else np.empty((0, 0), np.float32)
-        self.X = X
-        self.info = MetaInfo()
-        if labels:
-            self.info.labels = np.concatenate(labels)
-        if weights:
-            self.info.weights = np.concatenate(weights)
-        if margins:
-            self.info.base_margin = np.concatenate(margins)
-        if qids:
-            q = np.concatenate(qids)
-            _, counts = np.unique(q, return_counts=True)
-            self.info.set_group(counts)
-        self._binned = None
-        self._binned_max_bin = None
-        if ref is not None:
-            cuts = ref.binned(max_bin).cuts
-        else:
-            summaries = None
-            for Xb in raw:
-                batch_s = [FeatureSummary.from_data(Xb[:, f])
-                           for f in range(Xb.shape[1])]
-                if summaries is None:
-                    summaries = batch_s
-                else:
-                    summaries = [a.merge(b).prune(max_bin * 8)
-                                 for a, b in zip(summaries, batch_s)]
-            cuts = cuts_from_summaries(summaries or [], max_bin)
-        # pass 2: fill
-        self._binned = BinnedMatrix.from_dense(X, cuts)
-        self._binned_max_bin = max_bin
-        self.info.validate(self.num_row())
