@@ -1,0 +1,163 @@
+"""PySpark estimators (reference ``python-package/xgboost/spark/``).
+
+The reference trains through barrier-mode ``mapInPandas`` tasks with a rabit
+tracker on the driver (``spark/core.py:909-984``: every barrier task joins
+the tracker, builds a DMatrix from its partition, runs ``train()``, rank 0
+returns the model). This façade keeps that exact topology with the
+TPU-native plumbing: the driver allocates a ``jax.distributed`` coordinator
+port, each barrier task joins it as one controller process, and SPMD
+training runs over the joint mesh — the same per-worker body as
+``xgboost_tpu.dask._dispatched_train``.
+
+pyspark is an optional dependency (not present in the TPU image); imports
+are deferred to call time, mirroring the reference's soft-import pattern
+(``compat.py``). The estimator surface follows the reference:
+``SparkXGBClassifier/Regressor/Ranker(features_col=, label_col=, ...)``,
+``fit() -> model``, ``model.transform(df)`` appending a ``prediction``
+column, ``model.get_booster()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["SparkXGBClassifier", "SparkXGBRegressor", "SparkXGBRanker"]
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:  # pragma: no cover - pyspark absent in image
+        raise ImportError(
+            "SparkXGB* estimators require pyspark") from e
+
+
+def _train_barrier_partition(iterator, params: Dict[str, Any],
+                             num_boost_round: int, features_col: str,
+                             label_col: str, weight_col: Optional[str],
+                             coordinator: str):
+    """Barrier-task body (reference ``_train_booster``,
+    spark/core.py:909-984). Runs inside a ``RDD.barrier()`` stage: all
+    partitions execute concurrently and rendezvous on the coordinator."""
+    from pyspark import BarrierTaskContext  # pragma: no cover - needs spark
+
+    ctx = BarrierTaskContext.get()
+    rank = ctx.partitionId()
+    world = ctx.getTaskInfos().__len__()
+
+    import pandas as pd
+
+    frames = list(iterator)
+    pdf = pd.concat(frames) if frames else pd.DataFrame()
+    X = (np.stack(pdf[features_col].values)
+         if len(pdf) else np.empty((0, 0), np.float32))
+    y = pdf[label_col].to_numpy(np.float32) if len(pdf) else None
+    w = (pdf[weight_col].to_numpy(np.float32)
+         if weight_col and len(pdf) else None)
+
+    from .parallel import collective, launch
+
+    if world > 1:
+        launch.init_distributed(coordinator_address=coordinator,
+                                num_processes=world, process_id=rank)
+    with collective.CommunicatorContext():
+        bst = launch.train_per_host(params, np.asarray(X, np.float32), y,
+                                    num_boost_round, weight_local=w)
+    ctx.barrier()
+    if rank == 0:
+        yield pd.DataFrame({"model": [bytes(bst.save_raw("json"))]})
+
+
+class _SparkXGBModel:
+    """Fitted model wrapper (reference ``_SparkXGBModel``): holds the
+    Booster, appends a ``prediction`` column on transform."""
+
+    def __init__(self, booster, features_col: str,
+                 prediction_col: str = "prediction") -> None:
+        self._booster = booster
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+
+    def get_booster(self):
+        return self._booster
+
+    def transform(self, dataset):
+        _require_pyspark()
+        from pyspark.sql.functions import pandas_udf
+
+        raw = bytes(self._booster.save_raw("json"))
+        features_col = self.features_col
+
+        @pandas_udf("double")
+        def _predict(features):
+            from .core import Booster
+            from .data.dmatrix import DMatrix
+
+            bst = Booster()
+            bst.load_model(raw)
+            X = np.stack(features.values)
+            import pandas as pd
+
+            return pd.Series(np.asarray(
+                bst.predict(DMatrix(X))).astype(np.float64))
+
+        return dataset.withColumn(self.prediction_col,
+                                  _predict(dataset[features_col]))
+
+
+class _SparkXGBEstimator:
+    _objective = "reg:squarederror"
+
+    def __init__(self, *, features_col: str = "features",
+                 label_col: str = "label",
+                 weight_col: Optional[str] = None,
+                 prediction_col: str = "prediction",
+                 num_workers: int = 1, n_estimators: int = 100,
+                 **params: Any) -> None:
+        self.features_col = features_col
+        self.label_col = label_col
+        self.weight_col = weight_col
+        self.prediction_col = prediction_col
+        self.num_workers = num_workers
+        self.n_estimators = n_estimators
+        self.params = params
+
+    def fit(self, dataset) -> _SparkXGBModel:
+        _require_pyspark()
+        import socket
+
+        from .core import Booster
+
+        with socket.socket() as s:  # coordinator on the driver's host
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        host = socket.gethostname()
+        coordinator = f"{host}:{port}"
+        params = {"objective": self._objective, **self.params}
+        df = dataset.repartition(self.num_workers)
+        rows = (
+            df.rdd.barrier()
+            .mapPartitions(lambda it: _train_barrier_partition(
+                it, params, self.n_estimators, self.features_col,
+                self.label_col, self.weight_col, coordinator))
+            .collect())
+        raw = rows[0]["model"] if rows else None
+        if raw is None:
+            raise RuntimeError("no partition returned a model")
+        bst = Booster()
+        bst.load_model(bytes(raw))
+        return _SparkXGBModel(bst, self.features_col, self.prediction_col)
+
+
+class SparkXGBRegressor(_SparkXGBEstimator):
+    _objective = "reg:squarederror"
+
+
+class SparkXGBClassifier(_SparkXGBEstimator):
+    _objective = "binary:logistic"
+
+
+class SparkXGBRanker(_SparkXGBEstimator):
+    _objective = "rank:ndcg"
