@@ -46,15 +46,36 @@ def test_lossguide_can_exceed_heap_depth():
 
 def test_lossguide_uncapped_equals_depthwise():
     # split decisions are order-independent: lossguide with no leaf cap and
-    # bounded depth must produce the same model as depthwise
+    # bounded depth must produce the same model as depthwise when both do
+    # full per-level builds (+nosub pins the depthwise numerics: the
+    # default sibling-subtraction histograms differ in the last ulp, which
+    # can legitimately flip near-tie splits)
     X, y = _data(seed=1)
     dm = xgb.DMatrix(X, label=y)
     p_lg = xgb.train({"objective": "binary:logistic", "max_depth": 4,
                       "grow_policy": "lossguide", "max_leaves": 0},
                      dm, 3, verbose_eval=False).predict(dm)
-    p_dw = xgb.train({"objective": "binary:logistic", "max_depth": 4},
+    p_dw = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                      "hist_method": "auto+nosub"},
                      dm, 3, verbose_eval=False).predict(dm)
     assert np.abs(p_lg - p_dw).max() < 2e-5
+
+
+def test_subtraction_matches_full_build_quality():
+    """The smaller-child + sibling-subtraction fast path (reference
+    histogram.h:192-207) must agree with full per-level builds up to
+    near-tie split flips: almost all predictions identical, quality
+    equal."""
+    X, y = _data(seed=1)
+    dm = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "max_depth": 4,
+              "eval_metric": "logloss"}
+    r_sub, r_full = {}, {}
+    xgb.train({**params, "hist_method": "auto+sub"}, dm, 5,
+              evals=[(dm, "t")], evals_result=r_sub, verbose_eval=False)
+    xgb.train(params, dm, 5, evals=[(dm, "t")], evals_result=r_full,
+              verbose_eval=False)
+    assert abs(r_sub["t"]["logloss"][-1] - r_full["t"]["logloss"][-1]) < 1e-3
 
 
 def test_depthwise_max_leaves_cap():
