@@ -1,0 +1,175 @@
+"""Test utilities for users of the framework (reference
+``python-package/xgboost/testing/``: synthetic data makers
+``make_categorical``/``make_ltr``/``make_sparse_regression``, the
+``IteratorForTest`` batching wrapper, and dependency skip markers).
+
+These are public: downstream projects build their own test suites on top of
+them, the same way the reference exposes ``xgboost.testing``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .data.dmatrix import DataIter
+
+
+def no_pandas():
+    """Pytest skip-mark kwargs when pandas is unavailable."""
+    try:
+        import pandas  # noqa: F401
+
+        return {"condition": False, "reason": "pandas is available"}
+    except ImportError:
+        return {"condition": True, "reason": "pandas is not available"}
+
+
+def no_sklearn():
+    try:
+        import sklearn  # noqa: F401
+
+        return {"condition": False, "reason": "sklearn is available"}
+    except ImportError:
+        return {"condition": True, "reason": "sklearn is not available"}
+
+
+def no_matplotlib():
+    try:
+        import matplotlib  # noqa: F401
+
+        return {"condition": False, "reason": "matplotlib is available"}
+    except ImportError:
+        return {"condition": True, "reason": "matplotlib is not available"}
+
+
+class IteratorForTest(DataIter):
+    """Batched wrapper over pre-split arrays (reference ``IteratorForTest``,
+    testing/__init__.py:194): drives the DataIter callback protocol from
+    in-memory shards."""
+
+    def __init__(self, X: List[np.ndarray], y: List[np.ndarray],
+                 w: Optional[List[np.ndarray]] = None,
+                 cache_prefix: Optional[str] = None) -> None:
+        super().__init__(cache_prefix=cache_prefix)
+        assert len(X) == len(y)
+        self.X, self.y, self.w = X, y, w
+        self.it = 0
+
+    def next(self, input_data) -> int:
+        if self.it == len(self.X):
+            return 0
+        kwargs = {"data": self.X[self.it], "label": self.y[self.it]}
+        if self.w is not None:
+            kwargs["weight"] = self.w[self.it]
+        input_data(**kwargs)
+        self.it += 1
+        return 1
+
+    def reset(self) -> None:
+        self.it = 0
+
+    def as_arrays(self) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        X = np.concatenate(self.X)
+        y = np.concatenate(self.y)
+        w = np.concatenate(self.w) if self.w is not None else None
+        return X, y, w
+
+
+def make_regression(n_samples: int = 1024, n_features: int = 8,
+                    *, seed: int = 0, sparsity: float = 0.0
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense regression data with optional NaN sparsity."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n_samples, n_features).astype(np.float32)
+    coef = rng.randn(n_features).astype(np.float32)
+    y = (X @ coef + 0.1 * rng.randn(n_samples)).astype(np.float32)
+    if sparsity > 0:
+        X[rng.rand(n_samples, n_features) < sparsity] = np.nan
+    return X, y
+
+
+def make_batches(n_samples_per_batch: int, n_features: int, n_batches: int,
+                 *, seed: int = 0, use_cupy: bool = False
+                 ) -> Tuple[List[np.ndarray], List[np.ndarray],
+                            List[np.ndarray]]:
+    """Shard lists for IteratorForTest (reference ``make_batches``)."""
+    if use_cupy:
+        raise NotImplementedError("no CUDA arrays on TPU")
+    X, y, w = [], [], []
+    rng = np.random.RandomState(seed)
+    for _ in range(n_batches):
+        _X = rng.randn(n_samples_per_batch, n_features).astype(np.float32)
+        X.append(_X)
+        y.append((_X @ rng.randn(n_features)).astype(np.float32))
+        w.append(rng.uniform(0.5, 2.0, n_samples_per_batch).astype(np.float32))
+    return X, y, w
+
+
+def make_categorical(n_samples: int, n_features: int, n_categories: int,
+                     *, onehot: bool = False, sparsity: float = 0.0,
+                     seed: int = 0, shuffle: bool = False):
+    """Categorical classification data (reference ``make_categorical``,
+    testing/__init__.py:376) -> (pandas DataFrame with category dtype, y);
+    with ``onehot`` the frame is one-hot encoded instead."""
+    import pandas as pd
+
+    rng = np.random.RandomState(seed)
+    codes = rng.randint(0, n_categories, size=(n_samples, n_features))
+    y = np.zeros(n_samples, np.float32)
+    for f in range(n_features):
+        y += (codes[:, f] % 3 == 0).astype(np.float32)
+    y = (y > n_features / 6).astype(np.float32)
+    df = pd.DataFrame({
+        f"c{f}": pd.Categorical(codes[:, f],
+                                categories=list(range(n_categories)))
+        for f in range(n_features)})
+    if sparsity > 0:
+        for f in range(n_features):
+            mask = rng.rand(n_samples) < sparsity
+            col = df[f"c{f}"].copy()
+            col[mask] = np.nan
+            df[f"c{f}"] = col
+    if shuffle:
+        perm = rng.permutation(n_samples)
+        df = df.iloc[perm].reset_index(drop=True)
+        y = y[perm]
+    if onehot:
+        df = pd.get_dummies(df).astype(np.float32)
+    return df, y
+
+
+def make_ltr(n_samples: int = 2048, n_features: int = 16,
+             n_query_groups: int = 8, max_rel: int = 4, *, seed: int = 0
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Learning-to-rank data (reference ``make_ltr``, testing/__init__.py:447)
+    -> (X, relevance labels, sorted qid)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n_samples, n_features).astype(np.float32)
+    qid = np.sort(rng.randint(0, n_query_groups, n_samples))
+    w = rng.randn(n_features).astype(np.float32)
+    score = X @ w + 0.5 * rng.randn(n_samples)
+    # per-query relevance from within-query score quantiles
+    y = np.zeros(n_samples, np.float32)
+    for q in np.unique(qid):
+        m = qid == q
+        ranks = np.argsort(np.argsort(score[m]))
+        y[m] = np.floor(ranks / max(m.sum(), 1) * (max_rel + 1))
+    return X, np.clip(y, 0, max_rel).astype(np.float32), qid.astype(np.int64)
+
+
+def make_sparse_regression(n_samples: int, n_features: int,
+                           sparsity: float, *, seed: int = 0):
+    """Scipy CSR regression data (reference ``make_sparse_regression``,
+    testing/__init__.py:502)."""
+    import scipy.sparse
+
+    rng = np.random.RandomState(seed)
+    density = max(1.0 - sparsity, 1e-3)
+    X = scipy.sparse.random(n_samples, n_features, density=density,
+                            format="csr", dtype=np.float32,
+                            random_state=rng)
+    coef = rng.randn(n_features).astype(np.float32)
+    y = np.asarray(X @ coef).reshape(-1).astype(np.float32)
+    return X, y
