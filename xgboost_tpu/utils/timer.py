@@ -73,3 +73,33 @@ class Monitor:
 
         if get_config().get("verbosity", 1) >= 3 and self.timers:
             print(self.report())
+
+
+def annotate(label: str):
+    """Named range on the device timeline (the reference's NVTX ranges,
+    ``src/common/timer.h:52`` under ``USE_NVTX``): shows up in
+    ``jax.profiler`` traces. Usable as a context manager."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(label)
+
+
+class profile:
+    """Capture a device profile around a block (reference: nvprof/NVTX
+    workflow): ``with profile("/tmp/trace"): bst = train(...)`` writes a
+    TensorBoard-loadable trace of every XLA kernel."""
+
+    def __init__(self, log_dir: str) -> None:
+        self.log_dir = log_dir
+
+    def __enter__(self):
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.profiler.stop_trace()
+        return False
