@@ -22,6 +22,24 @@ from .tree.param import TrainParam
 
 __version__ = "0.1.0"
 
+
+def build_info() -> dict:
+    """Runtime build description (reference ``xgboost.build_info``): the
+    JAX/device stack plays the role of the reference's compiler flags."""
+    import jax
+
+    from . import native
+
+    return {
+        "version": __version__,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "native_runtime": native.load() is not None,
+        "USE_CUDA": False,
+        "USE_NCCL": False,
+        "USE_FEDERATED": True,
+    }
+
 __all__ = [
     "Booster", "train", "cv", "DMatrix", "QuantileDMatrix", "DataIter",
     "TrainParam", "Context", "make_data_mesh", "callback", "collective",

@@ -221,6 +221,35 @@ def is_distributed() -> bool:
     return get_communicator().is_distributed()
 
 
+def allreduce(data: np.ndarray, op: str = "sum") -> np.ndarray:
+    """Module-level allreduce on the active communicator (reference
+    ``collective.allreduce``, python collective.py:209; op names mirror the
+    Op enum: sum/max/min/bitwise_or)."""
+    return get_communicator().allreduce(np.asarray(data), op=op)
+
+
+def broadcast(data: Any, root: int = 0) -> Any:
+    """Broadcast any picklable object from ``root`` (reference
+    ``collective.broadcast``, python collective.py:137)."""
+    return get_communicator().broadcast(data, root=root)
+
+
+def allgather(data: Any) -> List[Any]:
+    """Gather one object per rank, rank-ordered."""
+    return get_communicator().allgather_objects(data)
+
+
+def communicator_print(msg: Any) -> None:
+    """Rank-prefixed print (reference ``collective.communicator_print``)."""
+    print(f"[{get_rank()}] {msg}", flush=True)
+
+
+def get_processor_name() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
 class CommunicatorContext:
     """``with CommunicatorContext(...)`` — reference
     ``python-package/xgboost/collective.py`` context manager."""
