@@ -245,3 +245,27 @@ def test_reference_ubjson_typed_arrays():
     X = np.asarray([[1.0, 0.0], [3.0, 0.0]], np.float32)
     preds = bst.predict(xgb.DMatrix(X), output_margin=True)
     np.testing.assert_allclose(preds, [1.0, -1.0])
+
+
+def test_export_validates_against_reference_schema(trained):
+    """The exporter's output must satisfy the reference's published JSON
+    schema (doc/model.schema) wherever available."""
+    import os
+
+    schema_path = "/root/reference/doc/model.schema"
+    if not os.path.exists(schema_path):
+        pytest.skip("reference schema not mounted")
+    jsonschema = pytest.importorskip("jsonschema")
+    bst, _ = trained
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    jsonschema.validate(native_to_reference_json(bst), schema)
+
+
+def test_export_ubjson_round_trip(trained, tmp_path):
+    bst, dm = trained
+    fname = str(tmp_path / "export.ubj")
+    save_xgboost_model(bst, fname)
+    back = xgb.Booster(model_file=fname)
+    np.testing.assert_allclose(back.predict(dm), bst.predict(dm),
+                               rtol=1e-6, atol=1e-7)

@@ -180,6 +180,52 @@ def reference_to_native_json(ref: Dict[str, Any]) -> Dict[str, Any]:
 
 # --------------------------------------------------------------------- export
 
+_REG_LOSS_OBJS = {"reg:squarederror", "reg:squaredlogerror", "reg:linear",
+                  "reg:logistic", "binary:logistic", "binary:logitraw",
+                  "reg:pseudohubererror"}
+
+
+def _objective_to_reference(obj, learner_params: Dict[str, Any],
+                            num_class: int) -> Dict[str, Any]:
+    """Emit the schema-exact objective JSON (name + its nested string-valued
+    param wrapper, doc/model.schema objective oneOf)."""
+    name = obj.name
+    own = obj.to_json() if hasattr(obj, "to_json") else {}
+
+    def s(key: str, default: Any) -> str:
+        v = own.get(key, learner_params.get(key, default))
+        return str(v)
+
+    if name in _REG_LOSS_OBJS:
+        return {"name": name, "reg_loss_param": {
+            "scale_pos_weight": s("scale_pos_weight", 1)}}
+    if name == "count:poisson":
+        return {"name": name, "poisson_regression_param": {
+            "max_delta_step": s("max_delta_step", 0.7)}}
+    if name == "reg:tweedie":
+        return {"name": name, "tweedie_regression_param": {
+            "tweedie_variance_power": s("tweedie_variance_power", 1.5)}}
+    if name == "reg:quantileerror":
+        return {"name": name, "quantile_loss_param": {
+            "quantile_alpha": s("quantile_alpha", 0.5)}}
+    if name in ("multi:softprob", "multi:softmax"):
+        return {"name": name, "softmax_multiclass_param": {
+            "num_class": str(num_class)}}
+    if name in ("rank:ndcg", "rank:pairwise", "rank:map"):
+        lr = {"lambdarank_num_pair_per_sample":
+              s("lambdarank_num_pair_per_sample", 1),
+              "lambdarank_pair_method": s("lambdarank_pair_method", "mean")}
+        # the published schema names the property "lambda_rank_param" but
+        # requires "lambdarank_param"; emit both spellings
+        return {"name": name, "lambda_rank_param": lr,
+                "lambdarank_param": lr}
+    if name == "survival:aft":
+        return {"name": name, "aft_loss_param": {
+            "aft_loss_distribution": s("aft_loss_distribution", "normal"),
+            "aft_loss_distribution_scale":
+                s("aft_loss_distribution_scale", 1.0)}}
+    return {"name": name}
+
 def _tree_to_reference(t, num_feature: int) -> Dict[str, Any]:
     n = t.num_nodes()
     is_leaf = t.is_leaf
@@ -287,7 +333,11 @@ def native_to_reference_json(booster) -> Dict[str, Any]:
                 "num_feature": str(nf),
                 "num_target": str(n_groups),
             },
-            "objective": obj.to_json() if obj else {"name": "reg:squarederror"},
+            "objective": (_objective_to_reference(
+                obj, booster.learner_params,
+                int(booster.learner_params.get("num_class", 0)))
+                if obj else {"name": "reg:squarederror",
+                             "reg_loss_param": {"scale_pos_weight": "1"}}),
             "gradient_booster": gb_json,
         },
     }
@@ -303,6 +353,14 @@ def load_xgboost_model(source) -> "Booster":  # noqa: F821
 
 
 def save_xgboost_model(booster, fname: str) -> None:
-    """Write a Booster as a reference-schema JSON model file."""
-    with open(fname, "w") as fh:
-        json.dump(native_to_reference_json(booster), fh)
+    """Write a Booster as a reference-schema model file; ``.ubj`` selects
+    UBJSON (the reference's default binary format), anything else JSON."""
+    obj = native_to_reference_json(booster)
+    if str(fname).endswith(".ubj"):
+        from .utils.ubjson import dump_ubjson
+
+        with open(fname, "wb") as fh:
+            dump_ubjson(obj, fh)
+    else:
+        with open(fname, "w") as fh:
+            json.dump(obj, fh)
