@@ -1,0 +1,105 @@
+"""Property-based tests (the reference drives cross-implementation
+consistency through hypothesis strategies — testing/params.py,
+test_gpu_updaters.py): histogram-method equivalence, sketch merge
+associativity, cut invariants, and model invariances."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import xgboost_tpu as xgb
+from xgboost_tpu.data.quantile import FeatureSummary, cuts_from_summaries
+from xgboost_tpu.ops.histogram import build_hist
+
+SETTINGS = dict(deadline=None, max_examples=20)
+
+
+@settings(**SETTINGS)
+@given(n=st.integers(10, 400), f=st.integers(1, 6),
+       n_nodes=st.integers(1, 8), max_nbins=st.integers(2, 32),
+       seed=st.integers(0, 1000))
+def test_hist_methods_agree(n, f, n_nodes, max_nbins, seed):
+    """segment (scatter-add) and onehot (matmul) formulations of the
+    histogram are the same mathematical object."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    bins = jnp.asarray(rng.integers(0, max_nbins, (n, f), dtype=np.int32))
+    gpair = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    pos = jnp.asarray(rng.integers(0, n_nodes + 1, n, dtype=np.int32))
+    h_seg = build_hist(bins, gpair, pos, n_nodes, max_nbins,
+                       method="segment")
+    h_oh = build_hist(bins, gpair, pos, n_nodes, max_nbins, method="onehot")
+    np.testing.assert_allclose(np.asarray(h_seg), np.asarray(h_oh),
+                               rtol=1e-5, atol=1e-5)
+
+
+@settings(**SETTINGS)
+@given(n=st.integers(2, 500), split=st.floats(0.1, 0.9),
+       seed=st.integers(0, 1000))
+def test_sketch_merge_associativity(n, split, seed):
+    """Exact (unpruned) summaries merge losslessly: sketch(A + B) ==
+    merge(sketch(A), sketch(B)) — the invariant the distributed sketch
+    sync depends on (reference src/common/quantile.cc:147-390)."""
+    rng = np.random.default_rng(seed)
+    col = rng.normal(size=n).astype(np.float32)
+    col[rng.random(n) < 0.1] = np.nan
+    k = max(1, min(n - 1, int(n * split)))
+    whole = FeatureSummary.from_data(col)
+    merged = FeatureSummary.from_data(col[:k]).merge(
+        FeatureSummary.from_data(col[k:]))
+    np.testing.assert_array_equal(whole.values, merged.values)
+    np.testing.assert_allclose(whole.weights, merged.weights)
+
+
+@settings(**SETTINGS)
+@given(n=st.integers(1, 2000), max_bin=st.integers(2, 64),
+       seed=st.integers(0, 1000))
+def test_cut_invariants(n, max_bin, seed):
+    """Cuts are strictly increasing per feature; every observed value lands
+    in a real bin; the last cut is strictly above the max value."""
+    rng = np.random.default_rng(seed)
+    col = np.round(rng.normal(size=n), 2).astype(np.float32)  # force ties
+    s = FeatureSummary.from_data(col)
+    cuts = cuts_from_summaries([s], max_bin)
+    v = cuts.values[cuts.ptrs[0]:cuts.ptrs[1]]
+    assert (np.diff(v) > 0).all()
+    assert v[-1] > col.max()
+    b = cuts.search_bin(col[:, None])
+    assert (b >= 0).all() and (b < cuts.n_bins(0)).all()
+
+
+@settings(deadline=None, max_examples=5)
+@given(seed=st.integers(0, 100))
+def test_all_nan_column_is_inert(seed):
+    """Appending an all-NaN feature must not change the model (no splits
+    can use it; argmax tie-breaking never reaches the appended index)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(800, 4).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    Xa = np.concatenate([X, np.full((800, 1), np.nan, np.float32)], axis=1)
+    params = {"objective": "binary:logistic", "max_depth": 3}
+    p1 = xgb.train(params, xgb.DMatrix(X, label=y), 3,
+                   verbose_eval=False).predict(xgb.DMatrix(X))
+    p2 = xgb.train(params, xgb.DMatrix(Xa, label=y), 3,
+                   verbose_eval=False).predict(xgb.DMatrix(Xa))
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
+
+
+@settings(deadline=None, max_examples=5)
+@given(seed=st.integers(0, 100), c=st.floats(0.5, 2.0))
+def test_weight_scale_invariance(seed, c):
+    """Multiplying every row weight by a constant leaves the model
+    unchanged (quantile ranks, split gains and leaf values are all ratios
+    of weighted sums)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(600, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, 600).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 3,
+              "reg_lambda": 0.0, "min_child_weight": 0.0}
+    p1 = xgb.train(params, xgb.DMatrix(X, label=y, weight=w), 3,
+                   verbose_eval=False).predict(xgb.DMatrix(X))
+    p2 = xgb.train(params, xgb.DMatrix(X, label=y, weight=w * np.float32(c)),
+                   3, verbose_eval=False).predict(xgb.DMatrix(X))
+    np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-5)
