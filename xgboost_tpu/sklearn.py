@@ -114,7 +114,10 @@ class XGBModel(_SkBase):
     def get_xgb_params(self) -> Dict[str, Any]:
         params = {}
         for k, v in self.__dict__.items():
-            if k.startswith("_") or k in self._NON_BOOSTER or v is None:
+            # trailing-underscore attributes are sklearn fitted state
+            # (classes_, n_classes_, evals_result_), not booster params
+            if k.startswith("_") or k.endswith("_") \
+                    or k in self._NON_BOOSTER or v is None:
                 continue
             if k == "objective" and callable(v):
                 continue
@@ -256,6 +259,35 @@ class XGBModel(_SkBase):
     @property
     def n_features_in_(self) -> int:
         return self.get_booster().num_features()
+
+    @property
+    def feature_names_in_(self) -> np.ndarray:
+        names = self.get_booster().feature_names
+        if names is None:
+            raise AttributeError(
+                "`feature_names_in_` is defined only when fitted on a frame "
+                "with column names")
+        return np.asarray(names, dtype=object)
+
+    def __sklearn_is_fitted__(self) -> bool:
+        return getattr(self, "_Booster", None) is not None
+
+    @property
+    def coef_(self) -> np.ndarray:
+        """Linear-booster coefficients (reference sklearn.py ``coef_``:
+        defined for ``booster='gblinear'`` only)."""
+        if self.booster != "gblinear":
+            raise AttributeError(
+                f"coef_ is not defined for booster={self.booster!r}")
+        W = np.asarray(self.get_booster().gbm.W, np.float32)
+        return W[:, 0] if W.shape[1] == 1 else W.T
+
+    @property
+    def intercept_(self) -> np.ndarray:
+        if self.booster != "gblinear":
+            raise AttributeError(
+                f"intercept_ is not defined for booster={self.booster!r}")
+        return np.asarray(self.get_booster().gbm.bias, np.float32)
 
     def save_model(self, fname: str) -> None:
         self.get_booster().save_model(fname)
