@@ -121,3 +121,21 @@ def test_get_fscore_and_split_value_histogram(trained):
     # pandas variant
     df = bst.get_split_value_histogram("f0")
     assert list(df.columns) == ["SplitValue", "Count"]
+
+
+def test_predict_validates_features(trained):
+    bst, dtr, X, y = trained
+    with pytest.raises(ValueError, match="feature count mismatch"):
+        bst.predict(xgb.DMatrix(X[:, :5]))
+    # names mismatch
+    bst2 = xgb.train({"objective": "binary:logistic", "max_depth": 2},
+                     xgb.DMatrix(X, label=y,
+                                 feature_names=[f"a{i}" for i in range(8)]),
+                     2)
+    with pytest.raises(ValueError, match="feature_names mismatch"):
+        bst2.predict(xgb.DMatrix(X,
+                                 feature_names=[f"b{i}" for i in range(8)]))
+    # opt-out works
+    p = bst2.predict(xgb.DMatrix(X, feature_names=[f"b{i}" for i in range(8)]),
+                     validate_features=False)
+    assert p.shape == (X.shape[0],)

@@ -196,6 +196,8 @@ class Booster:
                            if k not in ("objective", "booster")})
         info = dtrain.info if dtrain is not None else None
         n_groups = max(1, self.obj.n_targets(info))
+        if dtrain is not None and not getattr(self, "_num_features", 0):
+            self._num_features = dtrain.num_col()
         if self.gbm is None:
             self.gbm = self._make_booster(
                 n_groups, dtrain.num_col() if dtrain is not None else 0)
@@ -675,14 +677,34 @@ class Booster:
         state["n_trees"] = total
         return state["margin"]
 
+    def _validate_features(self, data: DMatrix) -> None:
+        """Shape/name agreement between model and data (reference
+        ``Booster._validate_features``, core.py)."""
+        nf = self.num_features()
+        if nf and data.num_col() != nf:
+            raise ValueError(
+                f"feature count mismatch: model has {nf}, data has "
+                f"{data.num_col()}")
+        names = data.info.feature_names
+        if self.feature_names and names and self.feature_names != names:
+            missing = set(self.feature_names) - set(names)
+            extra = set(names) - set(self.feature_names)
+            raise ValueError(
+                "feature_names mismatch between model and data"
+                + (f"; missing from data: {sorted(missing)}" if missing
+                   else "")
+                + (f"; unexpected in data: {sorted(extra)}" if extra else ""))
+
     def predict(self, data: DMatrix, output_margin: bool = False,
                 pred_leaf: bool = False, pred_contribs: bool = False,
                 approx_contribs: bool = False,
                 pred_interactions: bool = False,
                 iteration_range: Optional[Tuple[int, int]] = None,
-                strict_shape: bool = False, training: bool = False
-                ) -> np.ndarray:
+                strict_shape: bool = False, training: bool = False,
+                validate_features: bool = True) -> np.ndarray:
         self._configure(data if data.info.labels is not None else None)
+        if validate_features:
+            self._validate_features(data)
         if pred_contribs or pred_interactions:
             from .tree.multi import MultiTargetTreeModel
 
@@ -831,7 +853,9 @@ class Booster:
         return self.gbm.num_boosted_rounds() if self.gbm is not None else 0
 
     def num_features(self) -> int:
-        return len(self.feature_names) if self.feature_names else 0
+        if self.feature_names:
+            return len(self.feature_names)
+        return getattr(self, "_num_features", 0)
 
     # ---------------------------------------------------------------- slicing
     def __getitem__(self, val: slice) -> "Booster":
@@ -911,6 +935,7 @@ class Booster:
                                    if self.base_margin_ is not None else [0.0]),
                     "num_class": int(self.learner_params.get("num_class", 0)),
                     "num_target": self.n_groups,
+                    "num_feature": self.num_features(),
                 },
                 "objective": self.obj.to_json() if self.obj else {},
                 "gradient_booster": self.gbm.to_json() if self.gbm else {},
@@ -939,6 +964,7 @@ class Booster:
         self.feature_names = learner.get("feature_names") or None
         self.feature_types = learner.get("feature_types") or None
         lmp = learner.get("learner_model_param", {})
+        self._num_features = int(lmp.get("num_feature", 0) or 0)
         self.base_margin_ = np.asarray(lmp.get("base_score", [0.0]),
                                        dtype=np.float32).reshape(-1)
         obj_cfg = learner.get("objective", {})

@@ -169,6 +169,7 @@ def reference_to_native_json(ref: Dict[str, Any]) -> Dict[str, Any]:
                 "base_score": base.tolist(),
                 "num_class": num_class,
                 "num_target": n_groups,
+                "num_feature": int(lmp.get("num_feature", 0) or 0),
             },
             "objective": {"name": obj_name, **obj_params},
             "gradient_booster": booster,
