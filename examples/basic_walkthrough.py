@@ -1,0 +1,41 @@
+"""Basic walkthrough (reference demo/guide-python/basic_walkthrough.py):
+train on the agaricus mushrooms data, evaluate, save and reload."""
+import os
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+TRAIN = "/root/reference/demo/data/agaricus.txt.train"
+TEST = "/root/reference/demo/data/agaricus.txt.test"
+
+
+def main(out_dir: str = "/tmp") -> None:
+    if os.path.exists(TRAIN):
+        dtrain, dtest = xgb.DMatrix(TRAIN), xgb.DMatrix(TEST)
+    else:  # synthetic stand-in when the demo data is not mounted
+        rng = np.random.RandomState(0)
+        X = rng.randn(6000, 126).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        dtrain = xgb.DMatrix(X[:5000], label=y[:5000])
+        dtest = xgb.DMatrix(X[5000:], label=y[5000:])
+
+    params = {"max_depth": 2, "eta": 1.0, "objective": "binary:logistic",
+              "eval_metric": "error"}
+    bst = xgb.train(params, dtrain, 2,
+                    evals=[(dtrain, "train"), (dtest, "eval")])
+
+    preds = bst.predict(dtest)
+    labels = dtest.get_label()
+    err = float(np.mean((preds > 0.5) != labels))
+    print(f"error={err:.4f}")
+
+    model_path = os.path.join(out_dir, "agaricus.json")
+    bst.save_model(model_path)
+    bst2 = xgb.Booster(model_file=model_path)
+    assert np.abs(bst2.predict(dtest) - preds).max() == 0
+    print("saved + reloaded:", model_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""LambdaMART ranking (reference demo/rank): rank:ndcg on query groups."""
+import xgboost_tpu as xgb
+from xgboost_tpu.testing import make_ltr
+
+
+def main() -> None:
+    X, y, qid = make_ltr(4000, 16, n_query_groups=20)
+    dtrain = xgb.DMatrix(X, label=y, qid=qid)
+    res = {}
+    xgb.train({"objective": "rank:ndcg", "eval_metric": ["ndcg@5", "ndcg@10"],
+               "max_depth": 4, "eta": 0.3,
+               "lambdarank_pair_method": "topk"}, dtrain, 20,
+              evals=[(dtrain, "train")], evals_result=res, verbose_eval=5)
+    assert res["train"]["ndcg@10"][-1] > res["train"]["ndcg@10"][0]
+    print("ndcg@10 improved:", res["train"]["ndcg@10"][0], "->",
+          res["train"]["ndcg@10"][-1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Every example under examples/ must run end to end (the reference CI
+exercises demo/ the same way)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _run(name: str) -> None:
+    path = os.path.join(EXAMPLES, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name[:-3]] = mod
+    spec.loader.exec_module(mod)
+    mod.main()
+
+
+@pytest.mark.parametrize("name", sorted(
+    f for f in os.listdir(EXAMPLES) if f.endswith(".py")))
+def test_example_runs(name, tmp_path):
+    if name == "basic_walkthrough.py":
+        path = os.path.join(EXAMPLES, name)
+        spec = importlib.util.spec_from_file_location("bw", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main(out_dir=str(tmp_path))
+    else:
+        _run(name)
