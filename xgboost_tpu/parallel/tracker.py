@@ -1,0 +1,59 @@
+"""Tracker — the rendezvous coordinator handle.
+
+Reference counterpart: ``RabitTracker`` (``python-package/xgboost/
+tracker.py:178``), the TCP process that accepts workers, assigns ranks and
+hands out ``DMLC_TRACKER_URI/PORT`` env vars. In the TPU-native stack the
+rendezvous is ``jax.distributed``'s coordinator service, which rank 0's
+process hosts in-process — so the "tracker" reduces to choosing the
+coordinator endpoint and handing every worker the same bootstrap args.
+
+Used by the dask/spark drivers; standalone:
+
+    tracker = Tracker(n_workers=4)          # on the driver
+    args = tracker.worker_args()            # ship to every worker
+    # each worker:
+    launch.init_distributed(args["coordinator_address"],
+                            args["n_workers"], rank)
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, Optional
+
+
+def get_host_ip(host_ip: Optional[str] = None) -> str:
+    """Best-effort routable host address (reference ``tracker.py`` host
+    discovery)."""
+    if host_ip:
+        return host_ip
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+class Tracker:
+    """Coordinator endpoint factory (reference ``RabitTracker``)."""
+
+    def __init__(self, n_workers: int, host_ip: Optional[str] = None,
+                 port: int = 0) -> None:
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.n_workers = n_workers
+        self.host_ip = get_host_ip(host_ip)
+        if port == 0:
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+        self.port = port
+
+    def worker_args(self) -> Dict[str, Any]:
+        """Bootstrap args for every worker (reference ``worker_envs()`` ->
+        DMLC_TRACKER_URI/PORT)."""
+        return {
+            "coordinator_address": f"{self.host_ip}:{self.port}",
+            "n_workers": self.n_workers,
+        }
