@@ -91,6 +91,65 @@ def build_hist_onehot(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarra
     return acc.reshape(F, max_nbins, n_nodes, 2).transpose(2, 0, 1, 3)
 
 
+def build_onehot_plane(bins_t: jnp.ndarray, max_nbins: int) -> jnp.ndarray:
+    """Materialise the full one-hot plane [F * max_nbins, n] int8 in HBM.
+
+    Bins are loop-invariant across a round's levels (and across rounds), so
+    the plane is built once and every level's histogram becomes ONE int8
+    MXU contraction against it (``build_hist_prehot``) — trading HBM
+    capacity (n x F x B bytes) for the per-level VMEM one-hot builds that
+    otherwise dominate. Built feature-by-feature so the peak temporary is
+    one [B, n] block, not a second full plane."""
+    F, n = bins_t.shape
+    iota = jnp.arange(max_nbins, dtype=jnp.int32)[:, None]
+    blocks = [(bins_t[f][None, :].astype(jnp.int32) == iota).astype(jnp.int8)
+              for f in range(F)]
+    return jnp.concatenate(blocks, axis=0)
+
+
+def build_hist_prehot(oh_pre: jnp.ndarray, gpair: jnp.ndarray,
+                      rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
+                      axis_name=None) -> jnp.ndarray:
+    """Histogram from the pre-materialised one-hot plane: the same 15-bit
+    fixed-point quantisation as the Pallas ``int8x2`` kernel (reference
+    ``GradientQuantiser``, src/tree/gpu_hist/histogram.cu:55-100), but the
+    whole contraction runs as two plain XLA int8 matmuls with int32
+    accumulation — exact, deterministic, and entirely MXU/HBM-bound.
+
+    oh_pre: [F * max_nbins, n] int8 (from ``build_onehot_plane``)
+    -> [n_nodes, F, max_nbins, 2] f32
+
+    int32 accumulation is exact while n * 128 < 2^31 (n <= ~16.7M rows per
+    shard); callers gate on that.
+    """
+    FB, n = oh_pre.shape
+    F = FB // max_nbins
+    gpair_t = gpair.T                                   # [2, n]
+    max_abs = jnp.max(jnp.abs(gpair_t), axis=1)         # [2]
+    if axis_name is not None:
+        max_abs = jax.lax.pmax(max_abs, axis_name)      # global scale
+    scale = 32512.0 / jnp.maximum(max_abs, 1e-30)
+    q = jnp.round(gpair_t * scale[:, None]).astype(jnp.int32)
+    node_oh = (rel_pos.astype(jnp.int32)[None, :]
+               == jnp.arange(n_nodes, dtype=jnp.int32)[:, None])  # [N, n]
+    g_scat = jnp.where(node_oh, q[0][None, :], 0)
+    h_scat = jnp.where(node_oh, q[1][None, :], 0)
+    PT = jnp.concatenate([g_scat, h_scat], axis=0)      # [2N, n] i32
+    hi = (PT + 128) >> 8                                # round-to-nearest
+    lo = (PT - hi * 256).astype(jnp.int8)
+    hi = hi.astype(jnp.int8)
+    contract = (((1,), (1,)), ((), ()))                 # oh . PT^T over rows
+    acc_hi = jax.lax.dot_general(oh_pre, hi, contract,
+                                 preferred_element_type=jnp.int32)
+    acc_lo = jax.lax.dot_general(oh_pre, lo, contract,
+                                 preferred_element_type=jnp.int32)
+    out = acc_hi.astype(jnp.float32) * 256.0 + acc_lo.astype(jnp.float32)
+    inv = jnp.repeat(1.0 / scale, n_nodes)[None, :]     # [1, 2N]
+    out = out * inv                                     # dequantise
+    gh = out.reshape(F, max_nbins, 2, n_nodes)
+    return gh.transpose(3, 0, 1, 2)                     # [N, F, B, 2]
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "max_nbins", "method", "block_rows"))
 def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                n_nodes: int, max_nbins: int, method: str = "auto",
@@ -121,6 +180,10 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
             bins_t = bins.T
         return build_hist_pallas(bins_t, gpair, rel_pos, n_nodes, max_nbins,
                                  precision=precision)
+    if method == "prehot":
+        oh = build_onehot_plane(bins_t if bins_t is not None else bins.T,
+                                max_nbins)
+        return build_hist_prehot(oh, gpair, rel_pos, n_nodes, max_nbins)
     if method == "segment":
         return build_hist_segment(bins, gpair, rel_pos, n_nodes, max_nbins)
     if method == "onehot":

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import build_hist, subtract_siblings
+from ..ops.histogram import (build_hist, build_hist_prehot,
+                             build_onehot_plane, subtract_siblings)
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import CatInfo, evaluate_splits
 from .param import TrainParam, calc_weight
@@ -171,6 +172,19 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     prev_hist = None
     built_is_left = None
 
+    # Pre-materialised one-hot plane (ops/histogram.py
+    # build_onehot_plane): bins are loop-invariant, so one [F*B, n] int8
+    # plane in HBM turns every level's histogram into a single int8 MXU
+    # contraction instead of a per-level VMEM one-hot rebuild. Auto on TPU
+    # when the plane fits the HBM budget; int32 accumulation stays exact
+    # while n * 128 < 2^31.
+    use_prehot = (not use_compaction and n * 128 < 2 ** 31
+                  and (hist_kernel == "prehot"
+                       or (hist_kernel == "auto"
+                           and jax.default_backend() == "tpu"
+                           and n * F * max_nbins <= 8_000_000_000)))
+    oh_pre = (build_onehot_plane(bins_t, max_nbins) if use_prehot else None)
+
     for depth in range(max_depth):
         lo = 2 ** depth - 1
         n_level = 2 ** depth
@@ -179,8 +193,13 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         in_level = (positions >= lo) & (positions < lo + n_level)
         rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
         if depth == 0 or not use_compaction:
-            hist = build_hist(bins, gpair, rel, n_level, max_nbins,
-                              method=hist_kernel, bins_t=bins_t)
+            if use_prehot:
+                hist = build_hist_prehot(
+                    oh_pre, gpair, rel, n_level, max_nbins,
+                    axis_name=axis_name if not col_split else None)
+            else:
+                hist = build_hist(bins, gpair, rel, n_level, max_nbins,
+                                  method=hist_kernel, bins_t=bins_t)
             hist = allreduce(hist)
         else:
             n_parents = n_level // 2
