@@ -1,0 +1,191 @@
+"""Distributed metric aggregation (VERDICT r1 item 4): shard-local eval under
+an InMemoryCommunicator must equal the single-process global eval — the
+reference wraps every metric in collective::GlobalRatio
+(src/collective/aggregator.h:115) and AUC merges across workers
+(src/metric/auc.cc:293,314). Plus sync/prune/refresh under a 2-rank world."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.data.dmatrix import MetaInfo
+from xgboost_tpu.metric import get_metric
+from xgboost_tpu.parallel.collective import (InMemoryCommunicator,
+                                             set_thread_local_communicator)
+
+
+def _run_world(world_size, fn):
+    comms = InMemoryCommunicator.make_world(world_size)
+    results = [None] * world_size
+    errors = []
+
+    def worker(rank):
+        set_thread_local_communicator(comms[rank])
+        try:
+            results[rank] = fn(comms[rank], rank)
+        except Exception as e:
+            errors.append(e)
+        finally:
+            set_thread_local_communicator(None)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(world_size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _shards(n, world):
+    cuts = np.linspace(0, n, world + 1).astype(int)
+    return [(cuts[r], cuts[r + 1]) for r in range(world)]
+
+
+@pytest.mark.parametrize("name", ["rmse", "mae", "logloss", "error",
+                                  "merror", "auc", "aucpr"])
+def test_sharded_equals_global(name):
+    rng = np.random.RandomState(7)
+    n = 400
+    y = (rng.rand(n) > 0.4).astype(np.float64)
+    p = np.clip(rng.rand(n) * 0.6 + y * 0.3, 1e-6, 1 - 1e-6)
+    w = rng.rand(n) + 0.5
+
+    metric = get_metric(name)
+    info_g = MetaInfo(labels=y, weights=w)
+    global_val = metric(p, info_g)
+
+    def fn(comm, rank):
+        s, e = _shards(n, comm.get_world_size())[rank]
+        info = MetaInfo(labels=y[s:e], weights=w[s:e])
+        return get_metric(name)(p[s:e], info)
+
+    for val in _run_world(3, fn):
+        assert val == pytest.approx(global_val, rel=1e-12), name
+
+
+def test_sharded_ndcg_at_k_equals_global():
+    rng = np.random.RandomState(11)
+    n_groups, gsize = 12, 10
+    n = n_groups * gsize
+    y = rng.randint(0, 4, n).astype(np.float64)
+    p = rng.rand(n)
+    group_sizes = np.full(n_groups, gsize)
+
+    metric = get_metric("ndcg@3")
+    info_g = MetaInfo(labels=y)
+    info_g.set_group(group_sizes)
+    global_val = metric(p, info_g)
+
+    def fn(comm, rank):
+        # groups never span workers: each rank takes a contiguous group block
+        world = comm.get_world_size()
+        per = n_groups // world
+        g0, g1 = rank * per, (rank + 1) * per if rank < world - 1 else n_groups
+        s, e = g0 * gsize, g1 * gsize
+        info = MetaInfo(labels=y[s:e])
+        info.set_group(group_sizes[g0:g1])
+        return get_metric("ndcg@3")(p[s:e], info)
+
+    for val in _run_world(3, fn):
+        assert val == pytest.approx(global_val, rel=1e-12)
+
+
+def test_training_eval_sharded_equals_global():
+    """End-to-end: evals computed from row shards during distributed-style
+    eval equal the global numbers (VERDICT: 'masked only because every host
+    sees all rows' — here each thread's metric sees only its shard)."""
+    rng = np.random.RandomState(3)
+    n = 600
+    X = rng.randn(n, 6).astype(np.float32)
+    yb = (X[:, 0] + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3},
+                    xgb.DMatrix(X, label=yb), 5, verbose_eval=False)
+    preds = np.asarray(bst.predict(xgb.DMatrix(X)), np.float64)
+
+    global_auc = get_metric("auc")(preds, MetaInfo(labels=yb))
+    global_ll = get_metric("logloss")(preds, MetaInfo(labels=yb))
+
+    def fn(comm, rank):
+        s, e = _shards(n, comm.get_world_size())[rank]
+        info = MetaInfo(labels=yb[s:e])
+        return (get_metric("auc")(preds[s:e], info),
+                get_metric("logloss")(preds[s:e], info))
+
+    for auc, ll in _run_world(2, fn):
+        assert auc == pytest.approx(global_auc, rel=1e-12)
+        assert ll == pytest.approx(global_ll, rel=1e-12)
+
+
+def test_col_split_metrics_skip_reduction():
+    """Column split: rows replicated on every worker — aggregation must not
+    double-count (reference IsRowSplit guard in aggregator.h)."""
+    rng = np.random.RandomState(5)
+    n = 200
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    p = np.clip(rng.rand(n), 1e-6, 1 - 1e-6)
+    metric = get_metric("logloss")
+    global_val = metric(p, MetaInfo(labels=y))
+
+    def fn(comm, rank):
+        info = MetaInfo(labels=y, data_split_mode="col")
+        return get_metric("logloss")(p, info)
+
+    for val in _run_world(2, fn):
+        assert val == pytest.approx(global_val, rel=1e-12)
+
+
+def test_sync_trees_broadcasts_from_rank0():
+    """TreeSyncher analogue under a 2-rank world (regression for the
+    broadcast_obj AttributeError, tree/updaters.py)."""
+    from xgboost_tpu.tree.updaters import sync_trees
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3},
+                    xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+    trees = bst.gbm.trees
+
+    def fn(comm, rank):
+        local = trees if rank == 0 else []
+        return sync_trees(list(local), communicator=comm)
+
+    results = _run_world(2, fn)
+    assert len(results[1]) == len(trees)
+    for a, b in zip(results[0], results[1]):
+        np.testing.assert_array_equal(a.split_feature, b.split_feature)
+        np.testing.assert_allclose(a.leaf_value, b.leaf_value, rtol=1e-6)
+
+
+def test_prune_refresh_under_communicator():
+    """prune/refresh are rank-local ops on replicated trees: running them
+    under a 2-rank communicator must agree bitwise across ranks."""
+    from xgboost_tpu.tree.param import TrainParam
+    from xgboost_tpu.tree.updaters import prune_tree, refresh_tree
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = X[:, 0] + 0.1 * rng.randn(300)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "gamma": 0.0}, xgb.DMatrix(X, label=y.astype(np.float32)),
+                    2, verbose_eval=False)
+    tree = bst.gbm.trees[0]
+    param = TrainParam()
+    param.update_allow_unknown({"gamma": 0.5, "eta": 0.3})
+    gpair = np.stack([y - y.mean(), np.ones_like(y)], axis=1).astype(
+        np.float32)
+
+    def fn(comm, rank):
+        pruned = prune_tree(tree.copy() if hasattr(tree, "copy") else tree,
+                            param)
+        refreshed = refresh_tree(pruned, X, gpair, param)
+        return (refreshed.leaf_value.copy(), refreshed.sum_hess.copy())
+
+    results = _run_world(2, fn)
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_array_equal(results[0][1], results[1][1])

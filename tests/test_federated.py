@@ -119,3 +119,46 @@ def test_init_by_name():
 def test_rank_validation():
     with pytest.raises(ValueError):
         FederatedCommunicator("localhost:1", world_size=2, rank=5)
+
+
+def test_rendezvous_timeout_rolls_back_state():
+    """A timed-out waiter must not wedge the sequence: its contribution is
+    rolled back so a retried collective on the same seq completes."""
+    from xgboost_tpu.parallel.federated import _Rendezvous
+
+    rv = _Rendezvous(2)
+    with pytest.raises(TimeoutError):
+        rv.exchange(0, 0, "lost", timeout=0.05)
+    assert 0 not in rv.rounds and 0 not in rv.waiting and 0 not in rv.done
+
+    results = {}
+
+    def w(rank):
+        results[rank] = rv.exchange(rank, 0, f"p{rank}", timeout=10.0)
+
+    threads = [threading.Thread(target=w, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert results[0] == ["p0", "p1"] and results[1] == ["p0", "p1"]
+
+
+def test_rendezvous_rejects_duplicate_rank():
+    from xgboost_tpu.parallel.federated import _Rendezvous
+
+    rv = _Rendezvous(2)
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault(0, rv.exchange(0, 7, "x", 10.0)))
+    t.start()
+    for _ in range(200):  # wait until rank 0 is parked in the round
+        with rv.lock:
+            if rv.waiting.get(7, 0) == 1:
+                break
+        threading.Event().wait(0.01)
+    with pytest.raises(RuntimeError, match="duplicate"):
+        rv.exchange(0, 7, "again", timeout=1.0)
+    rv.exchange(1, 7, "y", timeout=10.0)  # legitimate peer releases
+    t.join(10)
+    assert out[0] == ["x", "y"]

@@ -30,6 +30,56 @@ def test_model_wrapper_predicts_locally():
     assert model.get_booster() is bst
 
 
+class _StubTaskInfo:
+    pass
+
+
+class _StubBarrierContext:
+    """Single-task stand-in for pyspark.BarrierTaskContext, so the barrier
+    body logic executes without pyspark (reference gates its spark suite on
+    a real cluster; the body itself deserves a unit test regardless)."""
+
+    def __init__(self, rank=0, world=1):
+        self._rank = rank
+        self._world = world
+
+    def partitionId(self):
+        return self._rank
+
+    def getTaskInfos(self):
+        return [_StubTaskInfo() for _ in range(self._world)]
+
+    def allGather(self, msg):
+        assert self._world == 1
+        return [msg]
+
+    def barrier(self):
+        pass
+
+
+def test_barrier_body_executes_with_stub_context():
+    pd = pytest.importorskip("pandas")
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 1] > 0).astype(np.float32)
+    pdf = pd.DataFrame({"features": list(X), "label": y})
+
+    out = list(sxgb._train_barrier_partition(
+        iter([pdf]), {"objective": "binary:logistic", "max_depth": 3},
+        5, "features", "label", None,
+        barrier_ctx=_StubBarrierContext()))
+    assert len(out) == 1
+    raw = out[0]
+    bst = xgb.Booster()
+    bst.load_model(bytes(raw))
+    preds = bst.predict(xgb.DMatrix(X))
+    assert np.isfinite(preds).all()
+    auc = ((preds[y == 1][:, None] > preds[y == 0][None, :]).mean())
+    assert auc > 0.8
+
+
 @pytest.mark.skipif(pytest.importorskip is None, reason="never")
 def test_full_spark_training():
     pytest.importorskip("pyspark")

@@ -1,0 +1,100 @@
+"""Restricted wire codec (parallel/wire.py): round-trips for all supported
+types, rejection of everything else — the decoder must never be able to
+construct arbitrary objects (the federated threat model; reference uses
+protobuf for the same reason)."""
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.parallel import wire
+
+
+@pytest.mark.parametrize("obj", [
+    None, True, False, 0, -1, 2**62, -(2**62), 2**100, -(2**100),
+    0.0, 3.5, float("inf"),
+    "", "héllo", b"", b"\x00\xff", bytearray(b"xyz"),
+    [], [1, "a", None], (1, 2.5), {"k": [1, 2], 3: "v"},
+    [[(None,)]],
+])
+def test_roundtrip_scalars(obj):
+    got = wire.decode(wire.encode(obj))
+    if isinstance(obj, bytearray):
+        assert got == bytes(obj)
+    elif isinstance(obj, float) and obj != obj:
+        assert got != got
+    else:
+        assert got == obj
+        assert type(got) is type(obj) or isinstance(obj, bytearray)
+
+
+def test_roundtrip_nan():
+    got = wire.decode(wire.encode(float("nan")))
+    assert np.isnan(got)
+
+
+@pytest.mark.parametrize("dtype", ["f4", "f8", "i1", "u1", "i4", "i8",
+                                   "u4", "?", "f2"])
+def test_roundtrip_arrays(dtype):
+    rng = np.random.RandomState(0)
+    for shape in [(), (0,), (5,), (3, 4), (2, 3, 4)]:
+        a = np.asarray(rng.rand(*shape) * 100).astype(dtype)
+        b = wire.decode(wire.encode(a))
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_roundtrip_nested_payload():
+    # the shapes actually exchanged: sketch summaries, tree json, counts
+    payload = [(np.arange(5, dtype=np.float32), np.ones(5)),
+               {"trees": "{...json...}", "n": 3},
+               (np.asarray([7]),)]
+    got = wire.decode(wire.encode(payload))
+    np.testing.assert_array_equal(got[0][0], payload[0][0])
+    assert got[1] == payload[1]
+
+
+def test_rejects_arbitrary_objects():
+    class Evil:
+        pass
+
+    with pytest.raises(wire.WireError):
+        wire.encode(Evil())
+    with pytest.raises(wire.WireError):
+        wire.encode({1: Evil()})
+    with pytest.raises(wire.WireError):
+        wire.encode(np.asarray([Evil()], dtype=object))
+
+
+def test_rejects_malformed_bytes():
+    with pytest.raises(wire.WireError):
+        wire.decode(b"")
+    with pytest.raises(wire.WireError):
+        wire.decode(b"Z")            # unknown tag
+    with pytest.raises(wire.WireError):
+        wire.decode(b"i\x01")        # truncated int
+    with pytest.raises(wire.WireError):
+        wire.decode(wire.encode(1) + b"x")  # trailing bytes
+    # array whose header claims more bytes than present
+    with pytest.raises(wire.WireError):
+        wire.decode(b"a" + b"\x03\x00\x00\x00<f4"
+                    + b"\x01\x00\x00\x00" + b"\x10\x00\x00\x00"
+                    + b"\xff\xff\xff\xff" + b"\x00" * 4)
+
+
+def test_rejects_deep_nesting():
+    obj = []
+    for _ in range(100):
+        obj = [obj]
+    with pytest.raises(wire.WireError):
+        wire.encode(obj)
+    # hand-built deep buffer attacks the decoder directly
+    buf = b"l\x01\x00\x00\x00" * 100 + b"N"
+    with pytest.raises(wire.WireError):
+        wire.decode(buf)
+
+
+def test_no_pickle_in_wire_path():
+    # the federated module must not import pickle at all
+    import xgboost_tpu.parallel.federated as fed
+
+    assert "pickle" not in fed.__dict__

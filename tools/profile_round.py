@@ -1,0 +1,193 @@
+"""Phase breakdown of one boosting round on the real chip.
+
+Each phase (histogram, split-eval, position-advance, gradient) is timed as
+ONE jitted program containing the same 6-level loop as the real fused round,
+repeated REPS times via fori_loop with per-iteration input perturbation
+(defeats CSE) and a scalar carry device_get'd at the end (the only reliable
+sync over the axon tunnel). One compilation per phase keeps total compile
+time bounded. Run on the TPU:
+
+    python tools/profile_round.py            # 1M x 28 (bench config)
+    BENCH_ROWS=4000000 python tools/profile_round.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+COLS = 28
+DEPTH = 6
+MAX_BIN = 256
+REPS = int(os.environ.get("PROFILE_REPS", 5))
+PHASES = set(os.environ.get("PROFILE_PHASES", "hist,eval,adv,grad,full")
+             .split(","))
+
+
+def bench(fn, label, reps=REPS):
+    """fn: jitted nullary returning a scalar; best-of-2 ms per rep."""
+    t0 = time.perf_counter()
+    float(fn())  # compile + warm
+    compile_s = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(fn())
+        best = min(best, time.perf_counter() - t0)
+    ms = best / reps * 1e3
+    print(f"  {label}: {ms:8.2f} ms/round-equivalent "
+          f"(compile {compile_s:.0f}s)", flush=True)
+    return ms
+
+
+def main():
+    print(f"backend={jax.default_backend()}", flush=True)
+    rng = np.random.RandomState(42)
+    X = rng.randn(ROWS, COLS).astype(np.float32)
+    w = rng.randn(COLS).astype(np.float32)
+    y = (X @ w + rng.randn(ROWS).astype(np.float32) > 0).astype(np.float32)
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.ops.histogram import (build_hist_prehot,
+                                           build_onehot_plane)
+    from xgboost_tpu.ops.partition import advance_positions_level
+    from xgboost_tpu.ops.split import evaluate_splits
+    from xgboost_tpu.tree.param import TrainParam
+
+    t0 = time.perf_counter()
+    dm = xgb.DMatrix(X, label=y)
+    binned = dm.binned(MAX_BIN)
+    print(f"dmatrix+binning: {time.perf_counter() - t0:.2f}s", flush=True)
+
+    bins = jnp.asarray(binned.bins)
+    max_nbins = binned.max_nbins
+    n_real = jnp.asarray(binned.n_real_bins())
+    param = TrainParam()
+    param.update_allow_unknown({"max_depth": DEPTH, "eta": 0.1,
+                                "max_bin": MAX_BIN})
+
+    gpair = jnp.stack([jnp.asarray(y) - 0.5,
+                       jnp.full((ROWS,), 0.25, jnp.float32)], axis=1)
+    bins_t = bins.T
+    oh_pre = jax.jit(lambda bt: build_onehot_plane(bt, max_nbins))(bins_t)
+    row_iota = jnp.arange(ROWS, dtype=jnp.int32)
+
+    # ---- phase: histogram, all 6 levels per rep (arrays passed as args —
+    # a closed-over plane would be captured as a 7GB program constant)
+    @jax.jit
+    def hist_phase(oh, gpr, iota):
+        def body(i, acc):
+            gp = gpr * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
+            for d in range(DEPTH):
+                h = build_hist_prehot(oh, gp, iota % (2 ** d),
+                                      2 ** d, max_nbins)
+                acc = acc + jnp.sum(h).astype(jnp.float32)
+            return acc
+        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+    ms_hist = bench(lambda: hist_phase(oh_pre, gpair, row_iota),
+                    "hist prehot (6 levels)") if "hist" in PHASES else 0.0
+
+    # ---- phase: split evaluation, all 6 levels per rep
+    hist32 = jax.jit(lambda: build_hist_prehot(
+        oh_pre, gpair, row_iota % 32, 32, max_nbins))()
+    fmask = jnp.ones((1, COLS), bool)
+
+    @jax.jit
+    def eval_phase(h32):
+        def body(i, acc):
+            pert = 1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30
+            for d in range(DEPTH):
+                h = h32[: 2 ** d] * pert
+                ps = jnp.sum(h, axis=(1, 2)) / COLS
+                r = evaluate_splits(h, ps, n_real, param,
+                                    feature_mask=fmask, has_missing=True)
+                acc = acc + jnp.sum(r.gain).astype(jnp.float32)
+            return acc
+        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+    ms_eval = (bench(lambda: eval_phase(hist32), "split eval (6 levels)")
+               if "eval" in PHASES else 0.0)
+
+    # ---- phase: position advance, all 6 levels per rep
+    bins_f32 = bins.astype(jnp.float32)
+
+    @jax.jit
+    def adv_phase(bf32, iota):
+        def body(i, acc):
+            bump = (acc > 1e30).astype(jnp.int32) + 0 * i
+            for d in range(DEPTH):
+                nl = 2 ** d
+                rel = iota % nl
+                pos = (nl - 1) + rel + bump
+                feats = jnp.arange(nl, dtype=jnp.int32) % COLS
+                sbins = jnp.full((nl,), 100, jnp.int32)
+                p = advance_positions_level(
+                    bf32, pos, rel, feats, sbins,
+                    jnp.zeros((nl,), bool), jnp.ones((nl,), bool),
+                    max_nbins - 1)
+                acc = acc + jnp.sum(p).astype(jnp.float32) * 1e-9
+            return acc
+        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+    ms_adv = (bench(lambda: adv_phase(bins_f32, row_iota),
+                    "advance positions (6 levels)")
+              if "adv" in PHASES else 0.0)
+
+    # ---- phase: gradient
+    from xgboost_tpu.objective import get_objective
+    import types
+    obj = get_objective("binary:logistic", {})
+    sinfo = types.SimpleNamespace(labels=jnp.asarray(y), weights=None)
+    margin0 = jnp.zeros((ROWS, 1), jnp.float32)
+
+    @jax.jit
+    def grad_phase(m0, lab):
+        import types as _t
+        si = _t.SimpleNamespace(labels=lab, weights=None)
+        def body(i, acc):
+            m = m0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30
+            gp = obj.get_gradient(m, si, 0)
+            return acc + jnp.sum(gp).astype(jnp.float32)
+        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+
+    ms_grad = (bench(lambda: grad_phase(margin0, sinfo.labels),
+                     "gradient (binary:logistic)")
+               if "grad" in PHASES else 0.0)
+
+    # ---- full fused round, amortised over 10 rounds
+    if "full" not in PHASES:
+        print(f"partial totals: hist {ms_hist:.1f} eval {ms_eval:.1f} "
+              f"adv {ms_adv:.1f} grad {ms_grad:.1f}", flush=True)
+        return
+    params = {"objective": "binary:logistic", "max_depth": DEPTH,
+              "eta": 0.1, "max_bin": MAX_BIN}
+    xgb.train(params, dm, 2, verbose_eval=False)  # warm-up/compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        bst = xgb.train(params, dm, 10, verbose_eval=False)
+        st = next(iter(bst._caches.values()))
+        float(jnp.sum(st["margin"]))  # force the whole chain
+        best = min(best, time.perf_counter() - t0)
+    per_round = best / 10 * 1e3
+    print(f"\nfull fused round: {per_round:.1f} ms/round "
+          f"({10 / best:.2f} rounds/s)", flush=True)
+    accounted = ms_hist + ms_eval + ms_adv + ms_grad
+    print(f"accounted: {accounted:.1f} ms/round (hist {ms_hist:.1f} + "
+          f"eval {ms_eval:.1f} + advance {ms_adv:.1f} + grad {ms_grad:.1f})"
+          f"; unaccounted {per_round - accounted:.1f} ms = delta "
+          f"accumulation + host dispatch + fusion differences", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -304,6 +304,22 @@ class Booster:
             # first seen as eval-only; rebuild as a training entry
             del self._caches[key]
         if key not in self._caches:
+            if is_train and getattr(dm, "presharded", False):
+                # ShardedDMatrix (parallel/launch.py): the global quantized
+                # matrix was already assembled from per-process shards — no
+                # host-global arrays exist anywhere. Must be checked before
+                # the approx/exact branch: those train on raw thresholds of
+                # the (local-only) X and would silently fit 1/N of the data.
+                if tm in ("approx", "exact"):
+                    raise NotImplementedError(
+                        f"tree_method={tm} is not supported with sharded "
+                        "multi-process ingestion; use hist")
+                base = (self.base_margin_ if self.base_margin_ is not None
+                        else np.zeros(self.n_groups, np.float32))
+                return self._store_cache(
+                    key, dm.global_binned(),
+                    dm.make_margin(base, self.n_groups), True, dm,
+                    dm.device_info(), dm.num_row())
             if is_train and tm in ("approx", "exact"):
                 # approx re-sketches per iteration and exact rank-encodes
                 # losslessly — neither trains against a shared binned matrix,
@@ -808,14 +824,14 @@ class Booster:
         for dm, name in evals:
             margin = self._cached_margin(dm)
             preds = self.obj.pred_transform(margin)
-            preds_np = np.asarray(preds)[: dm.num_row()]
+            preds_np = self._host_rows(preds, dm)
             if preds_np.ndim == 2 and preds_np.shape[1] == 1:
                 preds_np = preds_np[:, 0]
             for metric in self._eval_metrics:
                 score = metric(preds_np, dm.info)
                 msg += f"\t{name}-{metric.full_name}:{score:.6f}"
             if feval is not None:
-                margin_np = np.asarray(margin)[: dm.num_row()]
+                margin_np = self._host_rows(margin, dm)
                 if margin_np.ndim == 2 and margin_np.shape[1] == 1:
                     margin_np = margin_np[:, 0]
                 res = feval(margin_np if output_margin else preds_np, dm)
@@ -823,6 +839,16 @@ class Booster:
                 for mname, val in pairs:
                     msg += f"\t{name}-{mname}:{val:.6f}"
         return msg
+
+    @staticmethod
+    def _host_rows(arr, dm) -> np.ndarray:
+        """Host view of this process's valid rows. Fully-addressable arrays
+        (single-controller) trim padding; mesh-global arrays from a
+        ShardedDMatrix pull only the local shard."""
+        if hasattr(dm, "local_rows") and isinstance(arr, jax.Array) \
+                and not arr.is_fully_addressable:
+            return dm.local_rows(arr)
+        return np.asarray(arr)[: dm.num_row()]
 
     # -------------------------------------------------------------- attributes
     def attr(self, key: str) -> Optional[str]:

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import os
 import pickle
-import socket
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -155,15 +154,34 @@ class LocalProcessClient:
         return {"workers": {f"local-{i}": {} for i in range(self.n_workers)}}
 
 
-def _n_workers(client: Any) -> int:
+def _worker_addresses(client: Any) -> List[str]:
     info = client.scheduler_info()
-    return max(len(info.get("workers", {})), 1)
+    return list(info.get("workers", {}))
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+def _submit(client: Any, fn, *args, workers: Optional[List[str]] = None):
+    """Submit with best-effort worker pinning: real dask honours
+    ``workers=``; duck-typed clients that don't understand it still work
+    (LocalProcessClient runs everything on localhost anyway)."""
+    if workers:
+        try:
+            return client.submit(fn, *args, workers=workers,
+                                 allow_other_workers=False)
+        except TypeError:
+            pass
+    return client.submit(fn, *args)
+
+
+def _probe_coordinator() -> str:
+    """Pick the jax.distributed coordinator endpoint on THIS worker's host.
+
+    Runs as a task pinned to the worker that will become rank 0: the
+    coordinator service is hosted in-process by rank 0, so the endpoint
+    must be an address routable to that machine — the driver's hostname
+    (let alone ``localhost``) is wrong on any real multi-machine cluster."""
+    from .parallel.tracker import Tracker
+
+    return Tracker(n_workers=1).worker_args()["coordinator_address"]
 
 
 # ------------------------------------------------------------------ dispatch
@@ -174,10 +192,13 @@ def _dispatched_train(params: Dict[str, Any], shard: Dict[str, list],
     """Per-worker body (reference ``dispatched_train``, dask.py:939-1030):
     join the coordinator, build the local shard, train SPMD, return the
     serialized model (identical on every rank)."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import jax
-
+    # Respect the worker's own platform (TPU workers train on TPU). Only
+    # when the env explicitly asks for CPU (test harness) re-latch the
+    # config, since a sitecustomize may have pinned another platform at
+    # interpreter start.
     if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
         jax.config.update("jax_platforms", "cpu")
     from .parallel import collective, launch
 
@@ -207,12 +228,21 @@ def train(client: Any, params: Dict[str, Any], dtrain: DaskDMatrix,
     returns ``{"booster": Booster, "history": {}}``."""
     from .core import Booster
 
-    world = min(_n_workers(client), max(dtrain.num_partitions(), 1))
+    addrs = _worker_addresses(client)
+    world = min(max(len(addrs), 1), max(dtrain.num_partitions(), 1))
     shards = dtrain._worker_shards(world)
-    coordinator = f"localhost:{_free_port()}"
+    # rank r is pinned (best-effort) to addrs[r % len], so the coordinator
+    # probe below and rank 0's training task land on the same machine
+    pins = [[addrs[r % len(addrs)]] if addrs else None for r in range(world)]
+    if world > 1:
+        probe = _submit(client, _probe_coordinator, workers=pins[0])
+        res = client.gather([probe])[0]
+        coordinator = res.result() if hasattr(res, "result") else res
+    else:
+        coordinator = ""  # single worker: never joins a cluster
     futures = [
-        client.submit(_dispatched_train, params, shards[r], r, world,
-                      coordinator, num_boost_round, dict(kwargs))
+        _submit(client, _dispatched_train, params, shards[r], r, world,
+                coordinator, num_boost_round, dict(kwargs), workers=pins[r])
         for r in range(world)]
     results = client.gather(futures)
     raws = [r.result() if hasattr(r, "result") else r for r in results]
@@ -222,7 +252,6 @@ def train(client: Any, params: Dict[str, Any], dtrain: DaskDMatrix,
 
 
 def _dispatched_predict(raw: bytes, part: Any) -> np.ndarray:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from .core import Booster
     from .data.dmatrix import DMatrix
 
@@ -283,7 +312,11 @@ class DaskXGBClassifier(_DaskModelBase):
     _objective = "binary:logistic"
 
     def predict_proba(self, X: Any) -> np.ndarray:
-        return super().predict(X)
+        # sklearn contract: [n, n_classes], one column per class
+        p = super().predict(X)
+        if p.ndim == 1:
+            return np.column_stack([1.0 - p, p])
+        return p
 
     def predict(self, X: Any) -> np.ndarray:
-        return (self.predict_proba(X) > 0.5).astype(np.int32)
+        return self.predict_proba(X).argmax(axis=1).astype(np.int32)

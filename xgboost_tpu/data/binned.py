@@ -130,11 +130,16 @@ class BinnedMatrix:
         (never matched) when the matrix has no missing values."""
         return self.max_nbins - 1 if self.has_missing else self.max_nbins
 
-    def n_real_bins(self) -> jnp.ndarray:
-        """[n_features] int32 count of real (non-missing) bins per feature."""
+    def n_real_bins(self) -> np.ndarray:
+        """[n_features] int32 count of real (non-missing) bins per feature.
+
+        Host array on purpose: it feeds jits as a replicated input, and in a
+        multi-controller world only host values (identical on every process)
+        and global arrays are valid jit arguments — a committed process-local
+        device array is not."""
         if self.n_real_override is not None:
-            return jnp.asarray(self.n_real_override)
-        return jnp.asarray(self.cuts.n_real_bins())
+            return np.asarray(self.n_real_override)
+        return np.asarray(self.cuts.n_real_bins())
 
     def to_values(self) -> jnp.ndarray:
         """Reconstruct representative feature values from bin ids (the

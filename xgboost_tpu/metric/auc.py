@@ -2,6 +2,15 @@
 
 Binary ROC-AUC via the rank-sum formulation with weight support; multiclass =
 weighted one-vs-rest average (matching the reference's OVR handling).
+
+Distributed evaluation: binary/multiclass AUC allgathers the (label, pred,
+weight) triples so the global ranking — and therefore the metric — is EXACT
+and identical to a single-host evaluation. (The reference instead merges
+local curves approximately: ``GlobalRatio`` of per-worker unnormalised areas,
+``auc.cc:314``; exactness is cheap here because metric evaluation is a
+host-side, once-per-round operation.) Ranking AUC keeps the reference's
+``GlobalRatio(sum_auc, valid_groups)`` (``auc.cc:293``) — query groups never
+span workers, so that merge is already exact.
 """
 
 from __future__ import annotations
@@ -9,7 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, global_mean
 
 
 def binary_roc_auc(labels: np.ndarray, preds: np.ndarray,
@@ -53,6 +62,23 @@ def binary_pr_auc(labels: np.ndarray, preds: np.ndarray,
     return float(np.sum((rec - rec0) * prec))
 
 
+def _gather_rows(y: np.ndarray, p: np.ndarray, w: np.ndarray, info):
+    """Exact distributed AUC: every worker contributes its (label, pred,
+    weight) shard; the concatenation makes the global ranking exact."""
+    from ..parallel.collective import get_communicator
+
+    comm = get_communicator()
+    if (not comm.is_distributed()
+            or getattr(info, "data_split_mode", "row") != "row"):
+        return y, p, w
+    parts = comm.allgather_objects(
+        (np.ascontiguousarray(y), np.ascontiguousarray(p),
+         np.ascontiguousarray(w)))
+    return (np.concatenate([a for a, _, _ in parts]),
+            np.concatenate([b for _, b, _ in parts]),
+            np.concatenate([c for _, _, c in parts]))
+
+
 class _AucBase(Metric):
     maximize = True
     _fn = staticmethod(binary_roc_auc)
@@ -62,18 +88,20 @@ class _AucBase(Metric):
         p = np.asarray(preds, dtype=np.float64)
         w = self.weights_of(info, len(y))
         if info.group_ptr is not None and len(info.group_ptr) > 2:
-            # ranking AUC: mean per-query AUC, weighted by query weight
+            # ranking AUC: mean per-query AUC; the cross-worker merge is the
+            # reference's GlobalRatio(sum_auc, valid_groups) (auc.cc:293)
             ptr = info.group_ptr
-            aucs, ws = [], []
+            total, valid = 0.0, 0.0
             for q in range(len(ptr) - 1):
                 s, e = int(ptr[q]), int(ptr[q + 1])
                 if e - s < 2:
                     continue
                 a = self._fn(y[s:e], p[s:e], np.ones(e - s))
                 if not np.isnan(a):
-                    aucs.append(a)
-                    ws.append(1.0)
-            return float(np.average(aucs, weights=ws)) if aucs else float("nan")
+                    total += a
+                    valid += 1.0
+            return float(global_mean(total, valid, info))
+        y, p, w = _gather_rows(y, p, w, info)
         if p.ndim == 2 and p.shape[1] > 1:
             # multiclass OVR, class-weighted like the reference
             total, wsum = 0.0, 0.0

@@ -9,6 +9,20 @@ import numpy as np
 from ..registry import METRICS
 
 
+def global_mean(numerator: float, denominator: float, info) -> float:
+    """Aggregate a weighted-mean metric across workers (reference wraps every
+    metric in ``collective::GlobalRatio``, ``src/collective/aggregator.h:115``
+    — sum numerator and denominator over the active communicator, then
+    divide). Single-process (NoOp communicator) this is a plain division.
+    Under column split the rows are replicated on every worker, so the
+    reduction is skipped (reference ``IsRowSplit`` guard)."""
+    from ..parallel.collective import global_ratio
+
+    row_split = getattr(info, "data_split_mode", "row") == "row"
+    return global_ratio(float(numerator), float(denominator),
+                        row_split=row_split)
+
+
 class Metric:
     name: str = ""
     # True when larger values are better (drives early stopping, reference

@@ -5,7 +5,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, global_mean
 
 
 def _labels1d(info) -> np.ndarray:
@@ -29,7 +29,8 @@ class _WeightedMean(Metric):
             # multi-output: rows weighted, targets averaged (reference
             # treats the [n, K] residual matrix as n*K weighted samples)
             w = np.broadcast_to(w[:, None], loss.shape)
-        return float(self.finalize(np.sum(loss * w) / np.sum(w)))
+        return float(self.finalize(
+            global_mean(np.sum(loss * w), np.sum(w), info)))
 
 
 @METRICS.register("rmse")
@@ -100,7 +101,7 @@ class BinaryError(Metric):
         p = np.asarray(preds, dtype=np.float64).reshape(y.shape)
         w = self.weights_of(info, len(y))
         wrong = (p > t).astype(np.float64) != (y > 0.5)
-        return float(np.sum(wrong * w) / np.sum(w))
+        return float(global_mean(np.sum(wrong * w), np.sum(w), info))
 
 
 @METRICS.register("poisson-nloglik")
@@ -156,4 +157,4 @@ class TweedieNLL(Metric):
         a = y * np.power(p, 1.0 - rho) / (1.0 - rho)
         b = np.power(p, 2.0 - rho) / (2.0 - rho)
         loss = -a + b
-        return float(np.sum(loss * w) / np.sum(w))
+        return float(global_mean(np.sum(loss * w), np.sum(w), info))

@@ -5,7 +5,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, global_mean
 
 
 @METRICS.register("merror")
@@ -17,7 +17,7 @@ class MultiError(Metric):
         p = np.asarray(preds)
         cls = p.argmax(axis=1) if p.ndim == 2 else p.astype(np.int64)
         w = self.weights_of(info, len(y))
-        return float(np.sum((cls != y) * w) / np.sum(w))
+        return float(global_mean(np.sum((cls != y) * w), np.sum(w), info))
 
 
 @METRICS.register("mlogloss")
@@ -30,4 +30,5 @@ class MultiLogLoss(Metric):
         eps = 1e-16
         picked = np.clip(p[np.arange(len(y)), y], eps, 1.0)
         w = self.weights_of(info, len(y))
-        return float(np.sum(-np.log(picked) * w) / np.sum(w))
+        return float(global_mean(np.sum(-np.log(picked) * w), np.sum(w),
+                                 info))

@@ -9,7 +9,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, global_mean
 
 
 def _per_query(info, preds):
@@ -43,13 +43,15 @@ class _TopKMetric(Metric):
         raise NotImplementedError
 
     def __call__(self, preds, info) -> float:
+        # queries never span workers (reference: groups are shard-local),
+        # so per-query scores sum locally and the mean aggregates globally
         total, wsum = 0.0, 0.0
         for y, s, w in _per_query(info, preds):
             k = self.k if self.k > 0 else len(y)
             order = np.argsort(-s, kind="stable")
             total += self.query_score(y, order, min(k, len(y))) * w
             wsum += w
-        return float(total / wsum) if wsum else float("nan")
+        return float(global_mean(total, wsum, info))
 
 
 def dcg_at(y_sorted: np.ndarray, k: int, exp_gain: bool = True) -> float:

@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, global_mean
 
 _EPS = 1e-12
 
@@ -47,7 +47,7 @@ class AFTNegLogLik(Metric):
             - np.where(lo > 0, cdf(z_lo), 0.0))
         w = self.weights_of(info, len(mu))
         nll = -np.log(np.maximum(L, _EPS))
-        return float(np.sum(nll * w) / np.sum(w))
+        return float(global_mean(np.sum(nll * w), np.sum(w), info))
 
 
 @METRICS.register("cox-nloglik")
@@ -82,7 +82,7 @@ class IntervalRegressionAccuracy(Metric):
         hi = np.asarray(info.label_upper_bound, np.float64)
         ok = (t >= lo) & ((~np.isfinite(hi)) | (t <= hi))
         w = self.weights_of(info, len(t))
-        return float(np.sum(ok * w) / np.sum(w))
+        return float(global_mean(np.sum(ok * w), np.sum(w), info))
 
 
 @METRICS.register("quantile")
@@ -100,4 +100,4 @@ class QuantileLoss(Metric):
         err = y - p
         loss = np.where(err >= 0, alpha * err, (alpha - 1.0) * err)
         w = self.weights_of(info, len(y))
-        return float(np.sum(loss * w) / np.sum(w))
+        return float(global_mean(np.sum(loss * w), np.sum(w), info))

@@ -70,12 +70,20 @@ class Objective:
         return prob
 
     def init_estimation(self, info) -> np.ndarray:
-        """One Newton step from margin 0 (reference fit_stump)."""
+        """One Newton step from margin 0 (reference fit_stump,
+        ``src/tree/fit_stump.cc:25-58`` — gradient sums cross workers via
+        ``collective::GlobalSum`` so every rank derives the same base score
+        from its row shard)."""
+        from ..parallel.collective import global_sum
+
         k = self.n_targets(info)
         zero = jnp.zeros((len(info.labels), k), dtype=jnp.float32)
         gpair = np.asarray(self.get_gradient(zero, info))
-        g = gpair[..., 0].sum(axis=0)
-        h = gpair[..., 1].sum(axis=0)
+        row_split = getattr(info, "data_split_mode", "row") == "row"
+        gh = global_sum(
+            np.stack([gpair[..., 0].sum(axis=0), gpair[..., 1].sum(axis=0)]),
+            row_split=row_split)
+        g, h = gh[0], gh[1]
         return np.where(h <= 0, 0.0, -g / np.maximum(h, 1e-10)).astype(np.float32)
 
     def to_json(self) -> Dict[str, Any]:

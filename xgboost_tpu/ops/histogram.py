@@ -181,6 +181,14 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
         return build_hist_pallas(bins_t, gpair, rel_pos, n_nodes, max_nbins,
                                  precision=precision)
     if method == "prehot":
+        # int32 accumulation is exact only while n * 128 < 2^31 (~16.7M rows
+        # per shard) — enforce here, not just on the auto path, so an
+        # explicit hist_method="prehot" can't silently overflow (row count
+        # is a static shape, so this resolves at trace time)
+        if bins.shape[0] * 128 >= 2 ** 31:
+            return build_hist_onehot(
+                bins, gpair, rel_pos, n_nodes, max_nbins,
+                block_rows=min(block_rows, max(bins.shape[0], 8)))
         oh = build_onehot_plane(bins_t if bins_t is not None else bins.T,
                                 max_nbins)
         return build_hist_prehot(oh, gpair, rel_pos, n_nodes, max_nbins)

@@ -150,23 +150,23 @@ class JaxProcessCommunicator(Communicator):
         raise ValueError(f"unknown op {op}")
 
     def allgather_objects(self, obj: Any) -> List[Any]:
-        """Per-rank objects, any picklable payload. process_allgather only
-        stacks identically-shaped array leaves, so ranks exchange padded
-        pickle buffers instead (same symmetric-collective trick as
-        apply_with_labels)."""
+        """Per-rank objects (wire-safe payloads — see wire.py).
+        process_allgather only stacks identically-shaped array leaves, so
+        ranks exchange padded wire buffers instead (same symmetric-collective
+        trick as apply_with_labels)."""
         if self._world == 1:
             return [obj]
-        import pickle
-
         from jax.experimental import multihost_utils
 
-        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        from . import wire
+
+        payload = np.frombuffer(wire.encode(obj), np.uint8)
         lengths = multihost_utils.process_allgather(
             np.asarray([len(payload)], np.int64), tiled=False).reshape(-1)
         buf = np.zeros(int(lengths.max()), np.uint8)
         buf[: len(payload)] = payload
         mat = multihost_utils.process_allgather(buf, tiled=False)
-        return [pickle.loads(mat[r, : int(lengths[r])].tobytes())
+        return [wire.decode(mat[r, : int(lengths[r])].tobytes())
                 for r in range(self._world)]
 
 
@@ -339,16 +339,17 @@ def apply_with_labels(fn, comm: Optional[Communicator] = None,
     if not comm.is_distributed():
         return fn()
     # symmetric-collective broadcast: process-group backends only support
-    # identically-shaped arrays on every rank, so the object is pickled on
-    # the label rank, its length maxed, and the zero-padded byte buffer
-    # sum-reduced (all other ranks contribute zeros)
-    import pickle
+    # identically-shaped arrays on every rank, so the object is wire-encoded
+    # on the label rank (restricted codec, never pickle — peers may be
+    # mutually distrusting under vertical federated), its length maxed, and
+    # the zero-padded byte buffer sum-reduced (other ranks contribute zeros)
+    from . import wire
 
-    payload = (pickle.dumps(fn()) if comm.get_rank() == label_rank else b"")
+    payload = (wire.encode(fn()) if comm.get_rank() == label_rank else b"")
     n = int(comm.allreduce(np.asarray([len(payload)], np.int64),
                            op="max")[0])
     buf = np.zeros(n, np.uint8)  # only one rank contributes: no overflow
     buf[: len(payload)] = np.frombuffer(payload, np.uint8)
     # reductions may promote the dtype; the values still fit a byte
     buf = comm.allreduce(buf, op="sum").astype(np.uint8)
-    return pickle.loads(buf.tobytes())
+    return wire.decode(buf.tobytes())

@@ -8,8 +8,11 @@ parties that cannot share raw data train one model by exchanging only
 aggregates through a coordinating server.
 
 No .proto codegen: the single ``Exchange`` RPC moves opaque bytes via
-grpc's generic method handlers, so the wire format is a host-side detail
-(pickled ``(rank, seq, payload)`` up, pickled payload list down). The
+grpc's generic method handlers. The wire format is the restricted codec in
+``wire.py`` — ``(rank, seq, payload)`` up, payload list down — NOT pickle:
+federated parties are mutually distrusting, and the decoder must never be
+able to construct arbitrary objects from a malicious peer's bytes (the
+reference uses protobuf for the same reason). The
 collective semantics mirror ``InMemoryCommunicator``: every round is an
 allgather rendezvous keyed by a client-side sequence number; allreduce
 reduces the gathered parts locally, exactly how the reference's federated
@@ -22,12 +25,12 @@ to ``run_federated_server``/``FederatedCommunicator``.
 
 from __future__ import annotations
 
-import pickle
 import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import wire
 from .collective import Communicator
 
 _SERVICE = "xgboost_tpu.federated.Federated"
@@ -45,26 +48,46 @@ class _Rendezvous:
         self.world_size = world_size
         self.lock = threading.Condition()
         self.rounds: Dict[int, List[Any]] = {}
+        self.arrived: Dict[int, set] = {}
         self.done: Dict[int, List[Any]] = {}
         self.waiting: Dict[int, int] = {}
 
     def exchange(self, rank: int, seq: int, payload: Any,
                  timeout: float) -> List[Any]:
         with self.lock:
+            if seq in self.done:
+                raise RuntimeError(
+                    f"stale arrival rank={rank} for completed seq={seq}")
             slot = self.rounds.setdefault(seq, [None] * self.world_size)
+            arrived = self.arrived.setdefault(seq, set())
+            if rank in arrived:
+                raise RuntimeError(f"duplicate arrival rank={rank} seq={seq}")
+            arrived.add(rank)
             slot[rank] = payload
             self.waiting[seq] = self.waiting.get(seq, 0) + 1
             if self.waiting[seq] == self.world_size:
                 self.done[seq] = slot
                 del self.rounds[seq]
+                del self.arrived[seq]
                 self.lock.notify_all()
             else:
                 deadline = threading.TIMEOUT_MAX if timeout is None else timeout
                 if not self.lock.wait_for(lambda: seq in self.done,
                                           timeout=deadline):
+                    # roll back this waiter's contribution so a retried
+                    # collective (or a late peer) doesn't see corrupt state
+                    missing = self.world_size - self.waiting.get(seq, 0)
+                    if seq in self.rounds:
+                        self.rounds[seq][rank] = None
+                        self.arrived[seq].discard(rank)
+                        self.waiting[seq] -= 1
+                        if self.waiting[seq] == 0:
+                            del self.rounds[seq]
+                            del self.arrived[seq]
+                            del self.waiting[seq]
                     raise TimeoutError(
                         f"federated exchange seq={seq} timed out waiting for "
-                        f"{self.world_size - self.waiting.get(seq, 0)} workers")
+                        f"{missing} workers")
             out = self.done[seq]
             self.waiting[seq] -= 1
             if self.waiting[seq] == 0:  # last reader frees the round
@@ -89,9 +112,12 @@ class FederatedServer:
         self._timeout = timeout
 
         def exchange(request: bytes, context) -> bytes:
-            rank, seq, payload = pickle.loads(request)
+            rank, seq, payload = wire.decode(request)
+            if not (isinstance(rank, int) and isinstance(seq, int)
+                    and 0 <= rank < world_size and seq >= 0):
+                raise wire.WireError(f"bad header rank={rank!r} seq={seq!r}")
             out = self._rendezvous.exchange(rank, seq, payload, self._timeout)
-            return pickle.dumps(out)
+            return wire.encode(out)
 
         handler = grpc.method_handlers_generic_handler(
             _SERVICE,
@@ -170,8 +196,8 @@ class FederatedCommunicator(Communicator):
     def _exchange(self, payload: Any) -> List[Any]:
         seq = self._seq
         self._seq += 1
-        request = pickle.dumps((self._rank, seq, payload))
-        return pickle.loads(self._call(request, timeout=self._timeout))
+        request = wire.encode((self._rank, seq, payload))
+        return wire.decode(self._call(request, timeout=self._timeout))
 
     def allgather_objects(self, obj: Any) -> List[Any]:
         return self._exchange(obj)

@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from . import collective
+from ..data.dmatrix import DMatrix, MetaInfo
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -122,36 +123,132 @@ def train_per_host(params: Dict[str, Any], X_local: np.ndarray,
         return train({**params, "mesh": mesh}, dm, num_boost_round,
                      **train_kwargs)
 
-    # Multi-controller: SPMD requires every process to hold identical global
-    # host arrays before the mesh device_put shards them, so the local row
-    # shards are allgathered (rank order) into one global matrix first. This
-    # trades host RAM for simplicity — a make_array_from_process_local_data
-    # fast path that feeds pre-sharded device arrays straight into the
-    # binning/ training cache is the planned optimisation.
-    comm = collective.get_communicator()
-    w = (np.ones(len(X_local), np.float32) if weight_local is None
-         else np.asarray(weight_local, np.float32))
-    # the process allgather stacks arrays, so shards must be equal-shaped:
-    # pad each to the global max row count, gather, then trim by true counts
-    n_local = len(X_local)
-    n_max = int(comm.allreduce(np.asarray([n_local]), op="max")[0])
-    pad = n_max - n_local
-    Xp = np.concatenate([np.asarray(X_local, np.float32),
-                         np.full((pad, X_local.shape[1]), np.nan,
-                                 np.float32)]) if pad else np.asarray(
-        X_local, np.float32)
-    yp = np.concatenate([np.asarray(y_local, np.float32),
-                         np.zeros(pad, np.float32)]) if pad else np.asarray(
-        y_local, np.float32)
-    wp = np.concatenate([w, np.zeros(pad, np.float32)]) if pad else w
-    counts = comm.allgather_objects(np.asarray([n_local]))
-    parts = comm.allgather_objects((Xp, yp, wp))
-    X = np.concatenate([p[0][: int(c[0])]
-                        for p, c in zip(parts, counts)])
-    y = np.concatenate([p[1][: int(c[0])]
-                        for p, c in zip(parts, counts)])
-    wg = np.concatenate([p[2][: int(c[0])]
-                         for p, c in zip(parts, counts)])
-    dm = DMatrix(X, label=y, weight=wg)
+    # Multi-controller: true sharded ingestion — each process contributes
+    # ONLY its local row shard (reference dask.py:261-470 partition mapping).
+    # Global quantile cuts come from the distributed sketch merge
+    # (src/common/quantile.cc:147-390 analogue); rows are binned locally and
+    # the global quantized matrix is assembled shard-by-shard with
+    # jax.make_array_from_process_local_data. No process ever materialises
+    # the global feature matrix.
+    dm = ShardedDMatrix(X_local, label=y_local, weight=weight_local,
+                        mesh=mesh,
+                        max_bin=int(params.get("max_bin", 256)))
     return train({**params, "mesh": mesh}, dm, num_boost_round,
                  **train_kwargs)
+
+
+class ShardedDMatrix(DMatrix):
+    """Per-process row shard of a global training matrix.
+
+    The quantized global matrix lives as one mesh-sharded ``jax.Array``
+    assembled from process-local blocks; labels/weights/margin shard the
+    same way. Host-side views (``info``, ``num_row``, ``values``) are LOCAL
+    — metrics evaluate shard-locally and aggregate through the communicator
+    (``metric.base.global_mean``), exactly the reference's GlobalRatio
+    design. Local shards are padded to the per-process maximum with
+    weight-0 rows so every device gets an equal block (static XLA shapes);
+    padded rows carry zero gradient and never affect the model.
+    """
+
+    presharded = True
+
+    def __init__(self, data: Any, label: Any = None, *,
+                 weight: Optional[np.ndarray] = None, mesh=None,
+                 max_bin: int = 256,
+                 comm: Optional[collective.Communicator] = None) -> None:
+        import jax
+        import jax.numpy as jnp
+        import jax.sharding as jsh
+
+        from ..context import DATA_AXIS
+        from ..data.adapters import to_dense
+        from ..data.binned import (BinnedMatrix, _dtype_for, search_bin_into)
+
+        comm = comm if comm is not None else collective.get_communicator()
+        X_local, _, _ = to_dense(data, np.nan)
+        X_local = np.ascontiguousarray(X_local, np.float32)
+        n_local, F = X_local.shape
+        y = None if label is None else np.asarray(label, np.float32)
+        if y is not None and y.ndim > 1 and y.shape[1] > 1:
+            raise NotImplementedError(
+                "ShardedDMatrix does not support multi-target labels yet")
+        w = None if weight is None else np.asarray(weight, np.float32)
+
+        # host-local view: metrics/predict see only this shard
+        self.X = X_local
+        self.info = MetaInfo(labels=y, weights=w, data_split_mode="row")
+        self.info.validate(n_local)
+        self.missing = np.nan
+        self._n_local = n_local
+
+        # 1. global cuts from the distributed sketch merge
+        cuts = collective.distributed_sketch(X_local, max_bin, weights=w,
+                                             comm=comm)
+        has_missing = bool(int(comm.allreduce(
+            np.asarray([int(np.isnan(X_local).any())]), op="max")[0]))
+        max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
+        missing_bin = max_nbins - 1 if has_missing else max_nbins
+
+        # 2. local binning against the (identical-everywhere) global cuts
+        bins_local = np.empty(
+            (n_local, F), _dtype_for(max(max_nbins - 1, 1)))
+        search_bin_into(X_local, cuts, min(missing_bin, max_nbins - 1),
+                        bins_local)
+
+        # 3. equal per-process blocks: pad to the global max local count,
+        # rounded up to a multiple of this process's device count
+        local_devs = jax.local_device_count()
+        n_max = int(comm.allreduce(np.asarray([n_local]), op="max")[0])
+        n_block = ((max(n_max, 1) + local_devs - 1) // local_devs) * local_devs
+        pad = n_block - n_local
+        if pad:
+            fill = np.full((pad, F), min(missing_bin, max_nbins - 1),
+                           bins_local.dtype)
+            bins_local = np.concatenate([bins_local, fill])
+        yp = np.zeros(n_block, np.float32)
+        if y is not None:
+            yp[:n_local] = y.reshape(n_local, -1)[:, 0] if y.ndim > 1 else y
+        wp = np.zeros(n_block, np.float32)
+        wp[:n_local] = 1.0 if w is None else w
+
+        # 4. assemble the global arrays from local blocks
+        row_sh = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS, None))
+        vec_sh = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS))
+        bins_g = jax.make_array_from_process_local_data(row_sh, bins_local)
+        self._labels_g = jax.make_array_from_process_local_data(vec_sh, yp)
+        self._weights_g = jax.make_array_from_process_local_data(vec_sh, wp)
+        self._binned_g = BinnedMatrix(bins=bins_g, cuts=cuts,
+                                      max_nbins=max_nbins,
+                                      has_missing=has_missing)
+        self._row_sharding = row_sh
+        self._mesh = mesh
+        self.n_global = n_block * jax.process_count()
+
+    # device-side training views ------------------------------------------
+    def device_info(self) -> MetaInfo:
+        """MetaInfo whose label/weight leaves are global mesh-sharded
+        arrays (weight 0 on padded rows)."""
+        return MetaInfo(labels=self._labels_g, weights=self._weights_g,
+                        data_split_mode="row")
+
+    def global_binned(self):
+        return self._binned_g
+
+    def make_margin(self, base: np.ndarray, n_groups: int):
+        """Global [n_global, K] margin initialised to the base score,
+        sharded like the rows (built block-wise: no global host array)."""
+        import jax
+
+        block = np.broadcast_to(
+            np.asarray(base, np.float32)[None, :],
+            (self.n_global // jax.process_count(), n_groups)).copy()
+        return jax.make_array_from_process_local_data(
+            self._row_sharding, block)
+
+    def local_rows(self, arr) -> np.ndarray:
+        """This process's valid rows of a row-sharded global array, in local
+        order (padding trimmed) — the eval/metrics view."""
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+        return local[: self._n_local]

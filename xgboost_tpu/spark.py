@@ -37,21 +37,43 @@ def _require_pyspark():
 def _train_barrier_partition(iterator, params: Dict[str, Any],
                              num_boost_round: int, features_col: str,
                              label_col: str, weight_col: Optional[str],
-                             coordinator: str):
+                             barrier_ctx=None):
     """Barrier-task body (reference ``_train_booster``,
     spark/core.py:909-984). Runs inside a ``RDD.barrier()`` stage: all
-    partitions execute concurrently and rendezvous on the coordinator."""
-    from pyspark import BarrierTaskContext  # pragma: no cover - needs spark
+    partitions execute concurrently; rank 0 picks the jax.distributed
+    coordinator endpoint on ITS host and shares it through the barrier's
+    ``allGather`` (the driver's hostname may not be routable from executors,
+    and the coordinator service lives in rank 0's process anyway)."""
+    if barrier_ctx is None:  # pragma: no cover - needs spark
+        from pyspark import BarrierTaskContext
 
-    ctx = BarrierTaskContext.get()
+        barrier_ctx = BarrierTaskContext.get()
+    ctx = barrier_ctx
     rank = ctx.partitionId()
-    world = ctx.getTaskInfos().__len__()
+    world = len(ctx.getTaskInfos())
+
+    if world > 1:
+        from .parallel.tracker import Tracker
+
+        endpoint = (Tracker(n_workers=world).worker_args()
+                    ["coordinator_address"] if rank == 0 else "")
+        coordinator = [e for e in ctx.allGather(endpoint) if e][0]
+    else:
+        coordinator = ""
 
     import pandas as pd
 
-    frames = list(iterator)
-    pdf = pd.concat(frames) if frames else pd.DataFrame()
-    X = (np.stack(pdf[features_col].values)
+    # df.rdd.mapPartitions feeds pyspark Row objects; the stub test feeds
+    # pandas DataFrames — normalise both to one frame
+    items = list(iterator)
+    if items and isinstance(items[0], pd.DataFrame):
+        pdf = pd.concat(items)
+    elif items:
+        pdf = pd.DataFrame([r.asDict() for r in items])
+    else:
+        pdf = pd.DataFrame()
+    X = (np.stack([np.asarray(v, np.float32)
+                   for v in pdf[features_col].values])
          if len(pdf) else np.empty((0, 0), np.float32))
     y = pdf[label_col].to_numpy(np.float32) if len(pdf) else None
     w = (pdf[weight_col].to_numpy(np.float32)
@@ -67,7 +89,8 @@ def _train_barrier_partition(iterator, params: Dict[str, Any],
                                     num_boost_round, weight_local=w)
     ctx.barrier()
     if rank == 0:
-        yield pd.DataFrame({"model": [bytes(bst.save_raw("json"))]})
+        # plain bytes element: RDD.collect() then hands fit() the raw model
+        yield bytes(bst.save_raw("json"))
 
 
 class _SparkXGBModel:
@@ -126,24 +149,17 @@ class _SparkXGBEstimator:
 
     def fit(self, dataset) -> _SparkXGBModel:
         _require_pyspark()
-        import socket
-
         from .core import Booster
 
-        with socket.socket() as s:  # coordinator on the driver's host
-            s.bind(("", 0))
-            port = s.getsockname()[1]
-        host = socket.gethostname()
-        coordinator = f"{host}:{port}"
         params = {"objective": self._objective, **self.params}
         df = dataset.repartition(self.num_workers)
         rows = (
             df.rdd.barrier()
             .mapPartitions(lambda it: _train_barrier_partition(
                 it, params, self.n_estimators, self.features_col,
-                self.label_col, self.weight_col, coordinator))
+                self.label_col, self.weight_col))
             .collect())
-        raw = rows[0]["model"] if rows else None
+        raw = rows[0] if rows else None
         if raw is None:
             raise RuntimeError("no partition returned a model")
         bst = Booster()

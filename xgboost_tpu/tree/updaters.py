@@ -121,5 +121,5 @@ def sync_trees(trees: List[TreeModel], communicator=None) -> List[TreeModel]:
 
     payload = json.dumps([t.to_json() for t in trees]) \
         if communicator.get_rank() == 0 else None
-    payload = communicator.broadcast_obj(payload, root=0)
+    payload = communicator.broadcast(payload, root=0)
     return [TreeModel.from_json(o) for o in json.loads(payload)]
