@@ -26,6 +26,11 @@ try:
     for _name in list(getattr(_xb, "_backend_factories", {})):
         if _name != "cpu":
             _xb._backend_factories.pop(_name, None)
+    # dropping the factory also removes "tpu" from known_platforms(), which
+    # breaks `import jax.experimental.pallas.tpu` (checkify registers a
+    # TPU lowering rule at import). A platform alias restores knowledge of
+    # the name without registering any backend.
+    _xb._platform_aliases.setdefault("tpu", "tpu")
 except Exception:  # pragma: no cover - defensive; tests then run on default
     pass
 
