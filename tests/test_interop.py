@@ -3,6 +3,7 @@ fixtures decode with exact decision semantics (x < cond left, in-set right),
 and our models round-trip through the reference schema bit-exactly."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -269,3 +270,149 @@ def test_export_ubjson_round_trip(trained, tmp_path):
     back = xgb.Booster(model_file=fname)
     np.testing.assert_allclose(back.predict(dm), bst.predict(dm),
                                rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Golden fixtures (VERDICT r1 item 7): hand-authored reference-schema models
+# (tests/fixtures/*.json — see fixtures/README.md for provenance) loaded by
+# the real loader and checked against an INDEPENDENT in-test implementation
+# of the reference's prediction semantics, plus hard-coded anchor values.
+
+_FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _ref_walk_margin(model, X):
+    """Reference prediction semantics, implemented from the reference source
+    (not from this repo's code): x < split_condition -> left
+    (tree_model.h:186); missing follows default_left; categorical goes right
+    iff the category is in the stored right-branch set (categorical.h:55,
+    Decision() == go-left when NOT in set); dart scales each tree by
+    weight_drop; base_score is user-space (learner.cc:395)."""
+    learner = model["learner"]
+    gb = learner["gradient_booster"]
+    weight_drop = None
+    if gb["name"] == "dart":
+        weight_drop = [float(w) for w in gb["weight_drop"]]
+        gb = gb["gbtree"]
+    if gb["name"] == "gblinear":
+        W = np.asarray(gb["model"]["weights"], np.float64)
+        margin = X @ W[:-1] + W[-1]
+        return margin
+    margin = np.zeros(len(X), np.float64)
+    for ti, tree in enumerate(gb["model"]["trees"]):
+        left = tree["left_children"]
+        right = tree["right_children"]
+        sidx = tree["split_indices"]
+        cond = tree["split_conditions"]
+        dleft = tree["default_left"]
+        stype = tree.get("split_type", [0] * len(left))
+        right_sets = {}
+        for node, seg, size in zip(tree.get("categories_nodes", []),
+                                   tree.get("categories_segments", []),
+                                   tree.get("categories_sizes", [])):
+            right_sets[node] = set(tree["categories"][seg:seg + size])
+        for i, row in enumerate(X):
+            nid = 0
+            while left[nid] != -1:
+                x = row[sidx[nid]]
+                if np.isnan(x):
+                    nid = left[nid] if dleft[nid] else right[nid]
+                elif stype[nid] == 1:
+                    in_set = (x >= 0 and int(x) in right_sets[nid])
+                    nid = right[nid] if in_set else left[nid]
+                else:
+                    nid = left[nid] if x < cond[nid] else right[nid]
+            w = weight_drop[ti] if weight_drop is not None else 1.0
+            margin[i] += w * cond[nid]
+    return margin
+
+
+def _load_fixture(name):
+    with open(os.path.join(_FIXDIR, name)) as fh:
+        return json.load(fh)
+
+
+def _fixture_case(name, X):
+    model = _load_fixture(name)
+    base_user = float(
+        model["learner"]["learner_model_param"]["base_score"])
+    obj_name = model["learner"]["objective"]["name"]
+    margin = _ref_walk_margin(model, X)
+    if obj_name == "binary:logistic":
+        margin = margin + np.log(base_user / (1.0 - base_user))
+        expected = 1.0 / (1.0 + np.exp(-margin))
+    else:
+        expected = margin + base_user
+    bst = xgb.Booster()
+    bst.load_model(os.path.join(_FIXDIR, name))
+    got = bst.predict(xgb.DMatrix(np.asarray(X, np.float32)))
+    np.testing.assert_allclose(np.asarray(got, np.float64), expected,
+                               rtol=1e-6, atol=1e-6)
+    return np.asarray(got, np.float64)
+
+
+def test_golden_gbtree_squarederror():
+    X = np.asarray([[-1.0, 0.0], [1.0, 2.0], [np.nan, 1.0],
+                    [0.0, np.nan], [2.5, -3.0]], np.float32)
+    got = _fixture_case("gbtree_squarederror.json", X)
+    # hand-computed anchors: row0 f0=-1<0 -> -0.4; f1=0<1 -> +0.1; +0.5 base
+    assert got[0] == pytest.approx(0.2, abs=1e-6)
+    # row1: f0=1>=0 -> +0.6; f1=2>=1 -> -0.2; +0.5
+    assert got[1] == pytest.approx(0.9, abs=1e-6)
+    # row2: f0 missing, default_left -> -0.4; f1=1>=1 -> -0.2; +0.5
+    assert got[2] == pytest.approx(-0.1, abs=1e-6)
+    # row3: f0=0>=0 -> +0.6; f1 missing, default right -> -0.2; +0.5
+    assert got[3] == pytest.approx(0.9, abs=1e-6)
+
+
+def test_golden_gbtree_logistic():
+    X = np.asarray([[0.0, -2.0], [0.0, 0.0], [1.0, 5.0],
+                    [np.nan, -1.5]], np.float32)
+    got = _fixture_case("gbtree_logistic.json", X)
+    # row0: f0=0<0.5 -> node1; f1=-2<-1 -> leaf -0.3; sigmoid(-0.3)
+    assert got[0] == pytest.approx(1 / (1 + np.exp(0.3)), abs=1e-6)
+    # row2: f0=1>=0.5 -> leaf 0.55
+    assert got[2] == pytest.approx(1 / (1 + np.exp(-0.55)), abs=1e-6)
+
+
+def test_golden_dart_weight_drop():
+    X = np.asarray([[-1.0, 0.0], [1.0, 3.0]], np.float32)
+    got = _fixture_case("dart_squarederror.json", X)
+    # row0: 0.7*(-1.0) + 0.3*(0.5) = -0.55; base 0
+    assert got[0] == pytest.approx(-0.55, abs=1e-6)
+    # row1: 0.7*(1.0) + 0.3*(-0.5) = 0.55
+    assert got[1] == pytest.approx(0.55, abs=1e-6)
+
+
+def test_golden_categorical_right_set():
+    # right-branch category set {1, 3}: cats 1,3 -> +0.75; 0,2 -> -0.25
+    X = np.asarray([[0.0, 9.9], [1.0, 9.9], [2.0, 9.9], [3.0, 9.9],
+                    [np.nan, 9.9]], np.float32)
+    got = _fixture_case("gbtree_categorical.json", X)
+    np.testing.assert_allclose(
+        got, [0.25, 1.25, 0.25, 1.25, 1.25], atol=1e-6)
+    # missing -> default_left=0 -> right leaf (+0.75 + 0.5)
+
+
+def test_golden_gblinear():
+    X = np.asarray([[1.0, 2.0], [0.0, 0.0], [-3.0, 0.5]], np.float32)
+    got = _fixture_case("gblinear_squarederror.json", X)
+    # 0.3*x0 - 0.7*x1 + 0.05 bias + 0.5 base
+    np.testing.assert_allclose(
+        got, [0.3 * 1 - 0.7 * 2 + 0.55, 0.55, 0.3 * -3 - 0.7 * 0.5 + 0.55],
+        rtol=1e-6)
+
+
+def test_golden_fixtures_validate_against_reference_schema():
+    schema_path = "/root/reference/doc/model.schema"
+    if not os.path.exists(schema_path):
+        pytest.skip("reference schema not mounted")
+    jsonschema = pytest.importorskip("jsonschema")
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    import glob
+    names = sorted(os.path.basename(p)
+                   for p in glob.glob(os.path.join(_FIXDIR, "*.json")))
+    assert len(names) >= 5
+    for name in names:
+        jsonschema.validate(_load_fixture(name), schema)

@@ -47,7 +47,10 @@ TOL = {
 }
 
 
-@pytest.mark.parametrize("precision", ["f32", "int8x2", "bf16x2"])
+# bf16x2 is exercised only on the real chip (BENCH_TPU=1): XLA:CPU emulates
+# bf16 dots with bf16 accumulation, so CPU equivalence would need a
+# meaninglessly loose tolerance (see ops/pallas/histogram.py docstring)
+@pytest.mark.parametrize("precision", ["f32", "int8x2"])
 @pytest.mark.parametrize("max_nbins,n_nodes", [(16, 1), (16, 64), (256, 4)])
 def test_pallas_interpret_matches_segment(precision, max_nbins, n_nodes):
     n, F = 1000, 5  # ragged: not a multiple of the 128-row tile

@@ -33,17 +33,12 @@ PHASES = set(os.environ.get("PROFILE_PHASES", "hist,eval,adv,grad,full")
              .split(","))
 
 
-def bench(fn, label, reps=REPS):
-    """fn: jitted nullary returning a scalar; best-of-2 ms per rep."""
-    t0 = time.perf_counter()
-    float(fn())  # compile + warm
-    compile_s = time.perf_counter() - t0
-    best = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
-        float(fn())
-        best = min(best, time.perf_counter() - t0)
-    ms = best / reps * 1e3
+from benchlib import slope_bench  # noqa: E402
+
+
+def bench(body, label, *args):
+    """body(i, acc, *args) -> array; slope-measured (see benchlib)."""
+    ms, compile_s = slope_bench(body, *args, reps_lo=REPS)
     print(f"  {label}: {ms:8.2f} ms/round-equivalent "
           f"(compile {compile_s:.0f}s)", flush=True)
     return ms
@@ -82,65 +77,74 @@ def main():
     row_iota = jnp.arange(ROWS, dtype=jnp.int32)
 
     # ---- phase: histogram, all 6 levels per rep (arrays passed as args —
-    # a closed-over plane would be captured as a 7GB program constant)
-    @jax.jit
-    def hist_phase(oh, gpr, iota):
-        def body(i, acc):
-            gp = gpr * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
-            for d in range(DEPTH):
-                h = build_hist_prehot(oh, gp, iota % (2 ** d),
-                                      2 ** d, max_nbins)
-                acc = acc + jnp.sum(h).astype(jnp.float32)
-            return acc
-        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+    # a closed-over plane would be captured as a 7GB program constant).
+    # "hist" measures the production auto path (Pallas int8x2 via
+    # build_hist); "prehot" measures the opt-in plane kernel.
+    def hist_body(i, acc, bt, gpr, iota):
+        gp = gpr * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
+        g = jnp.float32(0.0)
+        for d in range(DEPTH):
+            h = build_hist(bt.T, gp, iota % (2 ** d), 2 ** d, max_nbins,
+                           method="auto", bins_t=bt)
+            g = g + jnp.sum(h).astype(jnp.float32)
+        return g
 
-    ms_hist = bench(lambda: hist_phase(oh_pre, gpair, row_iota),
-                    "hist prehot (6 levels)") if "hist" in PHASES else 0.0
+    def prehot_body(i, acc, oh, gpr, iota):
+        gp = gpr * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
+        g = jnp.float32(0.0)
+        for d in range(DEPTH):
+            h = build_hist_prehot(oh, gp, iota % (2 ** d),
+                                  2 ** d, max_nbins)
+            g = g + jnp.sum(h).astype(jnp.float32)
+        return g
 
-    # ---- phase: split evaluation, all 6 levels per rep
-    hist32 = jax.jit(lambda: build_hist_prehot(
-        oh_pre, gpair, row_iota % 32, 32, max_nbins))()
+    ms_hist = (bench(hist_body, "hist auto/pallas (6 levels)",
+                     bins_t, gpair, row_iota)
+               if "hist" in PHASES else 0.0)
+    if "prehot" in PHASES:
+        bench(prehot_body, "hist prehot (6 levels)", oh_pre, gpair, row_iota)
+
+    # ---- phase: split evaluation, all 6 levels per rep (args, not
+    # closures: a closed-over plane becomes a 7GB program constant)
+    hist32 = jax.jit(lambda oh, gp, it: build_hist_prehot(
+        oh, gp, it % 32, 32, max_nbins))(oh_pre, gpair, row_iota)
     fmask = jnp.ones((1, COLS), bool)
 
-    @jax.jit
-    def eval_phase(h32):
-        def body(i, acc):
-            pert = 1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30
-            for d in range(DEPTH):
-                h = h32[: 2 ** d] * pert
-                ps = jnp.sum(h, axis=(1, 2)) / COLS
-                r = evaluate_splits(h, ps, n_real, param,
-                                    feature_mask=fmask, has_missing=True)
-                acc = acc + jnp.sum(r.gain).astype(jnp.float32)
-            return acc
-        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+    def eval_body(i, acc, h32):
+        pert = 1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30
+        g = jnp.float32(0.0)
+        for d in range(DEPTH):
+            h = h32[: 2 ** d] * pert
+            ps = jnp.sum(h, axis=(1, 2)) / COLS
+            r = evaluate_splits(h, ps, n_real, param,
+                                feature_mask=fmask, has_missing=True)
+            g = g + jnp.sum(r.gain).astype(jnp.float32)
+        return g
 
-    ms_eval = (bench(lambda: eval_phase(hist32), "split eval (6 levels)")
+    ms_eval = (bench(eval_body, "split eval (6 levels)", hist32)
                if "eval" in PHASES else 0.0)
 
     # ---- phase: position advance, all 6 levels per rep
     bins_f32 = bins.astype(jnp.float32)
 
-    @jax.jit
-    def adv_phase(bf32, iota):
-        def body(i, acc):
-            bump = (acc > 1e30).astype(jnp.int32) + 0 * i
-            for d in range(DEPTH):
-                nl = 2 ** d
-                rel = iota % nl
-                pos = (nl - 1) + rel + bump
-                feats = jnp.arange(nl, dtype=jnp.int32) % COLS
-                sbins = jnp.full((nl,), 100, jnp.int32)
-                p = advance_positions_level(
-                    bf32, pos, rel, feats, sbins,
-                    jnp.zeros((nl,), bool), jnp.ones((nl,), bool),
-                    max_nbins - 1)
-                acc = acc + jnp.sum(p).astype(jnp.float32) * 1e-9
-            return acc
-        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+    def adv_body(i, acc, bf32, iota):
+        bump = jnp.minimum(i, 0) + (acc > 1e30).astype(jnp.int32)
+        g = jnp.float32(0.0)
+        for d in range(DEPTH):
+            nl = 2 ** d
+            rel = iota % nl
+            pos = (nl - 1) + rel + bump
+            feats = jnp.arange(nl, dtype=jnp.int32) % COLS
+            sbins = jnp.full((nl,), 100, jnp.int32)
+            p = advance_positions_level(
+                bf32, pos, rel, feats, sbins,
+                jnp.zeros((nl,), bool), jnp.ones((nl,), bool),
+                max_nbins - 1)
+            g = g + jnp.sum(p).astype(jnp.float32) * 1e-9
+        return g
 
-    ms_adv = (bench(lambda: adv_phase(bins_f32, row_iota),
-                    "advance positions (6 levels)")
+    ms_adv = (bench(adv_body, "advance positions (6 levels)",
+                    bins_f32, row_iota)
               if "adv" in PHASES else 0.0)
 
     # ---- phase: gradient
@@ -150,18 +154,14 @@ def main():
     sinfo = types.SimpleNamespace(labels=jnp.asarray(y), weights=None)
     margin0 = jnp.zeros((ROWS, 1), jnp.float32)
 
-    @jax.jit
-    def grad_phase(m0, lab):
+    def grad_body(i, acc, m0, lab):
         import types as _t
         si = _t.SimpleNamespace(labels=lab, weights=None)
-        def body(i, acc):
-            m = m0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30
-            gp = obj.get_gradient(m, si, 0)
-            return acc + jnp.sum(gp).astype(jnp.float32)
-        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0.0))
+        m = m0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30
+        return obj.get_gradient(m, si, 0)
 
-    ms_grad = (bench(lambda: grad_phase(margin0, sinfo.labels),
-                     "gradient (binary:logistic)")
+    ms_grad = (bench(grad_body, "gradient (binary:logistic)",
+                     margin0, sinfo.labels)
                if "grad" in PHASES else 0.0)
 
     # ---- full fused round, amortised over 10 rounds

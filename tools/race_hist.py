@@ -1,0 +1,59 @@
+"""Race prehot vs pallas histogram kernels per level on the real chip."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+COLS, MAX_NBINS, REPS = 28, 256, 5
+
+
+def bench(fn, *args):
+    from benchlib import slope_bench
+
+    ms, _ = slope_bench(fn, *args, reps_lo=REPS)
+    return ms
+
+
+def main():
+    from xgboost_tpu.ops.histogram import (build_hist_prehot,
+                                           build_onehot_plane)
+    from xgboost_tpu.ops.pallas.histogram import build_hist_pallas
+
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, MAX_NBINS, (ROWS, COLS)).astype(
+        np.uint8))
+    bins_t = bins.T
+    gpair = jnp.asarray(rng.randn(ROWS, 2).astype(np.float32))
+    iota = jnp.arange(ROWS, dtype=jnp.int32)
+    oh_pre = jax.jit(
+        lambda bt: build_onehot_plane(bt, MAX_NBINS))(bins_t)
+    jax.block_until_ready(oh_pre)
+
+    for depth in range(6):
+        N = 2 ** depth
+
+        def pre(i, acc, oh, gp, it, nl=N):
+            g = gp * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
+            return build_hist_prehot(oh, g, it % nl, nl, MAX_NBINS)
+
+        def pal(i, acc, bt, gp, it, nl=N):
+            g = gp * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
+            return build_hist_pallas(bt, g, it % nl, nl, MAX_NBINS,
+                                     precision="int8x2")
+
+        t_pre = bench(pre, oh_pre, gpair, iota)
+        t_pal = bench(pal, bins_t, gpair, iota)
+        print(f"N={N:3d}: prehot {t_pre:7.2f} ms   pallas {t_pal:7.2f} ms",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,9 +73,16 @@ class Context(Parameter):
         return self._mesh.shape.get(DATA_AXIS, 1)
 
     # --- rng ----------------------------------------------------------------
-    def make_key(self, iteration: int = 0) -> jax.Array:
+    def raw_seed(self, iteration: int = 0) -> np.uint32:
+        """The uint32 key seed for ``iteration`` — the single source of
+        truth shared by ``make_key`` and the fused round's in-jit
+        derivation (they must never diverge: fused and general paths
+        produce identical models by construction)."""
         seed = self.seed + iteration if self.seed_per_iteration else self.seed
-        return jax.random.key(np.uint32(seed & 0xFFFFFFFF))
+        return np.uint32(seed & 0xFFFFFFFF)
+
+    def make_key(self, iteration: int = 0) -> jax.Array:
+        return jax.random.key(self.raw_seed(iteration))
 
 
 def make_data_mesh(n_devices: Optional[int] = None,

@@ -58,19 +58,27 @@ import functools as _functools
     jax.jit,
     static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
                      "hist_method", "has_missing"))
-def _fused_round_fn(bins, margin, labels, weights, n_real, key,
+def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
                     monotone, constraint_sets, cat, *,
                     obj_cls, obj_params, param, max_nbins, hist_method,
                     has_missing):
     """One boosting round (gradient -> grow -> margin update) as a single
     compiled program. Module-level so the compile cache is shared across
     Booster instances; PRNG key folding replicates ``do_boost`` exactly so
-    fused and general paths produce identical models."""
+    fused and general paths produce identical models.
+
+    ``seed``/``iteration`` arrive as traced scalars and the key is derived
+    INSIDE the program: deriving it eagerly cost two extra device dispatches
+    per round, which is material against a remote TPU (the tunnel adds tens
+    of ms of enqueue latency per eager op)."""
     import types
 
     from .tree.grow import _grow, _sample_features
 
     from .boosting.gbtree import sample_gradients
+
+    # identical stream to the general path: fold_in(make_key(it), it)
+    key = jax.random.fold_in(jax.random.key(seed), iteration)
 
     obj = obj_cls(dict(obj_params))
     sinfo = types.SimpleNamespace(labels=labels, weights=weights)
@@ -551,10 +559,10 @@ class Booster:
                 else jnp.asarray(info.weights, jnp.float32),
                 binned.n_real_bins())
         _, obj_params, grower, labels, weights, n_real = self._fused_round
-        key = jax.random.fold_in(self.ctx.make_key(iteration), iteration)
         try:
             new_margin, grown = _fused_round_fn(
-                binned.bins, state["margin"], labels, weights, n_real, key,
+                binned.bins, state["margin"], labels, weights, n_real,
+                self.ctx.raw_seed(iteration), np.int32(iteration),
                 grower.monotone, grower.constraint_sets, grower.cat,
                 obj_cls=type(self.obj), obj_params=obj_params,
                 param=grower.param, max_nbins=grower.max_nbins,

@@ -57,6 +57,19 @@ def _convert_tree(t: Dict[str, Any]) -> Dict[str, Any]:
     # reference: x < cond -> left; ours: x <= value -> left
     adj = np.where(is_leaf, conds,
                    np.nextafter(conds.astype(np.float32), np.float32("-inf")))
+    # XLA flushes f32 subnormals to zero on EVERY backend (verified on
+    # XLA:CPU too: jnp evaluates 0.0 <= -1.4e-45 as True): a nudged
+    # threshold from cond <= 0 that lands in the subnormal range
+    # (cond = 0.0 is common) would compare as 0.0 and route x == 0 rows
+    # LEFT, flipping the reference decision. Clamp such thresholds to the
+    # largest normal float below zero — exact for every flushed input.
+    # Known divergence: subnormal-magnitude inputs (|x| < 1.18e-38), which
+    # the reference's non-flushing C++ routes by true sign, are flushed
+    # here; unavoidable on flush-to-zero hardware.
+    tiny = np.float32(np.finfo(np.float32).tiny)
+    subnormal_neg = (~is_leaf) & (conds <= 0) \
+        & (adj.astype(np.float32) >= -tiny)
+    adj = np.where(subnormal_neg, np.float64(-tiny), adj)
     split_type = [int(x) for x in t.get("split_type", [0] * n)]
 
     cats: Dict[str, List[int]] = {}

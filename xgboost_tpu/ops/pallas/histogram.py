@@ -135,21 +135,21 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
 
         g_hi, g_lo = planes(q_ref[0:1, :])
         h_hi, h_lo = planes(q_ref[1:2, :])
-        PT_hi = jnp.concatenate([g_hi, h_hi], axis=0)      # [2N, R] i8
-        PT_lo = jnp.concatenate([g_lo, h_lo], axis=0)
+        # hi/lo byte planes as extra COLUMNS of one [4N, R] RHS: a single
+        # MXU pass over the one-hot instead of two (same trick as
+        # build_hist_prehot — the one-hot operand feed dominates)
+        PT4 = jnp.concatenate([g_hi, h_hi, g_lo, h_lo], axis=0)  # [4N, R] i8
 
         bin_iota = jax.lax.broadcasted_iota(jnp.int32, (B, R), 0)
         for f in range(Fb):
             row = bins_ref[f:f + 1, :].astype(jnp.int32)   # [1, R]
             oh_scratch[f * B:(f + 1) * B, :] = (
                 bin_iota == row).astype(jnp.int8)
-        acc_hi = jax.lax.dot_general(
-            oh_scratch[:], PT_hi, _CONTRACT_LAST,
-            preferred_element_type=jnp.int32)
-        acc_lo = jax.lax.dot_general(
-            oh_scratch[:], PT_lo, _CONTRACT_LAST,
-            preferred_element_type=jnp.int32)
-        acc = acc_hi.astype(jnp.float32) * 256.0 + acc_lo.astype(jnp.float32)
+        acc4 = jax.lax.dot_general(
+            oh_scratch[:], PT4, _CONTRACT_LAST,
+            preferred_element_type=jnp.int32)              # [Fb*B, 4N]
+        acc = (acc4[:, : 2 * N].astype(jnp.float32) * 256.0
+               + acc4[:, 2 * N:].astype(jnp.float32))
         out_ref[:] += acc.reshape(Fb, B, 2 * N)
 
     return kernel
@@ -158,17 +158,21 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
 @functools.partial(
     jax.jit,
     static_argnames=("n_nodes", "max_nbins", "precision", "block_rows",
-                     "feat_block", "interpret"))
+                     "feat_block", "interpret", "axis_name"))
 def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
                       rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
                       precision: str = "int8x2", block_rows: int = 2048,
                       feat_block: int = 8,
-                      interpret: bool = False) -> jnp.ndarray:
+                      interpret: bool = False,
+                      axis_name=None) -> jnp.ndarray:
     """Fused histogram kernel.
 
     bins_t: [F, n] local bin ids (any int dtype), missing at max_nbins - 1
     gpair: [n, 2] f32
     rel_pos: [n] int32 in [0, n_nodes]; n_nodes means "inactive row"
+    axis_name: mesh axis carrying row shards — the int8x2 quantisation
+        scale is pmax'd over it so every shard quantises identically and
+        N-chip histograms reproduce the 1-chip run bit-for-bit
     -> [n_nodes, F, max_nbins, 2] f32
     """
     F, n = bins_t.shape
@@ -205,10 +209,10 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
 
     if precision == "int8x2":
         # 15-bit fixed-point with a global per-component scale (reference
-        # GradientQuantiser, src/tree/gpu_hist/histogram.cu:55-100). The
-        # scale is computed on device; in distributed use the caller must
-        # psum-max it so all shards quantise identically.
+        # GradientQuantiser, src/tree/gpu_hist/histogram.cu:55-100)
         max_abs = jnp.max(jnp.abs(gpair_t), axis=1)      # [2]
+        if axis_name is not None:
+            max_abs = jax.lax.pmax(max_abs, axis_name)   # global scale
         scale = 32512.0 / jnp.maximum(max_abs, 1e-30)    # headroom vs 32767
         q = jnp.round(gpair_t * scale[:, None]).astype(jnp.int32)
         out = pl.pallas_call(

@@ -172,17 +172,15 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     prev_hist = None
     built_is_left = None
 
-    # Pre-materialised one-hot plane (ops/histogram.py
-    # build_onehot_plane): bins are loop-invariant, so one [F*B, n] int8
-    # plane in HBM turns every level's histogram into a single int8 MXU
-    # contraction instead of a per-level VMEM one-hot rebuild. Auto on TPU
-    # when the plane fits the HBM budget; int32 accumulation stays exact
-    # while n * 128 < 2^31.
+    # Pre-materialised one-hot plane (ops/histogram.py build_onehot_plane):
+    # one [F*B, n] int8 plane in HBM turns every level's histogram into a
+    # single int8 MXU contraction. EXPLICIT opt-in only since round 2: with
+    # the hi/lo byte planes fused into one [4N]-column matmul the Pallas
+    # kernel (VMEM one-hot, ~28 MB/level HBM traffic) measures faster at
+    # every level width (8.3 ms flat vs 9.7-37 ms at 1M x 28 x 256 on v5e)
+    # and costs no plane memory, so "auto" routes to it via build_hist.
     use_prehot = (not use_compaction and n * 128 < 2 ** 31
-                  and (hist_kernel == "prehot"
-                       or (hist_kernel == "auto"
-                           and jax.default_backend() == "tpu"
-                           and n * F * max_nbins <= 8_000_000_000)))
+                  and hist_kernel == "prehot")
     oh_pre = (build_onehot_plane(bins_t, max_nbins) if use_prehot else None)
 
     for depth in range(max_depth):
@@ -198,8 +196,13 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                     oh_pre, gpair, rel, n_level, max_nbins,
                     axis_name=axis_name if not col_split else None)
             else:
-                hist = build_hist(bins, gpair, rel, n_level, max_nbins,
-                                  method=hist_kernel, bins_t=bins_t)
+                hist = build_hist(
+                    bins, gpair, rel, n_level, max_nbins,
+                    method=hist_kernel, bins_t=bins_t,
+                    # int8x2 quantisation scale must be pmax'd across row
+                    # shards so every shard quantises identically (col
+                    # split replicates rows — local scale is already global)
+                    axis_name=axis_name if not col_split else None)
             hist = allreduce(hist)
         else:
             n_parents = n_level // 2
