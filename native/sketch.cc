@@ -241,11 +241,80 @@ int32_t xtpu_has_nan(const float* X, int64_t count) {
 // mapping done in BinnedMatrix.from_dense): local bin = lower_bound of the
 // feature's cuts, clamped into the last real bin; NaN -> missing_bin.
 // out_dtype: 0 = uint8, 1 = uint16, 2 = int32.
+
+#if defined(__AVX512F__)
+#include <immintrin.h>
+
+// 16 rows of one feature at a time: every lane binary-searches the SAME cut
+// array (same trip count), probes gathered per step. ~6x the scalar
+// branchless loop on one core (the scalar chain is latency-bound).
+static void SearchBinBlock16U8(const float* X, int64_t r0, int64_t nf,
+                               const float* cut_values,
+                               const int32_t* cut_ptrs, int32_t missing_bin,
+                               uint8_t* out) {
+  alignas(64) int32_t tmp[16];
+  const __m512i lane = _mm512_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+                                         11, 12, 13, 14, 15);
+  const __m512i stride = _mm512_mullo_epi32(
+      lane, _mm512_set1_epi32(static_cast<int32_t>(nf)));
+  for (int64_t f = 0; f < nf; ++f) {
+    const int32_t lo = cut_ptrs[f];
+    const int32_t len = cut_ptrs[f + 1] - lo;
+    const float* cuts = cut_values + lo;
+    const __m512 v = _mm512_i32gather_ps(stride, X + r0 * nf + f, 4);
+    const __mmask16 nan = _mm512_cmp_ps_mask(v, v, _CMP_UNORD_Q);
+    if (len <= 0) {  // empty cut range: match the scalar path (b = -1,
+                     // i.e. clamp of 0 into len-1), NaN -> missing_bin;
+                     // and never gather from the empty cut array
+      uint8_t* o = out + r0 * nf + f;
+      alignas(64) int32_t nm[16];
+      _mm512_store_si512(reinterpret_cast<__m512i*>(nm),
+                         _mm512_mask_mov_epi32(
+                             _mm512_set1_epi32(-1), nan,
+                             _mm512_set1_epi32(missing_bin)));
+      for (int i = 0; i < 16; ++i) o[i * nf] = static_cast<uint8_t>(nm[i]);
+      continue;
+    }
+    __m512i b = _mm512_setzero_si512();
+    int32_t m = len;
+    while (m > 1) {
+      const int32_t half = m / 2;
+      const __m512i probe =
+          _mm512_add_epi32(b, _mm512_set1_epi32(half - 1));
+      const __m512 c = _mm512_i32gather_ps(probe, cuts, 4);
+      const __mmask16 lt = _mm512_cmp_ps_mask(c, v, _CMP_LT_OQ);
+      b = _mm512_mask_add_epi32(b, lt, b, _mm512_set1_epi32(half));
+      m -= half;
+    }
+    const __m512 cb = _mm512_i32gather_ps(b, cuts, 4);
+    const __mmask16 inc = _mm512_cmp_ps_mask(cb, v, _CMP_LT_OQ);
+    b = _mm512_mask_add_epi32(b, inc, b, _mm512_set1_epi32(1));
+    b = _mm512_min_epi32(b, _mm512_set1_epi32(len - 1));
+    b = _mm512_mask_mov_epi32(b, nan, _mm512_set1_epi32(missing_bin));
+    _mm512_store_si512(reinterpret_cast<__m512i*>(tmp), b);
+    uint8_t* o = out + r0 * nf + f;
+    for (int i = 0; i < 16; ++i) o[i * nf] = static_cast<uint8_t>(tmp[i]);
+  }
+}
+#endif  // __AVX512F__
+
 void xtpu_search_bin(const float* X, int64_t n, int64_t nf,
                      const float* cut_values, const int32_t* cut_ptrs,
                      int32_t missing_bin, int32_t out_dtype, void* out) {
+  int64_t r_start = 0;
+#if defined(__AVX512F__)
+  if (out_dtype == 0 && nf > 0) {
+    const int64_t blocks = n / 16;
 #pragma omp parallel for schedule(static)
-  for (int64_t r = 0; r < n; ++r) {
+    for (int64_t blk = 0; blk < blocks; ++blk) {
+      SearchBinBlock16U8(X, blk * 16, nf, cut_values, cut_ptrs, missing_bin,
+                         static_cast<uint8_t*>(out));
+    }
+    r_start = blocks * 16;  // ragged tail falls through to the scalar loop
+  }
+#endif
+#pragma omp parallel for schedule(static)
+  for (int64_t r = r_start; r < n; ++r) {
     const float* row = X + r * nf;
     for (int64_t f = 0; f < nf; ++f) {
       const int32_t lo = cut_ptrs[f];

@@ -38,15 +38,17 @@ def _build(lib_path: str) -> None:
     # processes never dlopen a half-written library.
     srcs = _sources()
     tmp = f"{lib_path}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
-           "-o", tmp] + srcs
-    try:
-        subprocess.run(cmd, check=True, capture_output=True)
-    except subprocess.CalledProcessError:
-        # retry without OpenMP (toolchains without libgomp)
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-               "-o", tmp] + srcs
-        subprocess.run(cmd, check=True, capture_output=True)
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp] + srcs
+    # -march=native unlocks the AVX-512 binning sweep in sketch.cc; fall
+    # back progressively for toolchains/CPUs that reject it or lack libgomp
+    for extra in (["-march=native", "-fopenmp"], ["-fopenmp"],
+                  ["-march=native"], []):
+        try:
+            subprocess.run(base + extra, check=True, capture_output=True)
+            break
+        except subprocess.CalledProcessError:
+            if not extra:
+                raise
     os.replace(tmp, lib_path)
 
 

@@ -199,7 +199,10 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                 block_rows=min(block_rows, max(bins.shape[0], 8)))
         oh = build_onehot_plane(bins_t if bins_t is not None else bins.T,
                                 max_nbins)
-        return build_hist_prehot(oh, gpair, rel_pos, n_nodes, max_nbins)
+        # the onehot fallback above needs no axis sync (exact f32, no
+        # quantisation scale); prehot's int8x2 scale must be global
+        return build_hist_prehot(oh, gpair, rel_pos, n_nodes, max_nbins,
+                                 axis_name=axis_name)
     if method == "segment":
         return build_hist_segment(bins, gpair, rel_pos, n_nodes, max_nbins)
     if method == "onehot":
