@@ -1,0 +1,471 @@
+// Minimal C scoring ABI (docs/c_abi.md): the load-model/predict subset of
+// the reference's 94-function C API (include/xgboost/c_api.h:1080-1185),
+// implemented natively so non-Python processes (R, JVM, plain C) can score
+// models through dlopen with no Python and no accelerator. Accepts both the
+// reference JSON schema (doc/model.schema: x < split_condition goes left,
+// leaves ride in split_conditions, right-branch category sets) and this
+// framework's native Booster JSON (x <= split_value goes left, left-set
+// category bitmasks). Training stays behind the Python ABI by design — see
+// the decision note in docs/c_abi.md.
+//
+// Error contract mirrors the reference: every entry point returns 0/-1 and
+// XGBGetLastError() returns the last failure message for this thread.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <limits>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+// ----------------------------------------------------------------- JSON ---
+// A deliberately tiny recursive-descent parser: objects, arrays, strings,
+// doubles, true/false/null. Enough for model artifacts; not a general lib.
+struct JValue {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0.0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  const JValue* get(const std::string& key) const {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+  double as_num() const {
+    if (kind == kStr) return std::stod(str);
+    if (kind == kBool) return b ? 1.0 : 0.0;  // e.g. default_left booleans
+    return num;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(const char* s) {
+    size_t n = std::strlen(s);
+    if (static_cast<size_t>(end - p) < n || std::memcmp(p, s, n) != 0)
+      return false;
+    p += n;
+    return true;
+  }
+  JValue parse() {
+    skip();
+    if (p >= end) throw std::runtime_error("json: unexpected end");
+    JValue v;
+    const char c = *p;
+    if (c == '{') {
+      ++p;
+      v.kind = JValue::kObj;
+      skip();
+      if (p < end && *p == '}') { ++p; return v; }
+      while (true) {
+        skip();
+        JValue key = parse_string();
+        skip();
+        if (p >= end || *p != ':') throw std::runtime_error("json: ':'");
+        ++p;
+        v.obj.emplace(key.str, parse());
+        skip();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == '}') { ++p; break; }
+        throw std::runtime_error("json: '}'");
+      }
+    } else if (c == '[') {
+      ++p;
+      v.kind = JValue::kArr;
+      skip();
+      if (p < end && *p == ']') { ++p; return v; }
+      while (true) {
+        v.arr.push_back(parse());
+        skip();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == ']') { ++p; break; }
+        throw std::runtime_error("json: ']'");
+      }
+    } else if (c == '"') {
+      v = parse_string();
+    } else if (lit("true")) {
+      v.kind = JValue::kBool; v.b = true;
+    } else if (lit("false")) {
+      v.kind = JValue::kBool; v.b = false;
+    } else if (lit("null")) {
+      v.kind = JValue::kNull;
+    } else {
+      v.kind = JValue::kNum;
+      char* out = nullptr;
+      v.num = std::strtod(p, &out);
+      if (out == p) throw std::runtime_error("json: bad number");
+      p = out;
+    }
+    return v;
+  }
+  JValue parse_string() {
+    if (p >= end || *p != '"') throw std::runtime_error("json: '\"'");
+    ++p;
+    JValue v;
+    v.kind = JValue::kStr;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': v.str += '\n'; break;
+          case 't': v.str += '\t'; break;
+          case 'r': v.str += '\r'; break;
+          case 'b': v.str += '\b'; break;
+          case 'f': v.str += '\f'; break;
+          case 'u': {  // BMP only; fine for model keys
+            if (end - p < 5) throw std::runtime_error("json: \\u");
+            unsigned code = std::stoul(std::string(p + 1, p + 5), nullptr, 16);
+            if (code < 0x80) {
+              v.str += static_cast<char>(code);
+            } else if (code < 0x800) {
+              v.str += static_cast<char>(0xC0 | (code >> 6));
+              v.str += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              v.str += static_cast<char>(0xE0 | (code >> 12));
+              v.str += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              v.str += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            p += 4;
+            break;
+          }
+          default: v.str += *p;
+        }
+      } else {
+        v.str += *p;
+      }
+      ++p;
+    }
+    if (p >= end) throw std::runtime_error("json: unterminated string");
+    ++p;
+    return v;
+  }
+};
+
+// ----------------------------------------------------------------- model ---
+struct Tree {
+  std::vector<int32_t> left, right, feat;
+  std::vector<float> cond;       // threshold, or leaf value on leaves
+  std::vector<uint8_t> dleft, is_cat;
+  // category set per cat node; semantics flag below says which side it names
+  std::map<int32_t, std::vector<int32_t>> cats;
+};
+
+struct Model {
+  std::vector<Tree> trees;
+  std::vector<int32_t> tree_info;
+  std::vector<double> tree_weight;  // dart weight_drop; 1.0 otherwise
+  std::vector<double> base_margin;  // margin space, one per group
+  int n_groups = 1;
+  int num_feature = 0;
+  bool ref_semantics = false;  // true: x < cond left + RIGHT cat sets
+  std::string objective;
+
+  double walk(const Tree& t, const float* row) const {
+    int32_t nid = 0;
+    while (t.left[nid] >= 0) {
+      const float x = row[t.feat[nid]];
+      bool go_right;
+      if (std::isnan(x)) {
+        go_right = !t.dleft[nid];
+      } else if (t.is_cat[nid]) {
+        const auto it = t.cats.find(nid);
+        bool in_set = false;
+        if (it != t.cats.end() && x >= 0) {
+          const int32_t c = static_cast<int32_t>(x);
+          for (int32_t m : it->second) {
+            if (m == c) { in_set = true; break; }
+          }
+        }
+        // reference stores the RIGHT-branch set; native stores the LEFT set
+        go_right = ref_semantics ? in_set : !in_set;
+      } else {
+        go_right = ref_semantics ? !(x < t.cond[nid]) : (x > t.cond[nid]);
+      }
+      nid = go_right ? t.right[nid] : t.left[nid];
+    }
+    return t.cond[nid];
+  }
+
+  void predict_row(const float* row, double* out_margin) const {
+    for (int g = 0; g < n_groups; ++g) out_margin[g] = base_margin[g];
+    for (size_t i = 0; i < trees.size(); ++i) {
+      out_margin[tree_info[i]] += tree_weight[i] * walk(trees[i], row);
+    }
+  }
+
+  void transform(double* m) const {
+    if (objective == "binary:logistic" || objective == "reg:logistic") {
+      m[0] = 1.0 / (1.0 + std::exp(-m[0]));
+    } else if (objective == "multi:softprob" && n_groups > 1) {
+      double mx = m[0];
+      for (int g = 1; g < n_groups; ++g) mx = std::max(mx, m[g]);
+      double s = 0.0;
+      for (int g = 0; g < n_groups; ++g) { m[g] = std::exp(m[g] - mx); s += m[g]; }
+      for (int g = 0; g < n_groups; ++g) m[g] /= s;
+    } else if (objective == "count:poisson" || objective == "reg:gamma" ||
+               objective == "reg:tweedie" || objective == "survival:cox" ||
+               objective == "survival:aft") {
+      m[0] = std::exp(m[0]);
+    }
+  }
+};
+
+std::vector<double> nums(const JValue& a) {
+  std::vector<double> out;
+  out.reserve(a.arr.size());
+  for (const auto& v : a.arr) out.push_back(v.as_num());
+  return out;
+}
+
+Tree parse_tree_common(const JValue& jt) {
+  Tree t;
+  for (double v : nums(*jt.get("left_children")))
+    t.left.push_back(static_cast<int32_t>(v));
+  for (double v : nums(*jt.get("right_children")))
+    t.right.push_back(static_cast<int32_t>(v));
+  for (double v : nums(*jt.get("split_indices")))
+    t.feat.push_back(static_cast<int32_t>(v));
+  for (double v : nums(*jt.get("split_conditions")))
+    t.cond.push_back(static_cast<float>(v));
+  for (double v : nums(*jt.get("default_left")))
+    t.dleft.push_back(v != 0);
+  t.is_cat.assign(t.left.size(), 0);
+  if (const JValue* st = jt.get("split_type")) {
+    for (size_t i = 0; i < st->arr.size() && i < t.is_cat.size(); ++i)
+      t.is_cat[i] = st->arr[i].as_num() != 0;
+  }
+  return t;
+}
+
+void parse_ref_categories(const JValue& jt, Tree* t) {
+  const JValue* cn = jt.get("categories_nodes");
+  if (!cn || cn->arr.empty()) return;
+  const auto members = nums(*jt.get("categories"));
+  const auto segs = nums(*jt.get("categories_segments"));
+  const auto sizes = nums(*jt.get("categories_sizes"));
+  for (size_t i = 0; i < cn->arr.size(); ++i) {
+    std::vector<int32_t> set;
+    const size_t s = static_cast<size_t>(segs[i]);
+    for (size_t k = 0; k < static_cast<size_t>(sizes[i]); ++k)
+      set.push_back(static_cast<int32_t>(members[s + k]));
+    t->cats[static_cast<int32_t>(cn->arr[i].as_num())] = std::move(set);
+  }
+}
+
+void parse_native_categories(const JValue& jt, Tree* t) {
+  const JValue* c = jt.get("categories");
+  if (!c || c->kind != JValue::kObj) return;  // native: {"nid": [left...]}
+  for (const auto& kv : c->obj) {
+    std::vector<int32_t> set;
+    for (const auto& m : kv.second.arr)
+      set.push_back(static_cast<int32_t>(m.as_num()));
+    t->cats[std::stoi(kv.first)] = std::move(set);
+  }
+}
+
+Model load_model_json(const std::string& text) {
+  JParser parser(text);
+  const JValue root = parser.parse();
+  const JValue* learner = root.get("learner");
+  if (!learner) throw std::runtime_error("model: no learner");
+  const JValue* gb = learner->get("gradient_booster");
+  if (!gb) throw std::runtime_error("model: no gradient_booster");
+  Model m;
+  const JValue* lmp = learner->get("learner_model_param");
+  const JValue* objv = learner->get("objective");
+  if (objv && objv->get("name")) m.objective = objv->get("name")->str;
+
+  const JValue* gb_name = gb->get("name");
+  if (gb_name && gb_name->str == "gblinear")
+    throw std::runtime_error(
+        "the C scoring ABI supports tree boosters only (gblinear models "
+        "are a matmul — score them directly)");
+
+  // reference schema: booster payload nested under model/gbtree
+  const JValue* model = gb->get("model");
+  if (!model && gb->get("gbtree"))
+    model = gb->get("gbtree")->get("model");
+  m.ref_semantics = model != nullptr;
+
+  int num_class = 0, num_target = 1;
+  double base_user = 0.0;
+  std::vector<double> base_list;
+  if (lmp) {
+    if (const JValue* v = lmp->get("num_class"))
+      num_class = static_cast<int>(v->as_num());
+    if (const JValue* v = lmp->get("num_target"))
+      num_target = std::max(1, static_cast<int>(v->as_num()));
+    if (const JValue* v = lmp->get("num_feature"))
+      m.num_feature = static_cast<int>(v->as_num());
+    if (const JValue* v = lmp->get("base_score")) {
+      if (v->kind == JValue::kArr) {           // native: margin list
+        base_list = nums(*v);
+      } else {
+        base_user = v->as_num();
+      }
+    }
+  }
+  m.n_groups = std::max({num_class, num_target, 1});
+
+  const JValue* trees;
+  const JValue* tinfo;
+  if (m.ref_semantics) {
+    trees = model->get("trees");
+    tinfo = model->get("tree_info");
+  } else {
+    trees = gb->get("trees");
+    tinfo = gb->get("tree_info");
+  }
+  if (!trees) throw std::runtime_error("model: no trees");
+  for (const auto& jt : trees->arr) {
+    Tree t = parse_tree_common(jt);
+    if (m.ref_semantics) {
+      parse_ref_categories(jt, &t);
+    } else {
+      // native trees carry leaf values separately from thresholds
+      if (const JValue* lv = jt.get("split_conditions")) (void)lv;
+      parse_native_categories(jt, &t);
+    }
+    m.trees.push_back(std::move(t));
+  }
+  if (tinfo) {
+    for (double v : nums(*tinfo))
+      m.tree_info.push_back(static_cast<int32_t>(v));
+  }
+  m.tree_info.resize(m.trees.size(), 0);
+  if (const JValue* wd = gb->get("weight_drop")) {  // dart (both schemas)
+    m.tree_weight = nums(*wd);
+  }
+  m.tree_weight.resize(m.trees.size(), 1.0);
+
+  if (!base_list.empty()) {
+    m.base_margin = base_list;
+    m.base_margin.resize(m.n_groups, base_list.back());
+  } else {
+    // reference base_score is user-space: invert the objective's transform
+    double margin = base_user;
+    if (m.objective == "binary:logistic" || m.objective == "reg:logistic") {
+      const double p = std::min(std::max(base_user, 1e-16), 1.0 - 1e-16);
+      margin = std::log(p / (1.0 - p));
+    } else if (m.objective == "count:poisson" || m.objective == "reg:gamma" ||
+               m.objective == "reg:tweedie" ||
+               m.objective == "survival:cox" ||
+               m.objective == "survival:aft") {
+      margin = std::log(std::max(base_user, 1e-16));
+    }
+    m.base_margin.assign(m.n_groups, margin);
+  }
+  return m;
+}
+
+int fail(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* BoosterHandle;
+
+const char* XGBGetLastError() { return g_last_error.c_str(); }
+
+int XGBoosterCreate(const void*, int, BoosterHandle* out) {
+  *out = new Model();
+  return 0;
+}
+
+int XGBoosterFree(BoosterHandle handle) {
+  delete static_cast<Model*>(handle);
+  return 0;
+}
+
+int XGBoosterLoadModelFromBuffer(BoosterHandle handle, const void* buf,
+                                 uint64_t len) {
+  try {
+    std::string text(static_cast<const char*>(buf), len);
+    *static_cast<Model*>(handle) = load_model_json(text);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  }
+}
+
+int XGBoosterLoadModel(BoosterHandle handle, const char* fname) {
+  try {
+    std::ifstream in(fname, std::ios::binary);
+    if (!in) return fail(std::string("cannot open ") + fname);
+    std::stringstream ss;
+    ss << in.rdbuf();
+    const std::string text = ss.str();
+    *static_cast<Model*>(handle) = load_model_json(text);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  }
+}
+
+int XGBoosterBoostedRounds(BoosterHandle handle, int* out) {
+  *out = static_cast<int>(static_cast<Model*>(handle)->trees.size());
+  return 0;
+}
+
+int XGBoosterGetNumFeature(BoosterHandle handle, uint64_t* out) {
+  *out = static_cast<uint64_t>(static_cast<Model*>(handle)->num_feature);
+  return 0;
+}
+
+// Dense row-major [n, f] prediction. output_margin: 0 -> objective
+// transform applied (reference XGBoosterPredictFromDense config subset).
+// missing values: pass NaN (or `missing` to be mapped to NaN).
+int XGBoosterPredictFromDense(BoosterHandle handle, const float* data,
+                              uint64_t n, uint64_t f, float missing,
+                              int output_margin, float* out) {
+  try {
+    const Model& m = *static_cast<Model*>(handle);
+    if (m.num_feature && f < static_cast<uint64_t>(m.num_feature))
+      return fail("feature count mismatch");
+    std::vector<double> margin(m.n_groups);
+    std::vector<float> row(f);
+    const bool map_missing = !std::isnan(missing);
+    for (uint64_t r = 0; r < n; ++r) {
+      const float* src = data + r * f;
+      const float* use = src;
+      if (map_missing) {
+        for (uint64_t j = 0; j < f; ++j)
+          row[j] = (src[j] == missing)
+                       ? std::numeric_limits<float>::quiet_NaN()
+                       : src[j];
+        use = row.data();
+      }
+      m.predict_row(use, margin.data());
+      if (!output_margin) m.transform(margin.data());
+      for (int g = 0; g < m.n_groups; ++g)
+        out[r * m.n_groups + g] = static_cast<float>(margin[g]);
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  }
+}
+
+}  // extern "C"

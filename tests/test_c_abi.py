@@ -1,0 +1,150 @@
+"""C scoring ABI (native/c_api.cc, docs/c_abi.md): dlopen the native
+library the way an R/JVM binding would and assert prediction agreement
+with the Python Booster on both model schemas."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu import native
+from xgboost_tpu.interop import save_xgboost_model
+
+lib = native.load()
+pytestmark = pytest.mark.skipif(lib is None, reason="no C++ toolchain")
+
+
+def _proto():
+    lib.XGBGetLastError.restype = ctypes.c_char_p
+    lib.XGBoosterCreate.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+    lib.XGBoosterLoadModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.XGBoosterLoadModelFromBuffer.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.XGBoosterPredictFromDense.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_float, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.XGBoosterBoostedRounds.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_int)]
+
+
+_proto()
+
+
+def _c_predict(model_path_or_bytes, X, n_groups=1, output_margin=False,
+               missing=float("nan")):
+    h = ctypes.c_void_p()
+    assert lib.XGBoosterCreate(None, 0, ctypes.byref(h)) == 0
+    try:
+        if isinstance(model_path_or_bytes, bytes):
+            rc = lib.XGBoosterLoadModelFromBuffer(
+                h, model_path_or_bytes, len(model_path_or_bytes))
+        else:
+            rc = lib.XGBoosterLoadModel(
+                h, str(model_path_or_bytes).encode())
+        assert rc == 0, lib.XGBGetLastError().decode()
+        X = np.ascontiguousarray(X, np.float32)
+        out = np.empty((len(X), n_groups), np.float32)
+        rc = lib.XGBoosterPredictFromDense(
+            h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            X.shape[0], X.shape[1], ctypes.c_float(missing),
+            int(output_margin),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        assert rc == 0, lib.XGBGetLastError().decode()
+        rounds = ctypes.c_int()
+        lib.XGBoosterBoostedRounds(h, ctypes.byref(rounds))
+        return out[:, 0] if n_groups == 1 else out, rounds.value
+    finally:
+        lib.XGBoosterFree(h)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 6).astype(np.float32)
+    X[rng.rand(2000, 6) < 0.08] = np.nan
+    y = (np.nansum(X[:, :3], axis=1) > 0).astype(np.float32)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 5,
+                     "eta": 0.3}, xgb.DMatrix(X, label=y), 8,
+                    verbose_eval=False)
+    return bst, X
+
+
+def test_scores_native_schema(trained, tmp_path):
+    bst, X = trained
+    path = tmp_path / "m.json"
+    bst.save_model(str(path))
+    got, rounds = _c_predict(path, X)
+    assert rounds == 8
+    np.testing.assert_allclose(got, bst.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scores_reference_schema(trained, tmp_path):
+    bst, X = trained
+    path = tmp_path / "ref.json"
+    save_xgboost_model(bst, str(path))
+    got, _ = _c_predict(path, X)
+    np.testing.assert_allclose(got, bst.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-6)
+    margin, _ = _c_predict(path, X, output_margin=True)
+    np.testing.assert_allclose(
+        margin, bst.predict(xgb.DMatrix(X), output_margin=True),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_scores_golden_categorical_fixture():
+    fix = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "gbtree_categorical.json")
+    X = np.asarray([[0.0, 9.9], [1.0, 9.9], [2.0, 9.9], [3.0, 9.9],
+                    [np.nan, 9.9]], np.float32)
+    got, _ = _c_predict(fix, X)
+    np.testing.assert_allclose(got, [0.25, 1.25, 0.25, 1.25, 1.25],
+                               atol=1e-6)
+
+
+def test_scores_dart_fixture():
+    fix = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "dart_squarederror.json")
+    X = np.asarray([[-1.0, 0.0], [1.0, 3.0]], np.float32)
+    got, _ = _c_predict(fix, X)
+    np.testing.assert_allclose(got, [-0.55, 0.55], atol=1e-6)
+
+
+def test_multiclass_softprob(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.randn(600, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32) + (X[:, 1] > 0)
+    bst = xgb.train({"objective": "multi:softprob", "num_class": 3,
+                     "max_depth": 3}, xgb.DMatrix(X, label=y), 4,
+                    verbose_eval=False)
+    path = tmp_path / "mc.json"
+    bst.save_model(str(path))
+    got, _ = _c_predict(path, X, n_groups=3)
+    np.testing.assert_allclose(got, bst.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_custom_missing_value(trained, tmp_path):
+    bst, X = trained
+    path = tmp_path / "m2.json"
+    bst.save_model(str(path))
+    Xm = np.nan_to_num(X, nan=-999.0)
+    got, _ = _c_predict(path, Xm, missing=-999.0)
+    np.testing.assert_allclose(got, bst.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_error_contract():
+    h = ctypes.c_void_p()
+    lib.XGBoosterCreate(None, 0, ctypes.byref(h))
+    try:
+        rc = lib.XGBoosterLoadModelFromBuffer(h, b"not json", 8)
+        assert rc == -1
+        assert b"json" in lib.XGBGetLastError()
+    finally:
+        lib.XGBoosterFree(h)

@@ -73,3 +73,28 @@ def test_sklearn_facade():
     reg = dxgb.DaskXGBRegressor(client=client, n_estimators=5)
     reg.fit(Xp, yp)
     assert reg.predict(Xp).shape == (len(X),)
+
+
+@pytest.mark.slow
+def test_real_dask_local_cluster():
+    """Against a genuine dask.distributed LocalCluster (reference
+    tests/test_distributed/test_with_dask pattern). Skipped where dask is
+    not installed — the duck-typed LocalProcessClient tests above cover the
+    driver logic either way; this validates the real client API surface
+    (submit(workers=..., allow_other_workers=...), scheduler_info,
+    futures)."""
+    distributed = pytest.importorskip("distributed")
+
+    Xp, yp, X, y = _make_data(n_parts=4)
+    with distributed.LocalCluster(n_workers=2, threads_per_worker=1,
+                                  processes=True) as cluster, \
+            distributed.Client(cluster) as client:
+        dtrain = dxgb.DaskDMatrix(client, Xp, yp)
+        params = {"objective": "binary:logistic", "max_depth": 3,
+                  "eta": 0.5}
+        out = dxgb.train(client, params, dtrain, num_boost_round=3)
+        preds = dxgb.predict(client, out, Xp)
+    single = xgb.train(params, xgb.DMatrix(X, label=y), 3)
+    np.testing.assert_allclose(preds,
+                               single.predict(xgb.DMatrix(X)),
+                               rtol=1e-4, atol=1e-4)

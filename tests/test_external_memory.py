@@ -1,0 +1,102 @@
+"""Streaming external-memory training (VERDICT r1 item 6): with a
+cache_prefix the quantized matrix stays host-resident (disk memmap) and
+STREAMS to the device page-by-page inside the level loop — the model must
+match in-memory training, with device memory bounded at O(pages)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.data.binned import PagedBinnedMatrix
+from xgboost_tpu.data.dmatrix import DataIter
+
+from test_data_iterator import BatchIter, _data
+
+
+@pytest.fixture
+def paged_qdm(tmp_path, monkeypatch):
+    # tiny pages: 6000 rows / 500 = 12 pages -> the streamed path really
+    # iterates (VERDICT: "training 2x the configured page budget")
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "500")
+    X, y = _data(seed=3)
+    it = BatchIter(X, y, n_batches=5)
+    it.cache_prefix = str(tmp_path / "cache")
+    qdm = xgb.QuantileDMatrix(it, max_bin=64)
+    return X, y, qdm
+
+
+def test_paged_matrix_is_host_resident(paged_qdm):
+    X, y, qdm = paged_qdm
+    binned = qdm.binned(64)
+    assert isinstance(binned, PagedBinnedMatrix)
+    assert isinstance(binned.bins_host, np.memmap)  # disk-backed, not HBM
+    assert binned.n_pages() >= 12
+    assert binned.page_rows == 500
+
+
+def test_paged_training_matches_in_memory(paged_qdm):
+    X, y, qdm = paged_qdm
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64}
+    bst_p = xgb.train(params, qdm, 6, verbose_eval=False)
+
+    # in-memory reference on the SAME quantization (shared iterator cuts)
+    qdm_mem = xgb.QuantileDMatrix(BatchIter(X, y, n_batches=5), max_bin=64)
+    bst_m = xgb.train(params, qdm_mem, 6, verbose_eval=False)
+
+    trees_p, trees_m = bst_p.gbm.trees, bst_m.gbm.trees
+    assert len(trees_p) == len(trees_m) == 6
+    for tp, tm in zip(trees_p, trees_m):
+        # identical STRUCTURE; leaf values accumulate gradients in page
+        # order, so they agree only to float-summation reassociation
+        np.testing.assert_array_equal(tp.split_feature, tm.split_feature)
+        np.testing.assert_array_equal(tp.split_bin, tm.split_bin)
+        np.testing.assert_allclose(tp.leaf_value, tm.leaf_value,
+                                   rtol=1e-4, atol=1e-5)
+    dmx = xgb.DMatrix(X)
+    np.testing.assert_allclose(bst_p.predict(dmx), bst_m.predict(dmx),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_paged_training_with_missing_and_sampling(tmp_path, monkeypatch):
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "700")
+    # zero cache budget: every page streams on every visit (the
+    # larger-than-HBM regime), not just on first touch
+    monkeypatch.setenv("XTPU_PAGE_CACHE_BYTES", "0")
+    rng = np.random.RandomState(9)
+    X = rng.randn(4000, 6).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(6) > 0).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.1] = np.nan
+    it = BatchIter(X, y, n_batches=3)
+    it.cache_prefix = str(tmp_path / "c2")
+    qdm = xgb.QuantileDMatrix(it, max_bin=32)
+    res = {}
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "max_bin": 32, "subsample": 0.8,
+                     "colsample_bytree": 0.8, "eval_metric": "auc"},
+                    qdm, 8, evals=[(qdm, "train")], evals_result=res,
+                    verbose_eval=False)
+    assert res["train"]["auc"][-1] > 0.85
+    p = bst.predict(xgb.DMatrix(X))
+    assert np.isfinite(p).all()
+
+
+def test_paged_eval_and_continuation(paged_qdm):
+    X, y, qdm = paged_qdm
+    params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 64,
+              "eval_metric": "logloss"}
+    res = {}
+    bst = xgb.train(params, qdm, 4, evals=[(qdm, "train")],
+                    evals_result=res, verbose_eval=False)
+    assert res["train"]["logloss"][-1] < res["train"]["logloss"][0]
+    # continuation re-enters the paged margin cache
+    bst2 = xgb.train(params, qdm, 2, xgb_model=bst, verbose_eval=False)
+    assert len(bst2.gbm.trees) == 6
+
+
+def test_paged_unsupported_configs_raise(paged_qdm):
+    X, y, qdm = paged_qdm
+    with pytest.raises(NotImplementedError):
+        xgb.train({"objective": "binary:logistic",
+                   "grow_policy": "lossguide", "max_leaves": 8,
+                   "max_bin": 64}, qdm, 1, verbose_eval=False)

@@ -136,15 +136,23 @@ class GBTree:
                 # reference BoostNewTrees: lr /= num_parallel_tree
                 param = param.clone()
                 param.eta = param.eta / self.num_parallel_tree
+            paged = getattr(binned, "is_paged", False)
+            kw = {"split_mode": self.split_mode}
             if param.grow_policy == "lossguide":
+                if paged:
+                    raise NotImplementedError(
+                        "external-memory training supports "
+                        "grow_policy=depthwise only")
                 from ..tree.lossguide import LossguideGrower
 
                 cls = LossguideGrower
+                kw = {}
+            elif paged:
+                from ..tree.paged import PagedGrower
+
+                cls = PagedGrower
             else:
                 cls = TreeGrower
-            kw = {}
-            if cls is TreeGrower:
-                kw["split_mode"] = self.split_mode
             self._grower = cls(param, binned.max_nbins, binned.cuts,
                                hist_method=self.hist_method,
                                mesh=self.mesh, monotone=self.monotone,
@@ -367,24 +375,36 @@ class GBTree:
         m, pos = pred.margin(X, np.asarray(base, np.float32))
         return np.asarray(m), pos, self.trees[lo:hi]
 
+    def _margin_binned_paged(self, pred, binned, base):
+        """Streamed prediction over a PagedBinnedMatrix's pages."""
+        outs = []
+        for _, _, page in binned.pages():
+            m, _ = pred.margin_binned(page, binned.missing_bin, base)
+            outs.append(m)
+        return jnp.concatenate(outs)
+
     def margin_delta_binned(self, binned, tree_lo: int, tree_hi: int):
         """Margin contribution of trees [tree_lo, tree_hi) on quantized data
         (the prediction-cache increment)."""
         pred = self._predictor(tree_lo, tree_hi)
         if pred is None:
             return 0.0
-        delta, _ = pred.margin_binned(binned.bins, binned.missing_bin,
-                                      np.zeros(self.n_groups, np.float32))
+        zero = np.zeros(self.n_groups, np.float32)
+        if getattr(binned, "is_paged", False):
+            return self._margin_binned_paged(pred, binned, zero)
+        delta, _ = pred.margin_binned(binned.bins, binned.missing_bin, zero)
         return delta
 
     def full_margin_binned(self, binned, base):
         pred = self._predictor(0, len(self.trees))
-        n = binned.bins.shape[0]
+        n = binned.n_rows
         if pred is None:
             return jnp.broadcast_to(
                 jnp.asarray(base, jnp.float32)[None, :], (n, self.n_groups))
-        m, _ = pred.margin_binned(binned.bins, binned.missing_bin,
-                                  np.asarray(base, np.float32))
+        base = np.asarray(base, np.float32)
+        if getattr(binned, "is_paged", False):
+            return self._margin_binned_paged(pred, binned, base)
+        m, _ = pred.margin_binned(binned.bins, binned.missing_bin, base)
         return m
 
     # -- model container ------------------------------------------------------

@@ -336,6 +336,10 @@ class Booster:
             elif is_train:
                 binned = dm.binned(self.tree_param.max_bin)
                 if self.ctx.mesh is not None:
+                    if getattr(binned, "is_paged", False):
+                        raise NotImplementedError(
+                            "external-memory (paged) training does not "
+                            "support meshes yet")
                     return self._make_sharded_train_state(key, dm, binned)
             else:
                 train_cuts = None
@@ -527,6 +531,7 @@ class Booster:
                 or self.tree_param.max_leaves > 0
                 or hasattr(self.obj, "update_tree_leaf")
                 or state.get("binned") is None
+                or getattr(state.get("binned"), "is_paged", False)
                 or self.ctx.mesh is not None
                 or observer.enabled()):
             return False

@@ -241,6 +241,8 @@ class BinnedMatrix:
         return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins,
                             has_missing=has_missing)
 
+    is_paged = False
+
     @staticmethod
     def from_local_bins(local: np.ndarray, cuts: HistogramCuts,
                         max_nbins: Optional[int] = None, device=None,
@@ -254,3 +256,119 @@ class BinnedMatrix:
                 else jnp.asarray(arr))
         return BinnedMatrix(bins=bins, cuts=cuts, max_nbins=max_nbins,
                             has_missing=has_missing)
+
+
+@dataclass
+class PagedBinnedMatrix:
+    """Quantized matrix resident in HOST memory (ndarray or disk memmap),
+    streamed to the device one row page at a time — the training analogue of
+    the reference's external-memory ``SparsePageDMatrix`` whose pages flow
+    through the updater via an async prefetch ring
+    (``src/data/sparse_page_source.h:180-200``). Device memory is bounded at
+    O(2 pages) for the feature matrix; per-row vectors (gradients,
+    positions, margins — ~20 bytes/row vs ``n_features`` bytes/row of bins)
+    remain device-resident, mirroring the reference GPU external-memory
+    design where gradients stay on device while Ellpack pages stream."""
+
+    bins_host: np.ndarray   # [n_rows, n_features], np array or np.memmap
+    cuts: HistogramCuts
+    max_nbins: int
+    has_missing: bool = True
+    page_rows: int = 1_000_000
+    # HBM page cache: pages stay device-resident up to this many bytes
+    # (XTPU_PAGE_CACHE_BYTES, default 4 GiB) and only the overflow streams
+    # per visit — the reference keeps its page cache in host RAM and pays
+    # PCIe per fetch; against a ~34 MB/s tunnel, re-streaming every page at
+    # every level costs ~2 min/round, so caching what fits is the
+    # difference between external-memory being usable and not.
+    cache_budget_bytes: int = -1  # -1 -> env/default at first use
+
+    is_paged = True
+
+    def __post_init__(self) -> None:
+        self._device_cache: dict = {}
+        if self.cache_budget_bytes < 0:
+            import os
+
+            self.cache_budget_bytes = int(os.environ.get(
+                "XTPU_PAGE_CACHE_BYTES", 4 << 30))
+
+    @property
+    def bins(self) -> "PagedBinnedMatrix":
+        """Self-reference: paged-aware consumers (PagedGrower, the paged
+        margin cache) receive the pageable object through the same
+        ``binned.bins`` plumbing that hands resident consumers the device
+        array."""
+        return self
+
+    @property
+    def n_rows(self) -> int:
+        return self.bins_host.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.bins_host.shape[1]
+
+    @property
+    def missing_bin(self) -> int:
+        return self.max_nbins - 1 if self.has_missing else self.max_nbins
+
+    def n_real_bins(self) -> np.ndarray:
+        return np.asarray(self.cuts.n_real_bins())
+
+    def n_pages(self) -> int:
+        return max(-(-self.n_rows // self.page_rows), 1)
+
+    def _fetch(self, s: int, device):
+        e = min(s + self.page_rows, self.n_rows)
+        page = self._device_cache.get(s)
+        uploaded = page is None
+        if uploaded:
+            page = jax.device_put(
+                np.ascontiguousarray(self.bins_host[s:e]), device)
+        return s, e, page, uploaded
+
+    def pages(self, device=None):
+        """(start, end, device_page): cached pages are yielded straight
+        from HBM; pages past the cache budget upload per visit with one
+        page of lookahead (the prefetch ring — ``jax.device_put`` blocks
+        over remote-device tunnels, so the upload of page k+1 rides on a
+        worker thread while the consumer computes on page k)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        n = self.n_rows
+        if n == 0:
+            return
+        page_bytes = (self.page_rows * self.n_features
+                      * self.bins_host.dtype.itemsize)
+        max_cached = (self.cache_budget_bytes // page_bytes
+                      if page_bytes else 0)
+        starts = list(range(0, n, self.page_rows))
+        with ThreadPoolExecutor(1) as ex:
+            fut = ex.submit(self._fetch, starts[0], device)
+            for i in range(len(starts)):
+                s, e, page, uploaded = fut.result()
+                if i + 1 < len(starts):
+                    fut = ex.submit(self._fetch, starts[i + 1], device)
+                if uploaded and len(self._device_cache) < max_cached:
+                    self._device_cache[s] = page
+                yield s, e, page
+
+    def to_values_host(self) -> np.ndarray:
+        """Representative feature values from bin ids, page-wise on host
+        (the raw matrix was never retained)."""
+        cuts = self.cuts
+        ptrs = np.asarray(cuts.ptrs[:-1], np.int64)
+        vals = np.asarray(cuts.values, np.float32)
+        n_real = np.asarray(self.n_real_bins())
+        out = np.empty((self.n_rows, self.n_features), np.float32)
+        for s in range(0, self.n_rows, self.page_rows):
+            local = np.asarray(self.bins_host[s:s + self.page_rows],
+                               np.int64)
+            miss = local >= n_real[None, :]
+            gb = np.clip(ptrs[None, :] + np.minimum(local, n_real - 1), 0,
+                         len(vals) - 1)
+            page = vals[gb]
+            page[miss] = np.nan
+            out[s:s + local.shape[0]] = page
+        return out

@@ -141,8 +141,9 @@ class DMatrix:
         b = self._binned
         if not b.has_missing:
             return b.n_rows * b.n_features
-        return int(np.count_nonzero(
-            np.asarray(b.bins) != b.missing_bin))
+        bins = b.bins_host if getattr(b, "is_paged", False) else \
+            np.asarray(b.bins)
+        return int(np.count_nonzero(bins != b.missing_bin))
 
     @property
     def shape(self):
@@ -401,9 +402,21 @@ class DMatrix:
             search_bin_into(X, cuts, max_nbins - 1,
                             local[row:row + X.shape[0]])
             row += X.shape[0]
-        self._binned = BinnedMatrix.from_local_bins(
-            np.asarray(local), cuts, max_nbins=max_nbins,
-            has_missing=has_missing)
+        if cache_prefix:
+            # external-memory tier: the quantized matrix stays host-resident
+            # (disk-backed memmap) and STREAMS to the device in row pages
+            # during training (tree/paged.py) — it never lands whole in HBM
+            from .binned import PagedBinnedMatrix
+
+            page_rows = int(os.environ.get("XTPU_PAGE_ROWS", 1_000_000))
+            self._binned = PagedBinnedMatrix(
+                bins_host=local, cuts=cuts, max_nbins=max_nbins,
+                has_missing=has_missing,
+                page_rows=max(page_rows, 1))
+        else:
+            self._binned = BinnedMatrix.from_local_bins(
+                np.asarray(local), cuts, max_nbins=max_nbins,
+                has_missing=has_missing)
         self._binned_max_bin = max_bin
         self._n_rows = n_rows
         self._n_cols = n_feat
@@ -416,6 +429,8 @@ class DMatrix:
         data). Note the reconstruction materialises an [n, F] f32 matrix."""
         if self.X is not None:
             return self.X
+        if getattr(self._binned, "is_paged", False):
+            return self._binned.to_values_host()
         return np.asarray(self._binned.to_values())
 
     def slice(self, rindex: np.ndarray) -> "DMatrix":

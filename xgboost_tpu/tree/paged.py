@@ -1,0 +1,226 @@
+"""External-memory tree growth: the level loop over streamed bin pages.
+
+Counterpart of the reference's external-memory updater flow — histogram
+builds and row partitioning iterate over ``SparsePage``/``Ellpack`` batches
+fetched through an async prefetch ring (``src/data/sparse_page_source.h:
+180-200``, CPU hist loop over pages ``src/tree/updater_quantile_hist.cc``).
+TPU shape: per depth, one pass over the host-resident quantized matrix in
+row pages (double-buffered host->device upload, ``PagedBinnedMatrix.pages``);
+page histograms accumulate on device, split evaluation reuses the resident
+``evaluate_splits`` kernel, and positions advance page-by-page with the
+gather walk. Device memory stays O(2 pages + per-row vectors).
+
+Scope: depthwise single-target growth (the hist hot path). Categorical
+splits, monotone/interaction constraints, column split, and meshes raise
+``NotImplementedError`` — train those on resident matrices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import build_hist
+from ..ops.partition import advance_positions_level, update_positions
+from ..ops.split import evaluate_splits
+from .grow import GrownTree, TreeGrower, _sample_features
+from .param import calc_weight
+
+_EPS = 1e-6
+
+
+class PagedGrower(TreeGrower):
+    """Grows one tree from a ``PagedBinnedMatrix`` (host-resident bins)."""
+
+    def __init__(self, param, max_nbins, cuts, hist_method="auto",
+                 mesh=None, monotone=None, constraint_sets=None,
+                 has_missing=True, split_mode="row") -> None:
+        if mesh is not None:
+            raise NotImplementedError(
+                "external-memory training does not support meshes yet; "
+                "page budgets are per-chip")
+        if monotone is not None or constraint_sets is not None:
+            raise NotImplementedError(
+                "external-memory training does not support monotone/"
+                "interaction constraints yet")
+        if split_mode != "row":
+            raise NotImplementedError(
+                "external-memory training supports data_split_mode=row only")
+        if cuts.is_cat().any():
+            raise NotImplementedError(
+                "external-memory training does not support categorical "
+                "features yet")
+        if param.max_leaves > 0:
+            raise NotImplementedError(
+                "external-memory training does not support max_leaves yet")
+        super().__init__(param, max_nbins, cuts, hist_method=hist_method,
+                         mesh=None, monotone=None, constraint_sets=None,
+                         has_missing=has_missing, split_mode="row")
+
+    def grow(self, paged, gpair: jnp.ndarray, n_real_bins,
+             key: jax.Array) -> GrownTree:
+        param = self.param
+        n = paged.n_rows
+        max_depth = param.max_depth
+        max_nodes = 2 ** (max_depth + 1) - 1
+        max_nbins = self.max_nbins
+        missing_bin = paged.missing_bin
+        hist_kernel = self.hist_method
+        for suffix in ("+sub", "+nosub"):
+            if hist_kernel.endswith(suffix):
+                hist_kernel = hist_kernel[: -len(suffix)]
+
+        n_real = np.asarray(n_real_bins)
+        base_mask = jnp.asarray(n_real) > 0
+        tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
+                                     base_mask, param.colsample_bytree)
+        key = jax.random.fold_in(key, 0x5EED)
+
+        # host-side tree bookkeeping (same heap layout as _grow)
+        split_feature = np.full(max_nodes, -1, np.int32)
+        split_bin = np.zeros(max_nodes, np.int32)
+        default_left = np.zeros(max_nodes, bool)
+        is_leaf = np.ones(max_nodes, bool)
+        active = np.zeros(max_nodes, bool)
+        active[0] = True
+        gain = np.zeros(max_nodes, np.float32)
+        node_sum = np.zeros((max_nodes, 2), np.float32)
+
+        positions = jnp.zeros((n,), jnp.int32)  # device-resident [n]
+        node_sum[0] = np.asarray(jnp.sum(gpair, axis=0))
+
+        # One static node width (2^(max_depth-1), the widest level) for
+        # EVERY per-page program: per-width jits would compile
+        # O(page_shapes x level_widths) programs, and XLA compilation on a
+        # single-core host costs ~50 s per program — the dominant cost of
+        # the first paged round. With a static width there are two hist +
+        # two advance + one eval program in total; the Pallas histogram's
+        # cost is flat in width, and pad nodes carry zero stats so they can
+        # never win a split.
+        n_static = 2 ** (max_depth - 1) if max_depth > 0 else 1
+
+        fmask_level = None
+        for depth in range(max_depth):
+            lo = 2 ** depth - 1
+            n_level = 2 ** depth
+
+            # --- histogram: one streamed pass over the pages -------------
+            hist_full = None
+            for s, e, page in paged.pages():
+                rel = jnp.where(
+                    (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
+                    positions[s:e] - lo, n_static).astype(jnp.int32)
+                h = build_hist(page, gpair[s:e], rel, n_static, max_nbins,
+                               method=hist_kernel)
+                hist_full = h if hist_full is None else hist_full + h
+
+            level_key = jax.random.fold_in(key, depth)
+            fmask_level = _sample_features(level_key, tree_mask,
+                                           param.colsample_bylevel)
+            if param.colsample_bynode < 1.0:
+                node_keys = jax.random.split(
+                    jax.random.fold_in(level_key, 1), n_level)
+                fmask = jax.vmap(
+                    lambda k: _sample_features(k, fmask_level,
+                                               param.colsample_bynode)
+                )(node_keys)
+                if n_level < n_static:  # static-width eval program
+                    fmask = jnp.concatenate(
+                        [fmask, jnp.zeros((n_static - n_level,
+                                           fmask.shape[1]), bool)])
+            else:
+                fmask = fmask_level[None, :]
+
+            parent_pad = np.zeros((n_static, 2), np.float32)
+            parent_pad[:n_level] = node_sum[lo:lo + n_level]
+            res = evaluate_splits(hist_full, jnp.asarray(parent_pad),
+                                  jnp.asarray(n_real),
+                                  param, feature_mask=fmask,
+                                  has_missing=self.has_missing)
+
+            res_gain = np.asarray(res.gain)[:n_level]
+            can_split = (active[lo:lo + n_level]
+                         & (res_gain > max(param.gamma, _EPS))
+                         & np.isfinite(res_gain))
+            idx = lo + np.arange(n_level)
+            r_feat = np.asarray(res.feature)[:n_level]
+            r_bin = np.asarray(res.bin)[:n_level]
+            split_feature[idx] = np.where(can_split, r_feat, -1)
+            split_bin[idx] = np.where(can_split, r_bin, 0)
+            default_left[idx] = can_split \
+                & np.asarray(res.default_left)[:n_level]
+            is_leaf[idx] = ~can_split
+            gain[idx] = np.where(can_split, res_gain, 0.0)
+            li, ri = 2 * idx + 1, 2 * idx + 2
+            active[li] = can_split
+            active[ri] = can_split
+            ls = np.asarray(res.left_sum)[:n_level]
+            rs = np.asarray(res.right_sum)[:n_level]
+            node_sum[li] = np.where(can_split[:, None], ls, 0.0)
+            node_sum[ri] = np.where(can_split[:, None], rs, 0.0)
+
+            if not can_split.any():
+                # no node split at this level -> no deeper nodes exist;
+                # don't stream dead histogram passes for the rest of the
+                # depth budget (each costs a full pass over the pages)
+                break
+
+            # --- position advance: second streamed pass ------------------
+            if depth + 1 <= max_depth:
+                new_pos = []
+                if n_static <= 64:
+                    # static-width [N] split vectors -> one matmul-based
+                    # (gather-free) advance program per page shape; its
+                    # [page, N] intermediates cap the width at 64
+                    feat_pad = np.full(n_static, -1, np.int32)
+                    bin_pad = np.zeros(n_static, np.int32)
+                    dl_pad = np.zeros(n_static, bool)
+                    cs_pad = np.zeros(n_static, bool)
+                    feat_pad[:n_level] = split_feature[idx]
+                    bin_pad[:n_level] = split_bin[idx]
+                    dl_pad[:n_level] = default_left[idx]
+                    cs_pad[:n_level] = can_split
+                    feat_d = jnp.asarray(feat_pad)
+                    bin_d = jnp.asarray(bin_pad)
+                    dl_d = jnp.asarray(dl_pad)
+                    cs_d = jnp.asarray(cs_pad)
+                    for s, e, page in paged.pages():
+                        rel = jnp.where(
+                            (positions[s:e] >= lo)
+                            & (positions[s:e] < lo + n_level),
+                            positions[s:e] - lo,
+                            n_static).astype(jnp.int32)
+                        new_pos.append(advance_positions_level(
+                            page.astype(jnp.float32), positions[s:e], rel,
+                            feat_d, bin_d, dl_d, cs_d, missing_bin))
+                else:  # deep levels: per-row gather walk, O(page) memory
+                    sf_d = jnp.asarray(split_feature)
+                    sb_d = jnp.asarray(split_bin)
+                    dl_d = jnp.asarray(default_left)
+                    is_split_full = np.zeros(max_nodes, bool)
+                    is_split_full[idx] = can_split
+                    isf_d = jnp.asarray(is_split_full)
+                    for s, e, page in paged.pages():
+                        new_pos.append(update_positions(
+                            page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
+                            missing_bin))
+                positions = jnp.concatenate(new_pos)
+
+        w = calc_weight(jnp.asarray(node_sum[:, 0]),
+                        jnp.asarray(node_sum[:, 1]), param) * param.eta
+        w = np.asarray(w)
+        leaf_value = np.where(active & is_leaf, w, 0.0).astype(np.float32)
+        base_weight = np.where(active, w, 0.0).astype(np.float32)
+        delta = jnp.asarray(leaf_value)[positions]  # device gather [n]
+
+        return GrownTree(
+            split_feature=split_feature, split_bin=split_bin,
+            default_left=default_left, is_leaf=is_leaf, active=active,
+            leaf_value=leaf_value, node_sum=node_sum, gain=gain,
+            positions=positions, delta=delta,
+            is_cat_split=np.zeros(max_nodes, bool),
+            cat_words=np.zeros((max_nodes, 1), np.uint32),
+            base_weight=base_weight)
