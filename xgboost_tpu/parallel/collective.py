@@ -170,6 +170,58 @@ class JaxProcessCommunicator(Communicator):
                 for r in range(self._world)]
 
 
+class FaultInjectionCommunicator(Communicator):
+    """Wraps any communicator and fails the k-th collective — the testing
+    analogue of the reference's mock rabit engine
+    (``rabit/src/allreduce_mock.h:147``, ``RABIT_MOCK``: inject a failure
+    at a chosen (round, op) so recovery paths can be exercised without a
+    real cluster). Counts every collective (allreduce + allgather) across
+    the wrapped communicator's lifetime; optional ``op_filter`` restricts
+    which operation kinds count."""
+
+    class InjectedFault(RuntimeError):
+        pass
+
+    def __init__(self, inner: Communicator, fail_at: int,
+                 op_filter: Optional[str] = None) -> None:
+        # a fault injector that can never fire makes recovery tests
+        # vacuous — reject misconfiguration loudly
+        if fail_at < 1:
+            raise ValueError(f"fail_at must be >= 1, got {fail_at}")
+        if op_filter is not None and op_filter not in ("allreduce",
+                                                      "allgather"):
+            raise ValueError(
+                f"op_filter must be 'allreduce' or 'allgather' (broadcasts "
+                f"count as allgather), got {op_filter!r}")
+        self._inner = inner
+        self._fail_at = fail_at
+        self._op_filter = op_filter
+        self.calls = 0
+
+    def _tick(self, kind: str) -> None:
+        if self._op_filter is not None and kind != self._op_filter:
+            return
+        self.calls += 1
+        if self.calls == self._fail_at:
+            raise FaultInjectionCommunicator.InjectedFault(
+                f"injected failure at {kind} #{self.calls} "
+                f"(rank {self._inner.get_rank()})")
+
+    def get_rank(self) -> int:
+        return self._inner.get_rank()
+
+    def get_world_size(self) -> int:
+        return self._inner.get_world_size()
+
+    def allreduce(self, values: np.ndarray, op: str = "sum") -> np.ndarray:
+        self._tick("allreduce")
+        return self._inner.allreduce(values, op=op)
+
+    def allgather_objects(self, obj: Any) -> List[Any]:
+        self._tick("allgather")
+        return self._inner.allgather_objects(obj)
+
+
 # --- global communicator (reference collective::Init / CommunicatorContext) --
 
 _comm: Communicator = NoOpCommunicator()
