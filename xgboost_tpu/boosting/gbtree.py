@@ -270,6 +270,10 @@ class GBTree:
         from ..tree.multi import MultiTargetGrower
 
         binned = state["binned"]
+        if getattr(binned, "is_paged", False):
+            raise NotImplementedError(
+                "multi_output_tree does not support external-memory (paged) "
+                "matrices yet; use one_output_per_tree or a resident matrix")
         n = gpair.shape[0]
         if self._grower is None:
             param = self.tree_param
