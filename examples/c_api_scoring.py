@@ -1,7 +1,7 @@
 """C scoring ABI demo (docs/c_abi.md): train in Python, score from plain C.
 
-Writes a real C program, compiles it against the framework's native
-library, and runs it — exactly what an R/JVM/C++ deployment binding would
+Writes a real C program, compiles it (with g++ — the same toolchain that
+built the library) against the framework's native library, and runs it — exactly what an R/JVM/C++ deployment binding would
 do. The C side dlopens nothing Python-related: it links the same
 ``native/c_api.cc`` symbols exported from the framework's .so.
 """
@@ -73,7 +73,7 @@ def main() -> None:
         with open(src, "w") as fh:
             fh.write(_C_PROGRAM)
         so = lib._name
-        subprocess.run(["gcc", "-O2", "-o", exe, src, so,
+        subprocess.run(["g++", "-O2", "-o", exe, src, so,
                         f"-Wl,-rpath,{os.path.dirname(so)}"], check=True)
         out = subprocess.run([exe, model], check=True,
                              capture_output=True, text=True).stdout.strip()

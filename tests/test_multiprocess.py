@@ -49,15 +49,24 @@ _WORKER = textwrap.dedent("""
     res = {}
     with launch.CommunicatorContext():
         bst = launch.train_per_host(
-            {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3},
+            {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+             "eval_metric": ["logloss", "auc"]},
             X_local, y_local, 5,
             evals_result=res, verbose_eval=False)
+        # distributed eval: each rank evaluates its LOCAL shard and the
+        # metrics aggregate through the communicator (GlobalRatio / exact
+        # AUC merge) — every rank must see the GLOBAL value
+        from xgboost_tpu.parallel.launch import ShardedDMatrix
+        sdm = bst._caches[next(iter(bst._caches))]["dm"]
+        assert isinstance(sdm, ShardedDMatrix)
+        line = bst.eval_set([(sdm, "train")], 0)
     # local predictions on the local shard (raw-threshold walk)
     preds = np.asarray(bst.predict(xgb.DMatrix(X_local)))
     with open(out_path, "w") as fh:
         json.dump({"rank": rank, "preds": preds.tolist(),
                    "n_trees": len(bst.gbm.trees),
                    "base": float(np.asarray(bst.base_margin_).reshape(-1)[0]),
+                   "eval_line": line,
                    }, fh)
 """)
 
@@ -110,6 +119,11 @@ def test_two_process_sharded_training(tmp_path):
     assert results[0]["n_trees"] == len(bst.gbm.trees)
     # identical base score on every rank (fit_stump GlobalSum)
     assert results[0]["base"] == pytest.approx(results[1]["base"], abs=1e-6)
+    # distributed metrics: both ranks computed the identical GLOBAL eval
+    # line from shard-local labels (GlobalRatio + exact AUC merge)
+    assert results[0]["eval_line"] == results[1]["eval_line"]
+    assert "train-logloss" in results[0]["eval_line"]
+    assert "train-auc" in results[0]["eval_line"]
     # sharded cuts differ slightly from single-host cuts (distributed sketch
     # merge), so trees can route borderline rows differently — demand close
     # agreement, not bitwise equality

@@ -340,7 +340,6 @@ class DMatrix:
         labels, weights, margins, qids = [], [], [], []
         lbound, ubound = [], []
         summaries = None
-        sketch_rows_used = 0
         n_rows = 0
         n_feat = 0
         has_missing = False
@@ -359,24 +358,26 @@ class DMatrix:
             if batch.get("qid") is not None:
                 qids.append(np.asarray(batch["qid"]))
             if need_sketch:
-                # strided subsample against the same global budget as
-                # sketch_matrix's SKETCH_SAMPLE_ROWS (the sketch is
-                # approximate by design; per-feature numpy sorts dominate
-                # iterator construction at scale — 41 s for 11M x 28
-                # unsampled). Weighted rows are never subsampled: dropping
-                # a heavily weighted row would starve its bin resolution.
+                # strided subsample PER BATCH (cap = SKETCH_SAMPLE_ROWS/4):
+                # the sketch is approximate by design and per-feature numpy
+                # sorts dominate iterator construction at scale (41 s for
+                # 11M x 28 unsampled). A per-batch cap — rather than a
+                # global budget consumed in stream order — keeps every
+                # batch contributing equally, so time-ordered streams with
+                # distribution drift keep bin resolution over their whole
+                # range; the cost is that long streams sample more total
+                # rows than the resident path would (each batch's sort is
+                # still capped, which is what the limit is for). Weighted
+                # batches are never subsampled: dropping a heavily
+                # weighted row would starve its bin resolution.
                 from .quantile import SKETCH_SAMPLE_ROWS
 
                 bw = batch.get("weight")
                 Xs = X
                 ws = None if bw is None else np.asarray(bw, np.float64)
-                budget_left = (SKETCH_SAMPLE_ROWS - sketch_rows_used
-                               if SKETCH_SAMPLE_ROWS else 0)
-                if bw is None and SKETCH_SAMPLE_ROWS \
-                        and X.shape[0] > max(budget_left, 1):
-                    stride = -(-X.shape[0] // max(budget_left, 1))
-                    Xs = X[::stride]
-                sketch_rows_used += Xs.shape[0]
+                cap = SKETCH_SAMPLE_ROWS // 4 if SKETCH_SAMPLE_ROWS else 0
+                if bw is None and cap and X.shape[0] > cap:
+                    Xs = X[:: -(-X.shape[0] // cap)]
                 batch_s = [FeatureSummary.from_data(Xs[:, f], ws)
                            for f in range(Xs.shape[1])]
                 if summaries is None:
