@@ -19,6 +19,9 @@ _C_PROGRAM = r"""
 #include <stdint.h>
 
 typedef void* BoosterHandle;
+#ifdef __cplusplus
+extern "C" {
+#endif
 extern const char* XGBGetLastError(void);
 extern int XGBoosterCreate(const void*, int, BoosterHandle*);
 extern int XGBoosterFree(BoosterHandle);
@@ -26,6 +29,9 @@ extern int XGBoosterLoadModel(BoosterHandle, const char*);
 extern int XGBoosterBoostedRounds(BoosterHandle, int*);
 extern int XGBoosterPredictFromDense(BoosterHandle, const float*, uint64_t,
                                      uint64_t, float, int, float*);
+#ifdef __cplusplus
+}
+#endif
 
 int main(int argc, char** argv) {
   BoosterHandle h;
