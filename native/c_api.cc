@@ -336,6 +336,14 @@ Model load_model_json(const std::string& text) {
   }
   if (!trees) throw std::runtime_error("model: no trees");
   for (const auto& jt : trees->arr) {
+    if (const JValue* tp = jt.get("tree_param")) {
+      if (const JValue* slv = tp->get("size_leaf_vector")) {
+        if (slv->as_num() > 1)
+          throw std::runtime_error(
+              "vector-leaf (multi_output_tree) models are not supported by "
+              "the C scoring ABI yet");
+      }
+    }
     Tree t = parse_tree_common(jt);
     if (m.ref_semantics) {
       parse_ref_categories(jt, &t);

@@ -106,15 +106,29 @@ def _flatten_objective(objective: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _convert_tree_multi(t: Dict[str, Any], n_targets: int) -> Dict[str, Any]:
+    """Reference vector-leaf tree (``MultiTargetTree::SaveModel``,
+    src/tree/multi_target_tree_model.cc:98 — thresholds in
+    ``split_conditions`` for every node, node weights FLAT
+    [n_nodes * K] in ``base_weights``, no stats arrays) -> our native
+    multi-target tree dict (``MultiTargetTreeModel.to_json`` layout)."""
+    out = _convert_tree(t)
+    n = len(out["left_children"])
+    bw = np.asarray([_f(x) for x in t["base_weights"]],
+                    np.float64).reshape(n, n_targets)
+    out["n_targets"] = n_targets
+    out["base_weights"] = bw.tolist()
+    out["leaf_values"] = bw.tolist()  # leaf rows ARE the node weights
+    return out
+
+
 def _gbtree_payload(gb: Dict[str, Any]) -> Dict[str, Any]:
     model = gb["model"]
-    trees = [_convert_tree(t) for t in model["trees"]]
-    for t, ref in zip(trees, model["trees"]):
+    trees = []
+    for ref in model["trees"]:
         slv = int(ref.get("tree_param", {}).get("size_leaf_vector", 1) or 1)
-        if slv > 1:
-            raise NotImplementedError(
-                "vector-leaf (multi_output_tree) reference models are not "
-                "supported yet")
+        trees.append(_convert_tree_multi(ref, slv) if slv > 1
+                     else _convert_tree(ref))
     mp = model.get("gbtree_model_param", {})
     n_trees = len(trees)
     indptr = [int(x) for x in model.get("iteration_indptr", [])]
@@ -124,7 +138,9 @@ def _gbtree_payload(gb: Dict[str, Any]) -> Dict[str, Any]:
     return {
         "name": "gbtree",
         "num_parallel_tree": int(mp.get("num_parallel_tree", 1) or 1),
-        "multi_strategy": "one_output_per_tree",
+        "multi_strategy": ("multi_output_tree"
+                           if any("n_targets" in t for t in trees)
+                           else "one_output_per_tree"),
         "trees": trees,
         "tree_info": [int(x) for x in model.get("tree_info", [0] * n_trees)],
         "iteration_indptr": indptr,
@@ -240,6 +256,42 @@ def _objective_to_reference(obj, learner_params: Dict[str, Any],
                 s("aft_loss_distribution_scale", 1.0)}}
     return {"name": name}
 
+def _multi_tree_to_reference(t, num_feature: int) -> Dict[str, Any]:
+    """Our MultiTargetTreeModel -> reference vector-leaf tree JSON
+    (``MultiTargetTree::SaveModel``: thresholds for every node in
+    split_conditions, node weights flat [n * K] in base_weights)."""
+    n = t.num_nodes()
+    K = t.n_targets
+    conds = np.where(
+        t.is_leaf, 0.0,
+        np.nextafter(t.split_value.astype(np.float32), np.float32("inf"))
+        .astype(np.float64))
+    bw = np.where(t.is_leaf[:, None], t.leaf_value,
+                  t.base_weight).astype(np.float64)
+    return {
+        "tree_param": {"num_nodes": str(n), "num_feature": str(num_feature),
+                       "size_leaf_vector": str(K), "num_deleted": "0"},
+        "id": 0,
+        "left_children": t.left_child.tolist(),
+        "right_children": t.right_child.tolist(),
+        "parents": [int(p) if p >= 0 else 2147483647 for p in t.parent],
+        "split_indices": [int(max(f, 0)) for f in t.split_feature],
+        "split_conditions": conds.tolist(),
+        "split_type": [0] * n,
+        "default_left": [int(d) for d in t.default_left],
+        "base_weights": bw.reshape(-1).tolist(),
+        # the reference's vector-leaf writer omits the stats arrays, but
+        # doc/model.schema requires them on every tree — emit them so
+        # exports validate (the reference loader ignores them here)
+        "loss_changes": t.gain.astype(np.float64).tolist(),
+        "sum_hessian": t.sum_hess.astype(np.float64).tolist(),
+        "categories": [],
+        "categories_nodes": [],
+        "categories_segments": [],
+        "categories_sizes": [],
+    }
+
+
 def _tree_to_reference(t, num_feature: int) -> Dict[str, Any]:
     n = t.num_nodes()
     is_leaf = t.is_leaf
@@ -303,9 +355,13 @@ def native_to_reference_json(booster) -> Dict[str, Any]:
             "name": "gblinear",
             "model": {"weights": flat.astype(np.float64).tolist()}}
     elif isinstance(gbm, GBTree):
+        from .tree.multi import MultiTargetTreeModel
+
         trees = []
         for i, t in enumerate(gbm.trees):
-            tj = _tree_to_reference(t, nf)
+            tj = (_multi_tree_to_reference(t, nf)
+                  if isinstance(t, MultiTargetTreeModel)
+                  else _tree_to_reference(t, nf))
             tj["id"] = i
             trees.append(tj)
         model = {
@@ -332,6 +388,18 @@ def native_to_reference_json(booster) -> Dict[str, Any]:
     user = np.asarray(obj.pred_transform(
         jnp.asarray(margin, jnp.float32)[None, :])).reshape(-1)
     base_score = float(user[0])
+    if n_groups > 1 and not np.allclose(np.asarray(margin),
+                                        np.asarray(margin).reshape(-1)[0]):
+        # the reference file format carries a SCALAR base_score; a
+        # per-target intercept (our multi-target fit_stump default) cannot
+        # cross the schema — train with an explicit base_score for exact
+        # interop
+        import warnings
+
+        warnings.warn(
+            "exporting a model with per-target base scores to the "
+            "reference schema keeps only target 0's value; set an explicit "
+            "scalar base_score for exact round-trips", stacklevel=2)
 
     return {
         "version": [2, 0, 0],
