@@ -6,8 +6,12 @@ scatter, so the kernel keeps the histogram-as-matmul formulation but fuses
 everything XLA would materialise:
 
 - the per-feature bin one-hot is built directly in its transposed (MXU-ready)
-  ``[B, R]`` layout in a VMEM scratch from a ``[F, n]`` bin matrix and never
-  touches HBM; a whole feature block ``[Fb*B, R]`` feeds ONE large MXU matmul;
+  ``[B, R]`` layout in VMEM from a ``[F, n]`` bin matrix and never touches
+  HBM. The default int8x2 kernel interleaves build and contraction
+  per-feature so Mosaic pipelines the VPU one-hot of feature f+1 against
+  the MXU dot of feature f (staging a whole ``[Fb*B, R]`` block for one
+  big matmul — still used by the f32/bf16 variants — serialises the two
+  units and measured 1.7x slower);
 - the node-scatter matrix ``P^T [2N, R]`` (rows scattered to their tree node,
   times (g, h)) is built once per row block and shared by every feature;
 - the accumulator ``[Fb, B, 2N]`` lives in VMEM across the row-block grid axis
@@ -112,7 +116,7 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
     column tile), so multi-target histograms intentionally loop targets."""
     B, N, R, Fb = n_bins, n_nodes, block_rows, n_feat_block
 
-    def kernel(bins_ref, q_ref, pos_ref, out_ref, oh_scratch):
+    def kernel(bins_ref, q_ref, pos_ref, out_ref):
         i = pl.program_id(1)
 
         @pl.when(i == 0)
@@ -140,17 +144,20 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
         # build_hist_prehot — the one-hot operand feed dominates)
         PT4 = jnp.concatenate([g_hi, h_hi, g_lo, h_lo], axis=0)  # [4N, R] i8
 
+        # Per-FEATURE one-hot + dot (not one big [Fb*B, R] staged matmul):
+        # Mosaic pipelines the VPU one-hot build of feature f+1 against the
+        # MXU dot of feature f, overlapping the kernel's two bound units —
+        # measured 8.3 -> ~4.8 ms/level at 1M x 28 x 256 on v5e.
         bin_iota = jax.lax.broadcasted_iota(jnp.int32, (B, R), 0)
         for f in range(Fb):
             row = bins_ref[f:f + 1, :].astype(jnp.int32)   # [1, R]
-            oh_scratch[f * B:(f + 1) * B, :] = (
-                bin_iota == row).astype(jnp.int8)
-        acc4 = jax.lax.dot_general(
-            oh_scratch[:], PT4, _CONTRACT_LAST,
-            preferred_element_type=jnp.int32)              # [Fb*B, 4N]
-        acc = (acc4[:, : 2 * N].astype(jnp.float32) * 256.0
-               + acc4[:, 2 * N:].astype(jnp.float32))
-        out_ref[:] += acc.reshape(Fb, B, 2 * N)
+            oh = (bin_iota == row).astype(jnp.int8)        # [B, R]
+            acc4 = jax.lax.dot_general(
+                oh, PT4, _CONTRACT_LAST,
+                preferred_element_type=jnp.int32)          # [B, 4N]
+            acc = (acc4[:, : 2 * N].astype(jnp.float32) * 256.0
+                   + acc4[:, 2 * N:].astype(jnp.float32))
+            out_ref[f] += acc
 
     return kernel
 
@@ -221,7 +228,7 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
             grid=grid,
             in_specs=[bins_spec, vec2_spec, pos_spec],
             out_specs=out_spec,
-            scratch_shapes=[pltpu.VMEM((F_blk * B, R), jnp.int8)],
+            scratch_shapes=[],
             interpret=interpret,
         )(bins_t, q, pos_t)
         # columns [0:N] hold g-sums, [N:2N] h-sums -> per-component dequant

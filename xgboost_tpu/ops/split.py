@@ -8,7 +8,8 @@ formulation: because the histogram carries an explicit per-feature missing slot
 (data/binned.py), both missing directions come from ONE cumulative sum —
 ``left = cumsum(present)`` for missing-right and ``left + missing`` for
 missing-left — instead of two scans. Categorical features reuse the same dense
-[nodes, features, bins, dirs] gain tensor: one-hot treats each category as the
+[nodes, features, dirs, bins] gain tensor (bin axis MINOR — see the layout
+note in evaluate_splits): one-hot treats each category as the
 right child; sorted-partition sorts categories by g/(h+lambda) and scans
 prefixes (the winning prefix is packed into a uint32 bitmask in-kernel).
 Everything ends in a flat argmax per node: pure VPU work that XLA fuses.
@@ -72,59 +73,68 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     weights clamped into the node's [node_lower, node_upper] interval and
     sign-violating splits are rejected (reference ``TreeEvaluator``,
     ``src/tree/split_evaluator.h:28``)."""
+    # LAYOUT NOTE: every dense plane here keeps the BIN axis minor
+    # ([N, F, dirs, bins] / [N, F, dirs, 2, bins]). With the (dirs, 2) pair
+    # minor instead, XLA tiles each (8, 128) vector register around 1-2
+    # valid elements — a 64x physical blow-up that made this function cost
+    # 22 ms/round at depth 6 (profiled; see docs/performance.md).
     N, F, B, _ = hist.shape
     nb = B - 1 if has_missing else B                      # real-bin slots
-    present = hist[:, :, :nb, :]                          # [N,F,nb,2]
+    # [N, F, 2, nb]: (g,h) ahead of the bin axis
+    present = jnp.moveaxis(hist[:, :, :nb, :], 3, 2)
     if has_missing:
         miss = hist[:, :, B - 1, :]                       # [N,F,2]
     else:
         miss = jnp.zeros(hist.shape[:2] + (2,), hist.dtype)
-    cum = jnp.cumsum(present, axis=2)                     # left sums, missing->right
-    parent = parent_sum[:, None, None, :]
+    cum = jnp.cumsum(present, axis=3)                     # left sums, missing->right
+    parent5 = parent_sum[:, None, None, :, None]          # [N,1,1,2,1]
     bins_idx = jnp.arange(nb, dtype=jnp.int32)
 
     # dir 0 = missing right (default_left=False), dir 1 = missing left;
     # without missing values both directions coincide, so only dir 0 is built
     n_dirs = 2 if has_missing else 1
-    dir_stack = [cum, cum + miss[:, :, None, :]][:n_dirs]
-    left = jnp.stack(dir_stack, axis=3)                   # [N,F,nb,dirs,2]
-    base_valid = bins_idx[None, :, None] < n_real_bins[:, None, None]  # [F,nb,1]
-    base_valid = jnp.broadcast_to(base_valid[None], (N, F, nb, n_dirs))
+    dir_stack = [cum, cum + miss[:, :, :, None]][:n_dirs]
+    left = jnp.stack(dir_stack, axis=2)                   # [N,F,dirs,2,nb]
+    base_valid = bins_idx[None, None, :] < n_real_bins[:, None, None]  # [F,1,nb]
+    base_valid = jnp.broadcast_to(base_valid[None], (N, F, n_dirs, nb))
 
     if cat is not None:
-        ic4 = cat.is_cat[None, :, None, None]          # vs [N,F,B-1,2dir]
-        ic5 = cat.is_cat[None, :, None, None, None]    # vs [N,F,B-1,2dir,2]
+        ic4 = cat.is_cat[None, :, None, None]          # vs [N,F,dirs,nb]
+        ic5 = cat.is_cat[None, :, None, None, None]    # vs [N,F,dirs,2,nb]
         oh4 = cat.is_onehot[None, :, None, None]
         oh5 = cat.is_onehot[None, :, None, None, None]
         # sorted-partition order: categories ascending by g/(h+lambda)
         # (reference evaluator sorts by weight, evaluate_splits.h:146)
-        ratio = present[..., 0] / (present[..., 1] + param.reg_lambda + 1e-10)
-        empty = present[..., 1] <= 0.0
+        ratio = present[:, :, 0] / (present[:, :, 1] + param.reg_lambda + 1e-10)
+        empty = present[:, :, 1] <= 0.0
         ratio = jnp.where(empty, jnp.inf, ratio)  # empty cats sort last
         order = jnp.argsort(ratio, axis=2)                       # [N,F,nb]
         ranks = jnp.argsort(order, axis=2).astype(jnp.int32)
-        sorted_hist = jnp.take_along_axis(present, order[..., None], axis=2)
-        cums = jnp.cumsum(sorted_hist, axis=2)
+        sorted_hist = jnp.take_along_axis(present, order[:, :, None, :],
+                                          axis=3)
+        cums = jnp.cumsum(sorted_hist, axis=3)
         left_sorted = jnp.stack(
-            [cums, cums + miss[:, :, None, :]][:n_dirs], axis=3)
+            [cums, cums + miss[:, :, :, None]][:n_dirs], axis=2)
         # one-hot: right child = {category c}; missing follows the default
         # direction: dir 0 -> left = parent - hist[c] - miss (missing right),
         # dir 1 -> left = parent - hist[c] (missing left)
-        left_oh = jnp.stack(
-            [parent - miss[:, :, None, :] - present,
-             parent - present][:n_dirs], axis=3)
+        present5 = present[:, :, None, :, :]              # [N,F,1,2,nb]
+        miss5 = miss[:, :, None, :, None]                 # [N,F,1,2,1]
+        left_oh = jnp.concatenate(
+            [parent5 - miss5 - present5,
+             parent5 - present5][:n_dirs], axis=2)
         left = jnp.where(ic5, jnp.where(oh5, left_oh, left_sorted), left)
         # validity: sorted prefixes capped by max_cat_threshold
         cat_valid = jnp.where(
             oh4, base_valid,
-            base_valid & (bins_idx[None, None, :, None]
+            base_valid & (bins_idx[None, None, None, :]
                           < param.max_cat_threshold))
         base_valid = jnp.where(ic4, cat_valid, base_valid)
 
-    right = parent[..., None, :] - left
+    right = parent5 - left
 
-    lg, lh = left[..., 0], left[..., 1]
-    rg, rh = right[..., 0], right[..., 1]
+    lg, lh = left[:, :, :, 0, :], left[:, :, :, 1, :]     # [N,F,dirs,nb]
+    rg, rh = right[:, :, :, 0, :], right[:, :, :, 1, :]
     if monotone is None:
         pgain = calc_gain(parent_sum[:, 0], parent_sum[:, 1], param)  # [N]
         loss_chg = (calc_gain(lg, lh, param) + calc_gain(rg, rh, param)
@@ -154,16 +164,21 @@ def evaluate_splits(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         valid = valid & fm[:, :, None, None]
     loss_chg = jnp.where(valid, loss_chg, -jnp.inf)
 
+    # flat layout (f, d, b); ties resolve to the lowest flat index, which
+    # prefers missing-right then lower bins — same preference order as the
+    # previous (f, b, d) layout for the common single-direction case
     flat = loss_chg.reshape(N, -1)
     best = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
     f_idx = (best // (nb * n_dirs)).astype(jnp.int32)
     rem = best % (nb * n_dirs)
-    b_idx = (rem // n_dirs).astype(jnp.int32)
-    d_idx = (rem % n_dirs).astype(jnp.int32)
+    d_idx = (rem // nb).astype(jnp.int32)
+    b_idx = (rem % nb).astype(jnp.int32)
 
     nn = jnp.arange(N)
-    best_left = left[nn, f_idx, b_idx, d_idx]             # [N,2]
+    best_left = jnp.stack(
+        [left[nn, f_idx, d_idx, 0, b_idx],
+         left[nn, f_idx, d_idx, 1, b_idx]], axis=1)       # [N,2]
     best_right = parent_sum - best_left
 
     if cat is None:
@@ -212,34 +227,38 @@ def evaluate_splits_multi(hist: jnp.ndarray, parent_sum: jnp.ndarray,
 
     hist: [N, F, B, K, 2] per-target (g, h) sums; parent_sum: [N, K, 2].
     """
+    # same LAYOUT NOTE as evaluate_splits: keep the bin axis MINOR — the
+    # (K, 2) pair in the minor position tiles vector registers around a
+    # handful of valid elements
     N, F, B, K, _ = hist.shape
     nb = B - 1 if has_missing else B
-    present = hist[:, :, :nb]                              # [N,F,nb,K,2]
+    # [N, F, K, 2, nb]
+    present = jnp.moveaxis(hist[:, :, :nb], 2, 4)
     if has_missing:
         miss = hist[:, :, B - 1]                           # [N,F,K,2]
     else:
         miss = jnp.zeros((N, F, K, 2), hist.dtype)
-    cum = jnp.cumsum(present, axis=2)
-    parent = parent_sum[:, None, None, :, :]               # [N,1,1,K,2]
+    cum = jnp.cumsum(present, axis=4)
     bins_idx = jnp.arange(nb, dtype=jnp.int32)
 
     n_dirs = 2 if has_missing else 1
-    left = jnp.stack([cum, cum + miss[:, :, None]][:n_dirs],
-                     axis=3)                               # [N,F,nb,dirs,K,2]
-    right = parent[..., None, :, :] - left
+    left = jnp.stack([cum, cum + miss[..., None]][:n_dirs],
+                     axis=2)                               # [N,F,dirs,K,2,nb]
+    parent6 = parent_sum[:, None, None, :, :, None]        # [N,1,1,K,2,1]
+    right = parent6 - left
 
-    lg, lh = left[..., 0], left[..., 1]                    # [N,F,nb,dirs,K]
-    rg, rh = right[..., 0], right[..., 1]
+    lg, lh = left[..., 0, :], left[..., 1, :]              # [N,F,dirs,K,nb]
+    rg, rh = right[..., 0, :], right[..., 1, :]
     pgain = jnp.sum(calc_gain(parent_sum[..., 0], parent_sum[..., 1], param),
                     axis=1)                                # [N]
-    loss_chg = (jnp.sum(calc_gain(lg, lh, param), axis=4)
-                + jnp.sum(calc_gain(rg, rh, param), axis=4)
-                - pgain[:, None, None, None])              # [N,F,nb,dirs]
+    loss_chg = (jnp.sum(calc_gain(lg, lh, param), axis=3)
+                + jnp.sum(calc_gain(rg, rh, param), axis=3)
+                - pgain[:, None, None, None])              # [N,F,dirs,nb]
 
-    base_valid = bins_idx[None, :, None] < n_real_bins[:, None, None]
-    valid = jnp.broadcast_to(base_valid[None], (N, F, nb, n_dirs)) \
-        & (jnp.sum(lh, axis=4) >= param.min_child_weight) \
-        & (jnp.sum(rh, axis=4) >= param.min_child_weight)
+    base_valid = bins_idx[None, None, :] < n_real_bins[:, None, None]
+    valid = jnp.broadcast_to(base_valid[None], (N, F, n_dirs, nb)) \
+        & (jnp.sum(lh, axis=3) >= param.min_child_weight) \
+        & (jnp.sum(rh, axis=3) >= param.min_child_weight)
     if feature_mask is not None:
         fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
         valid = valid & fm[:, :, None, None]
@@ -250,11 +269,13 @@ def evaluate_splits_multi(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
     f_idx = (best // (nb * n_dirs)).astype(jnp.int32)
     rem = best % (nb * n_dirs)
-    b_idx = (rem // n_dirs).astype(jnp.int32)
-    d_idx = (rem % n_dirs).astype(jnp.int32)
+    d_idx = (rem // nb).astype(jnp.int32)
+    b_idx = (rem % nb).astype(jnp.int32)
 
     nn = jnp.arange(N)
-    best_left = left[nn, f_idx, b_idx, d_idx]              # [N,K,2]
+    # [N,F,dirs,K,2,nb] -> advanced indices (nn, f, d, b) with slices at
+    # (K, 2): separated advanced indices put the broadcast dim first
+    best_left = jnp.moveaxis(left, 5, 3)[nn, f_idx, d_idx, b_idx]  # [N,K,2]
     best_right = parent_sum - best_left
     return MultiSplitResult(
         gain=best_gain, feature=f_idx, bin=b_idx,
