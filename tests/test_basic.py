@@ -171,3 +171,31 @@ def test_fused_round_matches_general_path():
         b2.update(xgb.DMatrix(X, label=y) if i == 0 else dm2, i)
         dm2 = list(b2._caches.values())[0]["dm"]
     assert bytes(b1.save_raw("json")) == bytes(b2.save_raw("json"))
+
+
+def test_round_batching_matches_sequential():
+    """train() batches fused rounds K-per-dispatch when nothing consumes
+    per-round output; the model must be identical to per-round updates
+    (same PRNG stream, same numerics — lax.scan over the same body)."""
+    import xgboost_tpu.callback as cb
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(3000, 7).astype(np.float32)
+    y = (X @ rng.randn(7) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "subsample": 0.8, "colsample_bytree": 0.8}
+
+    b_batched = xgb.train(params, xgb.DMatrix(X, label=y), 11,
+                          verbose_eval=False)
+    # a no-op callback forces the per-round path
+    b_seq = xgb.train(params, xgb.DMatrix(X, label=y), 11,
+                      verbose_eval=False,
+                      callbacks=[cb.TrainingCallback()])
+
+    assert len(b_batched.gbm.trees) == len(b_seq.gbm.trees) == 11
+    for ta, tb in zip(b_batched.gbm.trees, b_seq.gbm.trees):
+        np.testing.assert_array_equal(ta.split_feature, tb.split_feature)
+        np.testing.assert_array_equal(ta.split_bin, tb.split_bin)
+        np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value)
+    dm = xgb.DMatrix(X)
+    np.testing.assert_array_equal(b_batched.predict(dm), b_seq.predict(dm))

@@ -52,14 +52,21 @@ def sample_gradients(gp: jnp.ndarray, tkey: jax.Array,
 
 
 class _PendingTree:
-    """A grown tree whose per-node arrays still live on device."""
+    """A grown tree whose per-node arrays still live on device.
 
-    __slots__ = ("arrays", "grower")
+    ``index`` marks a tree inside a round-batched grow (core.update_batch):
+    its ``arrays`` dict is SHARED with its batch siblings and every leaf
+    carries a leading [K] axis — _flush fetches the dict once and slices
+    host-side, so a K-round batch still costs one device round trip."""
 
-    def __init__(self, grown, grower) -> None:
-        self.arrays = {f: getattr(grown, f) for f in _GROWN_FIELDS
-                       if hasattr(grown, f)}
+    __slots__ = ("arrays", "grower", "index")
+
+    def __init__(self, grown, grower, arrays=None, index=None) -> None:
+        self.arrays = arrays if arrays is not None else {
+            f: getattr(grown, f) for f in _GROWN_FIELDS
+            if hasattr(grown, f)}
         self.grower = grower
+        self.index = index
 
 
 class _HostGrown:
@@ -124,8 +131,17 @@ class GBTree:
                    if isinstance(t, _PendingTree)]
         if not pending:
             return
-        host = jax.device_get([t.arrays for _, t in pending])
-        for (i, t), arrs in zip(pending, host):
+        # round-batched trees share one stacked-array dict — fetch each
+        # distinct dict once, then slice host-side
+        unique: dict = {}
+        for _, t in pending:
+            unique.setdefault(id(t.arrays), t.arrays)
+        fetched = dict(zip(unique.keys(),
+                           jax.device_get(list(unique.values()))))
+        for i, t in pending:
+            arrs = fetched[id(t.arrays)]
+            if t.index is not None:
+                arrs = {k: v[t.index] for k, v in arrs.items()}
             self._trees[i] = t.grower.to_tree_model(_HostGrown(arrs))
 
     # -- training -------------------------------------------------------------

@@ -54,23 +54,14 @@ _LEARNER_KEYS = {
 import functools as _functools
 
 
-@_functools.partial(
-    jax.jit,
-    static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
-                     "hist_method", "has_missing"))
-def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
-                    monotone, constraint_sets, cat, *,
-                    obj_cls, obj_params, param, max_nbins, hist_method,
-                    has_missing):
-    """One boosting round (gradient -> grow -> margin update) as a single
-    compiled program. Module-level so the compile cache is shared across
-    Booster instances; PRNG key folding replicates ``do_boost`` exactly so
-    fused and general paths produce identical models.
-
-    ``seed``/``iteration`` arrive as traced scalars and the key is derived
-    INSIDE the program: deriving it eagerly cost two extra device dispatches
-    per round, which is material against a remote TPU (the tunnel adds tens
-    of ms of enqueue latency per eager op)."""
+def _fused_round_body(margin, seed, iteration, bins, labels, weights,
+                      n_real, monotone, constraint_sets, cat, *,
+                      obj_cls, obj_params, param, max_nbins, hist_method,
+                      has_missing):
+    """The ONE fused round: gradient -> sample -> colsample -> grow ->
+    margin update. Shared verbatim by the single-round and round-batched
+    jits — the fold_in constants (0, 0xC0, 0x5EED) define the PRNG stream
+    that keeps fused, batched, and general paths model-identical."""
     import types
 
     from .tree.grow import _grow, _sample_features
@@ -94,6 +85,60 @@ def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
                   hist_method=hist_method, axis_name=None,
                   has_missing=has_missing)
     return margin + grown.delta[:, None], grown
+
+
+@_functools.partial(
+    jax.jit,
+    static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
+                     "hist_method", "has_missing"))
+def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
+                    monotone, constraint_sets, cat, *,
+                    obj_cls, obj_params, param, max_nbins, hist_method,
+                    has_missing):
+    """One boosting round as a single compiled program. Module-level so the
+    compile cache is shared across Booster instances.
+
+    ``seed``/``iteration`` arrive as traced scalars and the key is derived
+    INSIDE the program: deriving it eagerly cost two extra device dispatches
+    per round, which is material against a remote TPU (the tunnel adds tens
+    of ms of enqueue latency per eager op)."""
+    return _fused_round_body(
+        margin, seed, iteration, bins, labels, weights, n_real, monotone,
+        constraint_sets, cat, obj_cls=obj_cls, obj_params=obj_params,
+        param=param, max_nbins=max_nbins, hist_method=hist_method,
+        has_missing=has_missing)
+
+
+@_functools.partial(
+    jax.jit,
+    static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
+                     "hist_method", "has_missing"))
+def _fused_multi_round_fn(bins, margin, labels, weights, n_real, seeds,
+                          iterations, monotone, constraint_sets, cat, *,
+                          obj_cls, obj_params, param, max_nbins, hist_method,
+                          has_missing):
+    """K boosting rounds as ONE dispatch (``lax.scan`` over the shared
+    round body — byte-identical numerics to K sequential
+    ``_fused_round_fn`` calls), batching away per-dispatch host/enqueue
+    latency when nothing consumes per-round output.
+
+    seeds/iterations: [K] arrays. Returns (margin, dict of per-NODE tree
+    arrays stacked on a leading [K] axis — the per-ROW positions/delta are
+    deliberately NOT stacked: [K, n] outputs would cost hundreds of MB at
+    10M-row scale for data the caller never reads)."""
+    from .boosting.gbtree import _GROWN_FIELDS
+
+    def body(m, si):
+        seed, it = si
+        new_margin, grown = _fused_round_body(
+            m, seed, it, bins, labels, weights, n_real, monotone,
+            constraint_sets, cat, obj_cls=obj_cls, obj_params=obj_params,
+            param=param, max_nbins=max_nbins, hist_method=hist_method,
+            has_missing=has_missing)
+        node_arrays = {f: getattr(grown, f) for f in _GROWN_FIELDS}
+        return new_margin, node_arrays
+
+    return jax.lax.scan(body, margin, (seeds, iterations))
 
 
 class Booster:
@@ -121,6 +166,7 @@ class Booster:
         # training DMatrix produces a different state dict and forces rebind
         self._fused_round = None
         self._fused_blocked = False
+        self._batch_blocked = False
         self._caches: Dict[int, Dict[str, Any]] = {}
         self._eval_metrics: List = []
         self._explicit_params: set = set()
@@ -521,49 +567,14 @@ class Booster:
         per-round op chain. Returns False when the configuration needs the
         general path; numerics and PRNG key derivation replicate do_boost
         exactly, so fused and unfused runs produce identical models."""
+        binding = self._fused_binding(state)
+        if binding is None:
+            return False
+        obj_params, grower, labels, weights, n_real = binding
+        binned = state["binned"]
         gbm = self.gbm
-        if (self._fused_blocked or type(gbm) is not GBTree
-                or not gbm.supports_margin_cache
-                or gbm.tree_method in ("approx", "exact")
-                or gbm.num_parallel_tree != 1 or gbm.n_groups != 1
-                or gbm.split_mode != "row"
-                or self.tree_param.grow_policy != "depthwise"
-                or self.tree_param.max_leaves > 0
-                or hasattr(self.obj, "update_tree_leaf")
-                or state.get("binned") is None
-                or getattr(state.get("binned"), "is_paged", False)
-                or self.ctx.mesh is not None
-                or observer.enabled()):
-            return False
-        from .objective.base import Objective
-
-        # custom get_gradient overrides may be host-side or
-        # iteration-dependent (lambdarank pair sampling) — general path
-        if type(self.obj).get_gradient is not Objective.get_gradient:
-            return False
         from .boosting.gbtree import _PendingTree
 
-        binned = state["binned"]
-        if self._fused_round is None or self._fused_round[0] is not state:
-            # (re)bind to THIS training cache — a different dtrain gets
-            # fresh labels/weights/bins; set_param resets this cache too
-            scalars = {k: v for k, v in self.obj.params.items()
-                       if k != "eval_metric"}  # metric list: not a gradient
-                       # input, never read by any objective
-            if not all(isinstance(v, (int, float, str, bool))
-                       for v in scalars.values()):
-                self._fused_blocked = True  # non-scalar objective params
-                return False                # can't be static jit args
-            obj_params = tuple(sorted(scalars.items()))
-            grower = gbm._grower_for(binned)
-            info = state["info"]
-            self._fused_round = (
-                state, obj_params, grower,
-                jnp.asarray(info.labels, jnp.float32),
-                None if info.weights is None
-                else jnp.asarray(info.weights, jnp.float32),
-                binned.n_real_bins())
-        _, obj_params, grower, labels, weights, n_real = self._fused_round
         try:
             new_margin, grown = _fused_round_fn(
                 binned.bins, state["margin"], labels, weights, n_real,
@@ -582,6 +593,103 @@ class Booster:
         gbm._trees.append(_PendingTree(grown, grower))
         gbm.tree_info.append(0)
         gbm.iteration_indptr.append(len(gbm._trees))
+        state["margin"] = new_margin
+        state["n_trees"] = gbm.version()
+        return True
+
+    def _fused_binding(self, state: Dict[str, Any]):
+        """Eligibility + cache binding shared by the single-round and the
+        round-batched fused paths; None -> use the general path."""
+        gbm = self.gbm
+        if (self._fused_blocked or type(gbm) is not GBTree
+                or not gbm.supports_margin_cache
+                or gbm.tree_method in ("approx", "exact")
+                or gbm.num_parallel_tree != 1 or gbm.n_groups != 1
+                or gbm.split_mode != "row"
+                or self.tree_param.grow_policy != "depthwise"
+                or self.tree_param.max_leaves > 0
+                or hasattr(self.obj, "update_tree_leaf")
+                or state.get("binned") is None
+                or getattr(state.get("binned"), "is_paged", False)
+                or self.ctx.mesh is not None
+                or observer.enabled()):
+            return None
+        from .objective.base import Objective
+
+        # custom get_gradient overrides may be host-side or
+        # iteration-dependent (lambdarank pair sampling) — general path
+        if type(self.obj).get_gradient is not Objective.get_gradient:
+            return None
+        binned = state["binned"]
+        if self._fused_round is None or self._fused_round[0] is not state:
+            # (re)bind to THIS training cache — a different dtrain gets
+            # fresh labels/weights/bins; set_param resets this cache too
+            scalars = {k: v for k, v in self.obj.params.items()
+                       if k != "eval_metric"}  # metric list: not a gradient
+                       # input, never read by any objective
+            if not all(isinstance(v, (int, float, str, bool))
+                       for v in scalars.values()):
+                self._fused_blocked = True  # non-scalar objective params
+                return None                 # can't be static jit args
+            obj_params = tuple(sorted(scalars.items()))
+            grower = gbm._grower_for(binned)
+            info = state["info"]
+            self._fused_round = (
+                state, obj_params, grower,
+                jnp.asarray(info.labels, jnp.float32),
+                None if info.weights is None
+                else jnp.asarray(info.weights, jnp.float32),
+                binned.n_real_bins())
+        return self._fused_round[1:]
+
+    def update_batch(self, dtrain: DMatrix, iterations: Sequence[int]) -> bool:
+        """Run ``len(iterations)`` fused boosting rounds as ONE device
+        dispatch (lax.scan over the fused round — numerics identical to
+        sequential ``update`` calls). Only valid when nothing consumes
+        per-round output (no evals/callbacks); the train() loop uses it
+        automatically in that case. Returns False when the configuration
+        needs the per-round path — the caller falls back to ``update``."""
+        self._configure(dtrain)
+        if self.tree_param.process_type == "update":
+            return False
+        if self._batch_blocked:
+            return False
+        state = self._state_of(dtrain, is_train=True)
+        if state["n_trees"] < self.gbm.version():
+            return False  # continuation bootstrap: update() folds old trees
+        binding = self._fused_binding(state)
+        if binding is None:
+            return False
+        obj_params, grower, labels, weights, n_real = binding
+        binned = state["binned"]
+        gbm = self.gbm
+        from .boosting.gbtree import _PendingTree
+
+        seeds = np.asarray([self.ctx.raw_seed(i) for i in iterations],
+                           np.uint32)
+        iters = np.asarray(list(iterations), np.int32)
+        try:
+            new_margin, growns = _fused_multi_round_fn(
+                binned.bins, state["margin"], labels, weights, n_real,
+                seeds, iters,
+                grower.monotone, grower.constraint_sets, grower.cat,
+                obj_cls=type(self.obj), obj_params=obj_params,
+                param=grower.param, max_nbins=grower.max_nbins,
+                hist_method=grower.hist_method,
+                has_missing=grower.has_missing)
+        except Exception:
+            logger.warning("batched fused rounds failed; falling back to "
+                           "per-round training", exc_info=True)
+            self._batch_blocked = True  # single-round fused path stays live
+            return False
+        # all K trees share ONE stacked-array dict; _flush fetches it once
+        # and slices host-side
+        stacked = growns
+        for k in range(len(iters)):
+            gbm._trees.append(
+                _PendingTree(None, grower, arrays=stacked, index=k))
+            gbm.tree_info.append(0)
+            gbm.iteration_indptr.append(len(gbm._trees))
         state["margin"] = new_margin
         state["n_trees"] = gbm.version()
         return True
@@ -1219,6 +1327,11 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
                            EvaluationMonitor)
 
     callbacks = list(callbacks) if callbacks else []
+    # Round batching: valid when NOTHING consumes per-round output. Decided
+    # on the USER-supplied callbacks — the EvaluationMonitor appended below
+    # is a no-op without evals, so it must not disable batching.
+    batchable = (not callbacks and not evals and obj is None
+                 and custom_metric is None and feval is None)
     if verbose_eval:
         period = 1 if verbose_eval is True else int(verbose_eval)
         callbacks.append(EvaluationMonitor(period=period))
@@ -1238,12 +1351,23 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
 
     bst = container.before_training(bst)
     start = bst.num_boosted_rounds()
-    for i in range(start, start + num_boost_round):
+    batch_k = 8
+    i = start
+    end = start + num_boost_round
+    while i < end:
+        if batchable and end - i >= 2:
+            k = min(batch_k, end - i)
+            if bst.update_batch(dtrain, list(range(i, i + k))):
+                i += k
+                continue
+            # config needs the per-round path (or a continuation bootstrap
+            # round) — fall through; retried next iteration
         if container.before_iteration(bst, i):
             break
         bst.update(dtrain, i, fobj=obj)
         if container.after_iteration(bst, i, list(evals)):
             break
+        i += 1
     bst = container.after_training(bst)
     bst._monitor.maybe_print()  # one cumulative table (reference: destructor)
 
