@@ -159,6 +159,155 @@ struct JParser {
   }
 };
 
+// ---------------------------------------------------------------- UBJSON ---
+// Minimal UBJSON reader (the reference's default binary model format,
+// written by its UBJWriter with strongly-typed arrays [$T#len...). Produces
+// the same JValue DOM as the JSON parser. Big-endian per the UBJSON spec.
+struct UbjParser {
+  const uint8_t* p;
+  const uint8_t* end;
+  UbjParser(const void* buf, size_t len)
+      : p(static_cast<const uint8_t*>(buf)),
+        end(static_cast<const uint8_t*>(buf) + len) {}
+
+  uint8_t take() {
+    if (p >= end) throw std::runtime_error("ubjson: unexpected end");
+    return *p++;
+  }
+  const uint8_t* raw(size_t n) {
+    if (static_cast<size_t>(end - p) < n)
+      throw std::runtime_error("ubjson: truncated");
+    const uint8_t* r = p;
+    p += n;
+    return r;
+  }
+  template <typename T>
+  T be() {
+    const uint8_t* b = raw(sizeof(T));
+    T v = 0;
+    for (size_t i = 0; i < sizeof(T); ++i)
+      v = static_cast<T>((v << 8) | b[i]);
+    return v;
+  }
+  int64_t read_int(uint8_t tag) {
+    switch (tag) {
+      case 'i': return static_cast<int8_t>(take());
+      case 'U': return take();
+      case 'I': return static_cast<int16_t>(be<uint16_t>());
+      case 'l': return static_cast<int32_t>(be<uint32_t>());
+      case 'L': return static_cast<int64_t>(be<uint64_t>());
+      default: throw std::runtime_error("ubjson: bad int tag");
+    }
+  }
+  double read_num(uint8_t tag) {
+    if (tag == 'd') {
+      uint32_t u = be<uint32_t>();
+      float f;
+      std::memcpy(&f, &u, 4);
+      return f;
+    }
+    if (tag == 'D') {
+      uint64_t u = be<uint64_t>();
+      double d;
+      std::memcpy(&d, &u, 8);
+      return d;
+    }
+    return static_cast<double>(read_int(tag));
+  }
+  std::string read_str(uint8_t len_tag) {
+    int64_t n = read_int(len_tag);
+    if (n < 0) throw std::runtime_error("ubjson: negative length");
+    const uint8_t* b = raw(static_cast<size_t>(n));
+    return std::string(reinterpret_cast<const char*>(b),
+                       static_cast<size_t>(n));
+  }
+  std::string read_str() { return read_str(take()); }
+  JValue parse(uint8_t tag) {
+    JValue v;
+    switch (tag) {
+      case '{': {
+        v.kind = JValue::kObj;
+        while (true) {
+          uint8_t t = take();
+          while (t == 'N') t = take();  // spec no-op: skip
+          if (t == '}') break;
+          // object keys are length-prefixed strings with the length's
+          // int tag inline (no 'S' marker)
+          std::string key = read_str(t);
+          v.obj.emplace(std::move(key), parse(take()));
+        }
+        return v;
+      }
+      case '[': {
+        v.kind = JValue::kArr;
+        uint8_t t = take();
+        uint8_t elem_type = 0;
+        int64_t count = -1;
+        if (t == '$') {           // strongly typed array
+          elem_type = take();
+          t = take();
+        }
+        if (t == '#') {
+          count = read_int(take());
+          t = 0;                  // no lookahead consumed
+        } else if (elem_type) {
+          throw std::runtime_error("ubjson: typed array without count");
+        }
+        if (count >= 0) {
+          // every element consumes >= 1 byte, so a count beyond the
+          // remaining buffer is corrupt — fail cheaply instead of
+          // reserving terabytes for a hostile header
+          if (count < 0 || count > end - p)
+            throw std::runtime_error("ubjson: array count exceeds buffer");
+          v.arr.reserve(static_cast<size_t>(count));
+          for (int64_t k = 0; k < count; ++k)
+            v.arr.push_back(parse(elem_type ? elem_type : take()));
+        } else {
+          while (true) {
+            while (t == 'N') t = take();  // spec no-op: skip
+            if (t == ']') break;
+            v.arr.push_back(parse(t));
+            t = take();
+          }
+        }
+        return v;
+      }
+      case 'S': v.kind = JValue::kStr; v.str = read_str(); return v;
+      case 'H': {  // high-precision number serialized as a string
+        v.kind = JValue::kNum;
+        v.num = std::stod(read_str());
+        return v;
+      }
+      case 'T': v.kind = JValue::kBool; v.b = true; return v;
+      case 'F': v.kind = JValue::kBool; v.b = false; return v;
+      case 'Z': return v;  // null
+      case 'C': v.kind = JValue::kStr; v.str = std::string(
+                    1, static_cast<char>(take())); return v;
+      case 'i': case 'U': case 'I': case 'l': case 'L':
+      case 'd': case 'D':
+        v.kind = JValue::kNum;
+        v.num = read_num(tag);
+        return v;
+      default:
+        throw std::runtime_error("ubjson: unknown tag");
+    }
+  }
+};
+
+bool looks_like_ubjson(const std::string& text) {
+  // both formats open with '{'; UBJSON follows it with a key-length int
+  // tag (or '}'), JSON with whitespace/'"'
+  if (text.empty() || text[0] != '{') return false;
+  if (text.size() < 2) return false;
+  const char c = text[1];
+  // note: the spec's count-optimized object header '{$'/'{#' is not
+  // supported (neither writer emits it); '{$' would be sniffed as UBJSON
+  // but die in the object loop, so leave it to the JSON parser's clearer
+  // "json:" error instead
+  return c == 'i' || c == 'U' || c == 'I' || c == 'l' || c == 'L' ||
+         c == '}';
+}
+
 // ----------------------------------------------------------------- model ---
 struct Tree {
   std::vector<int32_t> left, right, feat;
@@ -282,8 +431,14 @@ void parse_native_categories(const JValue& jt, Tree* t) {
 }
 
 Model load_model_json(const std::string& text) {
-  JParser parser(text);
-  const JValue root = parser.parse();
+  JValue root;
+  if (looks_like_ubjson(text)) {
+    UbjParser ub(text.data(), text.size());
+    root = ub.parse(ub.take());
+  } else {
+    JParser parser(text);
+    root = parser.parse();
+  }
   const JValue* learner = root.get("learner");
   if (!learner) throw std::runtime_error("model: no learner");
   const JValue* gb = learner->get("gradient_booster");

@@ -148,3 +148,28 @@ def test_error_contract():
         assert b"json" in lib.XGBGetLastError()
     finally:
         lib.XGBoosterFree(h)
+
+
+def test_scores_ubjson_models(trained, tmp_path):
+    """UBJSON — the reference's default binary model format — loads through
+    the C ABI in both this repo's writer layout and the reference
+    UBJWriter's strongly-typed-array layout."""
+    bst, X = trained
+    expected = bst.predict(xgb.DMatrix(X))
+
+    # our UBJ writer (untyped markers per element)
+    path = tmp_path / "m.ubj"
+    save_xgboost_model(bst, str(path))
+    got, _ = _c_predict(path, X)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    # reference-style strongly typed arrays ([$d#... / [$l#...)
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_interop import _encode_ubj_typed
+    from xgboost_tpu.interop import native_to_reference_json
+
+    raw = _encode_ubj_typed(native_to_reference_json(bst))
+    got2, rounds = _c_predict(raw, X)
+    assert rounds == 8
+    np.testing.assert_allclose(got2, expected, rtol=1e-5, atol=1e-6)
