@@ -52,7 +52,7 @@ def main():
     y = (X @ w + rng.randn(ROWS).astype(np.float32) > 0).astype(np.float32)
 
     import xgboost_tpu as xgb
-    from xgboost_tpu.ops.histogram import (build_hist_prehot,
+    from xgboost_tpu.ops.histogram import (build_hist, build_hist_prehot,
                                            build_onehot_plane)
     from xgboost_tpu.ops.partition import advance_positions_level
     from xgboost_tpu.ops.split import evaluate_splits
@@ -73,7 +73,10 @@ def main():
     gpair = jnp.stack([jnp.asarray(y) - 0.5,
                        jnp.full((ROWS,), 0.25, jnp.float32)], axis=1)
     bins_t = bins.T
-    oh_pre = jax.jit(lambda bt: build_onehot_plane(bt, max_nbins))(bins_t)
+    # the prehot plane costs n*F*B bytes (79 GB at 11M x 28 x 256) — only
+    # materialise it for the one phase that reads it
+    oh_pre = (jax.jit(lambda bt: build_onehot_plane(bt, max_nbins))(bins_t)
+              if "prehot" in PHASES else None)
     row_iota = jnp.arange(ROWS, dtype=jnp.int32)
 
     # ---- phase: histogram, all 6 levels per rep (arrays passed as args —
@@ -105,9 +108,13 @@ def main():
         bench(prehot_body, "hist prehot (6 levels)", oh_pre, gpair, row_iota)
 
     # ---- phase: split evaluation, all 6 levels per rep (args, not
-    # closures: a closed-over plane becomes a 7GB program constant)
-    hist32 = jax.jit(lambda oh, gp, it: build_hist_prehot(
-        oh, gp, it % 32, 32, max_nbins))(oh_pre, gpair, row_iota)
+    # closures: a closed-over plane becomes a 7GB program constant).
+    # hist32 comes from the production Pallas path, NOT the prehot plane,
+    # so 'eval' stays runnable at 11M-row shapes.
+    hist32 = (jax.jit(lambda bt, gp, it: build_hist(
+        bt.T, gp, it % 32, 32, max_nbins, method="auto", bins_t=bt))(
+            bins_t, gpair, row_iota)
+        if "eval" in PHASES else None)
     fmask = jnp.ones((1, COLS), bool)
 
     def eval_body(i, acc, h32):
