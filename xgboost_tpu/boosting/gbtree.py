@@ -144,6 +144,12 @@ class GBTree:
                 arrs = {k: v[t.index] for k, v in arrs.items()}
             self._trees[i] = t.grower.to_tree_model(_HostGrown(arrs))
 
+    def _vertical_federated(self) -> bool:
+        from ..parallel import collective
+
+        return (self.split_mode == "col" and self.mesh is None
+                and collective.is_distributed())
+
     # -- training -------------------------------------------------------------
     def _grower_for(self, binned: BinnedMatrix) -> TreeGrower:
         if self._grower is None:
@@ -167,6 +173,13 @@ class GBTree:
                 from ..tree.paged import PagedGrower
 
                 cls = PagedGrower
+            elif self.split_mode == "col" and self.mesh is None:
+                # column split without a device mesh: parties are separate
+                # communicator ranks (vertical federated) — host-level
+                # level loop with best-split/decision-bit exchanges
+                from ..tree.vertical import VerticalFederatedGrower
+
+                cls = VerticalFederatedGrower
             else:
                 cls = TreeGrower
             self._grower = cls(param, binned.max_nbins, binned.cuts,
@@ -265,9 +278,24 @@ class GBTree:
                     # committed tree's compact ids first
                     pos = tree.heap_map[np.asarray(grown.positions)]
                     alphas = obj.alphas() if hasattr(obj, "alphas") else [0.5]
-                    obj.update_tree_leaf(
-                        tree, pos, np.asarray(margin[:, k]), info,
-                        eta, alpha=alphas[min(k, len(alphas) - 1)])
+
+                    def _adapt():
+                        obj.update_tree_leaf(
+                            tree, pos, np.asarray(margin[:, k]), info,
+                            eta, alpha=alphas[min(k, len(alphas) - 1)])
+                        return np.asarray(tree.leaf_value)
+
+                    if self._vertical_federated():
+                        # adaptive leaves are label quantiles: positions and
+                        # margins replicate, labels live on the label rank
+                        # only (reference UpdateTreeLeaf under
+                        # ApplyWithLabels, src/objective/adaptive.cc)
+                        from ..parallel.collective import apply_with_labels
+
+                        tree.leaf_value = np.asarray(
+                            apply_with_labels(_adapt), np.float32)
+                    else:
+                        _adapt()
                     delta_k = delta_k + jnp.asarray(
                         tree.leaf_value[pos], dtype=jnp.float32)
                 else:

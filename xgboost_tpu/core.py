@@ -11,6 +11,7 @@ added since the cached version are walked, ``src/gbm/gbtree.cc:506-544``).
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -89,6 +90,7 @@ def _fused_round_body(margin, seed, iteration, bins, labels, weights,
 
 @_functools.partial(
     jax.jit,
+    donate_argnums=(1,),  # margin: updated in place, caller rebinds
     static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
                      "hist_method", "has_missing"))
 def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
@@ -111,6 +113,7 @@ def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
 
 @_functools.partial(
     jax.jit,
+    donate_argnums=(1,),  # margin: updated in place, caller rebinds
     static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
                      "hist_method", "has_missing"))
 def _fused_multi_round_fn(bins, margin, labels, weights, n_real, seeds,
@@ -243,6 +246,17 @@ class Booster:
         if (self.tree_param.grow_policy == "depthwise"
                 and self.tree_param.max_depth <= 0):
             raise ValueError("grow_policy=depthwise requires max_depth > 0")
+        if (dtrain is not None and self._is_vertical_federated()
+                and dtrain.info.data_split_mode != "col"):
+            # under vertical federated the DMatrix flag drives the
+            # row_split guards inside metrics/objectives; with it unset the
+            # label rank would issue extra collectives inside
+            # apply_with_labels closures and the ranks would deadlock on
+            # mismatched collectives instead of erroring
+            raise ValueError(
+                "vertical federated training requires the DMatrix to be "
+                "constructed with data_split_mode='col' (got "
+                f"{dtrain.info.data_split_mode!r})")
         obj_name = self.learner_params.get("objective", "reg:squarederror")
         if self.obj is None or getattr(self.obj, "name", None) != obj_name:
             self.obj = get_objective(
@@ -265,9 +279,21 @@ class Booster:
                 if self.base_margin_.shape[0] != n_groups:
                     self.base_margin_ = np.full(n_groups, float(margin),
                                                 dtype=np.float32)
-            elif dtrain is not None and dtrain.info.labels is not None:
-                est = np.asarray(self.obj.init_estimation(dtrain.info),
-                                 dtype=np.float32).reshape(-1)
+            elif dtrain is not None and (dtrain.info.labels is not None
+                                         or self._is_vertical_federated()):
+                # vertical federated: only the label rank can fit the stump;
+                # everyone receives its estimate (reference ApplyWithLabels
+                # around InitEstimation, src/objective/init_estimation.cc)
+                def _est():
+                    return np.asarray(self.obj.init_estimation(dtrain.info),
+                                      dtype=np.float32).reshape(-1)
+
+                if self._is_vertical_federated():
+                    from .parallel.collective import apply_with_labels
+
+                    est = np.asarray(apply_with_labels(_est), np.float32)
+                else:
+                    est = _est()
                 if est.shape[0] != n_groups:
                     est = np.full(n_groups, est[0] if est.size else 0.0,
                                   np.float32)
@@ -319,14 +345,23 @@ class Booster:
         if dsm not in ("row", "col"):
             raise ValueError(f"unknown data_split_mode: {dsm}")
         if dsm == "col":
-            if self.ctx.mesh is None:
-                raise ValueError("data_split_mode=col requires a mesh")
+            from .parallel import collective
+
+            if self.ctx.mesh is None and not collective.is_distributed():
+                raise ValueError(
+                    "data_split_mode=col requires a mesh (in-process column "
+                    "sharding) or an active distributed communicator "
+                    "(vertical federated training)")
             if (tm in ("approx", "exact")
                     or self.tree_param.grow_policy == "lossguide"
                     or ms == "multi_output_tree"):
                 raise NotImplementedError(
                     "data_split_mode=col supports tree_method=hist with "
                     "depthwise scalar trees only")
+            if self.ctx.mesh is None and name != "gbtree":
+                raise NotImplementedError(
+                    "vertical federated column split supports "
+                    "booster=gbtree only")
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
@@ -346,6 +381,18 @@ class Booster:
     @property
     def n_groups(self) -> int:
         return self.gbm.n_groups if self.gbm is not None else 1
+
+    def _is_vertical_federated(self) -> bool:
+        """Column split across communicator ranks (no device mesh): rows
+        and margins replicate, features partition, labels may live only on
+        the label rank — every label-derived quantity must route through
+        ``apply_with_labels``."""
+        if self.learner_params.get("data_split_mode", "row") != "col" \
+                or self.ctx.mesh is not None:
+            return False
+        from .parallel import collective
+
+        return collective.is_distributed()
 
     # ---------------------------------------------------------------- training
     def _state_of(self, dm: DMatrix, is_train: bool) -> Dict[str, Any]:
@@ -535,8 +582,19 @@ class Booster:
         margin = self.gbm.training_margin(state)
         with self._monitor.section("GetGradient"):
             if fobj is None:
-                gpair = self.obj.get_gradient(margin, state["info"],
-                                              iteration)
+                if self._is_vertical_federated():
+                    # margins replicate across parties, labels do not: the
+                    # label rank computes and broadcasts (reference
+                    # ApplyWithLabels in ObjFunction::GetGradient,
+                    # src/collective/aggregator.h:36)
+                    from .parallel.collective import apply_with_labels
+
+                    gpair = jnp.asarray(apply_with_labels(
+                        lambda: np.asarray(self.obj.get_gradient(
+                            margin, state["info"], iteration), np.float32)))
+                else:
+                    gpair = self.obj.get_gradient(margin, state["info"],
+                                                  iteration)
             else:
                 grad, hess = fobj(np.asarray(margin).squeeze(), dtrain)
                 gpair = jnp.stack(
@@ -589,6 +647,7 @@ class Booster:
                            "the general path permanently", exc_info=True)
             self._fused_blocked = True
             self._fused_round = None
+            self._recover_donated_margin(state)
             return False
         gbm._trees.append(_PendingTree(grown, grower))
         gbm.tree_info.append(0)
@@ -596,6 +655,27 @@ class Booster:
         state["margin"] = new_margin
         state["n_trees"] = gbm.version()
         return True
+
+    def _recover_donated_margin(self, state: Dict[str, Any]) -> None:
+        """The fused fns donate the margin buffer; a failure DURING execution
+        (not tracing) may have consumed it. The un-committed round's margin
+        equals base + committed trees, so rebuild it before the general path
+        touches it. The rebuild walks RAW thresholds when possible:
+        continuation-loaded trees may have been grown under different
+        quantile cuts, making their split_bin ids meaningless against this
+        binned matrix (same reason update() folds old trees via
+        margin_delta_raw)."""
+        m = state.get("margin")
+        if m is None or not getattr(m, "is_deleted", lambda: False)():
+            return
+        dm = state.get("dm")
+        if getattr(dm, "X", None) is not None and hasattr(
+                self.gbm, "margin_delta_raw"):
+            delta = self.gbm.margin_delta_raw(np.asarray(dm.X), 0,
+                                              self.gbm.version())
+            state["margin"] = state["base"] + jnp.asarray(delta)
+        else:
+            state["margin"] = self.gbm.compute_margin(state)
 
     def _fused_binding(self, state: Dict[str, Any]):
         """Eligibility + cache binding shared by the single-round and the
@@ -620,6 +700,11 @@ class Booster:
         # iteration-dependent (lambdarank pair sampling) — general path
         if type(self.obj).get_gradient is not Objective.get_gradient:
             return None
+        # the fused fns DONATE the margin buffer; a fresh cache's margin
+        # aliases state["base"] (same array), which process_type=update and
+        # continuation restarts still need — unalias before first donation
+        if state["margin"] is state["base"]:
+            state["margin"] = jnp.array(state["margin"], copy=True)
         binned = state["binned"]
         if self._fused_round is None or self._fused_round[0] is not state:
             # (re)bind to THIS training cache — a different dtrain gets
@@ -681,6 +766,7 @@ class Booster:
             logger.warning("batched fused rounds failed; falling back to "
                            "per-round training", exc_info=True)
             self._batch_blocked = True  # single-round fused path stays live
+            self._recover_donated_margin(state)
             return False
         # all K trees share ONE stacked-array dict; _flush fetches it once
         # and slices host-side
@@ -803,7 +889,12 @@ class Booster:
         total = self.gbm.version()
         if state["n_trees"] == total:
             return state["margin"]
-        if not self.gbm.supports_margin_cache:
+        if self._is_vertical_federated() and type(self.gbm) is GBTree:
+            # no party's local columns can walk the full forest — the
+            # incremental delta goes through the decision-bit protocol
+            state["margin"] = state["margin"] + jnp.asarray(
+                self._vertical_margin_delta(dm, state["n_trees"], total))
+        elif not self.gbm.supports_margin_cache:
             state["margin"] = self.gbm.compute_margin(state)
         elif state["binned"] is not None:
             state["margin"] = state["margin"] + self.gbm.margin_delta_binned(
@@ -813,6 +904,27 @@ class Booster:
                 dm.values(), state["n_trees"], total)
         state["n_trees"] = total
         return state["margin"]
+
+    def _vertical_margin_delta(self, dm: DMatrix, tree_lo: int,
+                               tree_hi: int) -> np.ndarray:
+        """Margin contribution of trees [lo, hi) on a vertically partitioned
+        DMatrix via the decision-bit protocol (tree/vertical.py)."""
+        from .parallel import collective
+        from .tree.vertical import federated_vertical_margin
+
+        comm = collective.get_communicator()
+        g = getattr(self.gbm, "_grower", None)
+        if g is not None and getattr(g, "f_offset", None) is not None:
+            offset = g.f_offset
+        else:  # loaded model: derive the block offset from column widths
+            widths = comm.allgather_objects(int(dm.num_col()))
+            offset = int(sum(widths[: comm.get_rank()]))
+        w = self.gbm.tree_weights()
+        return federated_vertical_margin(
+            self.gbm.trees[tree_lo:tree_hi],
+            self.gbm.tree_info[tree_lo:tree_hi], self.n_groups,
+            np.asarray(dm.values(), np.float32), offset, comm,
+            tree_weights=None if w is None else w[tree_lo:tree_hi])
 
     def _validate_features(self, data: DMatrix) -> None:
         """Shape/name agreement between model and data (reference
@@ -851,9 +963,35 @@ class Booster:
                 raise NotImplementedError(
                     "SHAP contributions are not supported for "
                     "multi_output_tree models")
+            if self._is_vertical_federated():
+                raise NotImplementedError(
+                    "SHAP contributions are not available under vertical "
+                    "federated column split (no party sees all features)")
             return self._predict_contribs(
                 data, approx=approx_contribs, interactions=pred_interactions,
                 iteration_range=iteration_range, strict_shape=strict_shape)
+        if self._is_vertical_federated() and type(self.gbm) is GBTree:
+            # decision-bit protocol: every split is resolvable by exactly
+            # one party; one OR-allreduce completes the routing
+            if pred_leaf:
+                raise NotImplementedError(
+                    "pred_leaf is not available under vertical federated "
+                    "column split")
+            lo_t, hi_t = self.gbm._tree_range(iteration_range)
+            margin = self._vertical_margin_delta(data, lo_t, hi_t)
+            base = (self.base_margin_ if self.base_margin_ is not None
+                    else np.zeros(self.n_groups, np.float32))
+            if data.info.base_margin is not None:
+                margin = margin + np.asarray(
+                    data.info.base_margin, np.float32).reshape(
+                        margin.shape[0], -1)
+            else:
+                margin = margin + base[None, :]
+            out = margin if output_margin else np.asarray(
+                self.obj.pred_transform(jnp.asarray(margin)))
+            if not strict_shape and out.ndim == 2 and out.shape[1] == 1:
+                out = out[:, 0]
+            return out
         X = data.values()
         base = self.base_margin_ if self.base_margin_ is not None else \
             np.zeros(self.n_groups, np.float32)
@@ -941,6 +1079,7 @@ class Booster:
         """Evaluate on a list of (DMatrix, name); returns the reference-format
         line ``[i]\\tname-metric:value...`` (``src/learner.cc:1307-1342``)."""
         self._configure(None)
+        vfed = self._is_vertical_federated()
         msg = f"[{iteration}]"
         for dm, name in evals:
             margin = self._cached_margin(dm)
@@ -949,14 +1088,33 @@ class Booster:
             if preds_np.ndim == 2 and preds_np.shape[1] == 1:
                 preds_np = preds_np[:, 0]
             for metric in self._eval_metrics:
-                score = metric(preds_np, dm.info)
+                if vfed:
+                    # predictions replicate, labels/weights live only on
+                    # the label rank (reference ApplyWithLabels around
+                    # Metric::Evaluate under vertical federated)
+                    from .parallel.collective import apply_with_labels
+
+                    score = apply_with_labels(
+                        lambda m=metric: float(m(preds_np, dm.info)))
+                else:
+                    score = metric(preds_np, dm.info)
                 msg += f"\t{name}-{metric.full_name}:{score:.6f}"
             if feval is not None:
                 margin_np = self._host_rows(margin, dm)
                 if margin_np.ndim == 2 and margin_np.shape[1] == 1:
                     margin_np = margin_np[:, 0]
-                res = feval(margin_np if output_margin else preds_np, dm)
-                pairs = res if isinstance(res, list) else [res]
+
+                def _feval():
+                    res = feval(margin_np if output_margin else preds_np, dm)
+                    return res if isinstance(res, list) else [res]
+
+                if vfed:
+                    from .parallel.collective import apply_with_labels
+
+                    pairs = apply_with_labels(
+                        lambda: [(str(k), float(v)) for k, v in _feval()])
+                else:
+                    pairs = _feval()
                 for mname, val in pairs:
                     msg += f"\t{name}-{mname}:{val:.6f}"
         return msg
@@ -1351,12 +1509,15 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
 
     bst = container.before_training(bst)
     start = bst.num_boosted_rounds()
-    batch_k = 8
+    # Largest power-of-two chunks <= XTPU_BATCH_ROUNDS: each chunk is one
+    # device dispatch (lax.scan), and pow2 sizing bounds the set of distinct
+    # scan lengths — i.e. compiled programs — to log2(max) + 1.
+    batch_max = int(os.environ.get("XTPU_BATCH_ROUNDS", "16"))
     i = start
     end = start + num_boost_round
     while i < end:
-        if batchable and end - i >= 2:
-            k = min(batch_k, end - i)
+        if batchable and end - i >= 2 and batch_max >= 2:
+            k = 1 << (min(batch_max, end - i).bit_length() - 1)
             if bst.update_batch(dtrain, list(range(i, i + k))):
                 i += k
                 continue

@@ -63,7 +63,9 @@ class DMatrix:
                  group: Any = None, qid: Any = None,
                  label_lower_bound: Any = None, label_upper_bound: Any = None,
                  enable_categorical: bool = False,
-                 max_bin: int = 256) -> None:
+                 max_bin: int = 256,
+                 data_split_mode: str = "row") -> None:
+        self._data_split_mode = data_split_mode
         if isinstance(data, DataIter):
             # external-memory path (reference DMatrix-from-DataIter ->
             # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): stream
@@ -101,7 +103,8 @@ class DMatrix:
                     enable_categorical = True
         X, names, types = to_dense(data, missing, feature_names, feature_types)
         self.X = X
-        self.info = MetaInfo(feature_names=names, feature_types=types)
+        self.info = MetaInfo(feature_names=names, feature_types=types,
+                             data_split_mode=self._data_split_mode)
         if not enable_categorical and types is not None and "c" in types:
             raise ValueError(
                 "categorical features present; pass enable_categorical=True")

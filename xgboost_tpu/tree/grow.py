@@ -51,6 +51,10 @@ class GrownTree(NamedTuple):
     is_cat_split: jnp.ndarray   # [max_nodes] bool
     cat_words: jnp.ndarray      # [max_nodes, W] uint32 — categories going LEFT
     base_weight: Optional[jnp.ndarray] = None  # [max_nodes] f32 node weight*eta
+    # raw split thresholds, set only by growers whose local cuts cannot
+    # resolve every feature (vertical federated: the winner exchange
+    # carries the owner's threshold)
+    split_value: Optional[np.ndarray] = None
 
 
 def _sample_features(key: jax.Array, base_mask: jnp.ndarray,

@@ -1,0 +1,326 @@
+"""Vertical (column-split) federated tree growing over a host Communicator.
+
+Reference analogue: column-split hist training where each party holds a
+feature slice of every row and only the label rank holds labels —
+``HistEvaluator::EvaluateSplits`` with column split
+(``src/tree/hist/evaluate_splits.h:294-409``: per-worker local best +
+best-split allgather) and the partition-bitvector broadcast in
+``src/tree/common_row_partitioner.h`` (each worker can route rows only at
+nodes whose split feature it owns; the decision bits are synced). Gradients
+and base score reach the non-label parties through
+``collective::ApplyWithLabels`` (``src/collective/aggregator.h:36-113``) —
+wired in ``core.Booster`` / ``boosting.gbtree``, not here.
+
+Design: unlike the in-jit mesh column split (``grow._grow`` with
+``split_mode="col"``), the parties here are separate processes/threads
+joined only by a ``parallel.collective.Communicator`` (e.g. the gRPC
+federated backend), so the level loop runs on the host and exchanges
+per-level aggregates: [P, N] best-split candidates up, [n] decision bits
+down. Tree numerics reuse the exact kernels of the resident path
+(``build_hist`` + ``evaluate_splits`` + ``calc_weight``), so the grown
+model is bit-identical to single-process training on the pooled columns
+(ties included: ranks hold contiguous ordered feature blocks and the
+cross-rank argmax prefers the lowest rank, which is the pooled argmax's
+lowest-feature preference).
+
+Scope limits (mirrors the mesh col-split caps): no categorical splits, no
+monotone/interaction constraints. Missing-value parity holds when local
+and pooled matrices agree on having missing slots (an all-dense dataset
+or missing present in every party's slice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import build_hist
+from ..ops.split import evaluate_splits
+from ..parallel import collective
+from .grow import _EPS, GrownTree, _sample_features
+from .param import TrainParam, calc_weight
+from .tree import TreeModel
+
+
+class VerticalFederatedGrower:
+    """Drop-in TreeGrower for ``split_mode="col"`` without a mesh: feature
+    blocks live on communicator ranks (rank-ordered, contiguous), rows and
+    gradients are replicated, labels may exist only on the label rank."""
+
+    def __init__(self, param: TrainParam, max_nbins: int, cuts,
+                 hist_method: str = "auto", mesh=None,
+                 monotone: Optional[np.ndarray] = None,
+                 constraint_sets: Optional[np.ndarray] = None,
+                 has_missing: bool = True,
+                 split_mode: str = "col") -> None:
+        if split_mode != "col":
+            raise ValueError("VerticalFederatedGrower is col-split only")
+        if monotone is not None or constraint_sets is not None:
+            raise NotImplementedError(
+                "vertical federated training does not support monotone/"
+                "interaction constraints yet")
+        if cuts.is_cat().any():
+            raise NotImplementedError(
+                "vertical federated training does not support categorical "
+                "features yet")
+        self.param = param
+        self.max_nbins = max_nbins
+        self.cuts = cuts
+        self.hist_method = hist_method
+        self.has_missing = has_missing
+        self.split_mode = split_mode
+        self.mesh = None
+        self.cat = None
+        self.monotone = None
+        self.constraint_sets = None
+        self.comm = collective.get_communicator()
+        self._f_offset: Optional[int] = None
+        self._base_global: Optional[np.ndarray] = None
+        self._bins_np = None  # (device array, host copy) identity-keyed
+
+    # -- one-time topology exchange -------------------------------------------
+    def _bind_features(self, n_real_bins) -> None:
+        if self._f_offset is not None:
+            return
+        base_local = np.asarray(n_real_bins) > 0
+        parts = self.comm.allgather_objects(base_local)
+        widths = [len(p) for p in parts]
+        self._f_offset = int(sum(widths[: self.comm.get_rank()]))
+        self._base_global = np.concatenate([np.asarray(p) for p in parts])
+
+    def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
+             n_real_bins: jnp.ndarray, key: jax.Array) -> GrownTree:
+        param = self.param
+        comm = self.comm
+        self._bind_features(n_real_bins)
+        # host copy keyed by array IDENTITY: a same-shape rebind (new
+        # DMatrix, continuation) must refresh the routing copy
+        if self._bins_np is None or self._bins_np[0] is not bins:
+            self._bins_np = (bins, np.asarray(bins))
+        bins_np = self._bins_np[1]
+        n, F_loc = bins_np.shape
+        off = self._f_offset
+        rank = comm.get_rank()
+        max_depth = param.max_depth
+        max_nodes = 2 ** (max_depth + 1) - 1
+        missing_bin = self.max_nbins - 1 if self.has_missing \
+            else self.max_nbins
+
+        # colsample draws replicate on every rank: shared key over the
+        # GLOBAL feature mask (grow.py TreeGrower.grow key discipline)
+        tree_mask_g = np.asarray(_sample_features(
+            jax.random.fold_in(key, 0xC0), jnp.asarray(self._base_global),
+            param.colsample_bytree))
+        key = jax.random.fold_in(key, 0x5EED)
+
+        split_feature = np.full(max_nodes, -1, np.int32)
+        split_bin = np.zeros(max_nodes, np.int32)
+        split_value = np.zeros(max_nodes, np.float32)
+        default_left = np.zeros(max_nodes, bool)
+        is_leaf = np.ones(max_nodes, bool)
+        active = np.zeros(max_nodes, bool)
+        active[0] = True
+        gain_arr = np.zeros(max_nodes, np.float32)
+        node_sum = np.zeros((max_nodes, 2), np.float32)
+        # rows replicate, so the local sum IS the global root sum — but it
+        # must use the same XLA reduction as the pooled path (numpy's
+        # pairwise summation differs in the low-order f32 bits, and that
+        # difference propagates into every gain/cover via parent - left)
+        node_sum[0] = np.asarray(jnp.sum(gpair, axis=0), np.float32)
+        positions = np.zeros(n, np.int32)
+
+        for depth in range(max_depth):
+            lo = 2 ** depth - 1
+            n_level = 2 ** depth
+            idx = lo + np.arange(n_level)
+            if not active[idx].any():
+                break
+            in_level = (positions >= lo) & (positions < lo + n_level)
+            rel = np.where(in_level, positions - lo, n_level).astype(np.int32)
+
+            hist = build_hist(bins, gpair, jnp.asarray(rel), n_level,
+                              self.max_nbins, method=self.hist_method)
+
+            level_key = jax.random.fold_in(key, depth)
+            level_mask_g = np.asarray(_sample_features(
+                level_key, jnp.asarray(tree_mask_g),
+                param.colsample_bylevel))
+            if param.colsample_bynode < 1.0:
+                node_keys = jax.random.split(
+                    jax.random.fold_in(level_key, 1), n_level)
+                fmask_g = np.stack([np.asarray(_sample_features(
+                    k, jnp.asarray(level_mask_g), param.colsample_bynode))
+                    for k in node_keys])
+            else:
+                fmask_g = level_mask_g[None, :]
+            fmask_loc = jnp.asarray(fmask_g[:, off:off + F_loc])
+
+            parent_sum = jnp.asarray(node_sum[lo:lo + n_level])
+            res = evaluate_splits(hist, parent_sum, n_real_bins, param,
+                                  feature_mask=fmask_loc,
+                                  has_missing=self.has_missing)
+            loc_feat = np.asarray(res.feature, np.int32)
+            loc_bin = np.asarray(res.bin, np.int32)
+            payload = {
+                "gain": np.asarray(res.gain, np.float32),
+                "feature": loc_feat + off,
+                "bin": loc_bin,
+                "default_left": np.asarray(res.default_left, bool),
+                "left_sum": np.asarray(res.left_sum, np.float32),
+                "right_sum": np.asarray(res.right_sum, np.float32),
+                "split_value": self.cuts.split_values(loc_feat, loc_bin),
+            }
+            cands = comm.allgather_objects(payload)
+            gains = np.stack([np.asarray(c["gain"]) for c in cands])  # [P,N]
+            winner = np.argmax(gains, axis=0)     # ties -> lowest rank ==
+            #                                       pooled lowest feature
+            sel = np.arange(n_level)
+            best_gain = gains[winner, sel]
+            best_feat = np.stack([c["feature"] for c in cands])[winner, sel]
+            best_bin = np.stack([c["bin"] for c in cands])[winner, sel]
+            best_dl = np.stack([c["default_left"] for c in cands])[winner,
+                                                                   sel]
+            best_ls = np.stack([c["left_sum"] for c in cands])[winner, sel]
+            best_rs = np.stack([c["right_sum"] for c in cands])[winner, sel]
+            best_sv = np.stack([c["split_value"] for c in cands])[winner,
+                                                                  sel]
+
+            can_split = (active[idx] & (best_gain > max(param.gamma, _EPS))
+                         & np.isfinite(best_gain))
+
+            split_feature[idx] = np.where(can_split, best_feat, -1)
+            split_bin[idx] = np.where(can_split, best_bin, 0)
+            split_value[idx] = np.where(can_split, best_sv, 0.0)
+            default_left[idx] = can_split & best_dl
+            is_leaf[idx] = ~can_split
+            gain_arr[idx] = np.where(can_split, best_gain, 0.0)
+            li, ri = 2 * idx + 1, 2 * idx + 2
+            active[li] = can_split
+            active[ri] = can_split
+            node_sum[li] = np.where(can_split[:, None], best_ls, 0.0)
+            node_sum[ri] = np.where(can_split[:, None], best_rs, 0.0)
+
+            # decision-bit sync: only the winning rank can route rows at a
+            # node (it owns the split feature); everyone else contributes 0
+            # and one sum-allreduce fans the bits out
+            mine = (winner == rank) & can_split
+            rel_c = np.minimum(rel, n_level - 1)
+            row_mine = in_level & mine[rel_c]
+            feat_per_row = np.maximum(loc_feat[rel_c], 0)
+            b = bins_np[np.arange(n), feat_per_row].astype(np.int32)
+            go_right = b > loc_bin[rel_c]
+            dl_per_row = np.asarray(res.default_left, bool)[rel_c]
+            go_right = np.where(b == missing_bin, ~dl_per_row, go_right)
+            contrib = (row_mine & go_right).astype(np.uint8)
+            bits = np.asarray(comm.allreduce(contrib, op="sum")) > 0
+            splitting = in_level & can_split[rel_c]
+            positions = np.where(splitting,
+                                 2 * positions + 1 + bits.astype(np.int32),
+                                 positions).astype(np.int32)
+
+        w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
+                                   jnp.asarray(node_sum[:, 1]), param))
+        w = (w * param.eta).astype(np.float32)
+        leaf_value = np.where(active & is_leaf, w, 0.0).astype(np.float32)
+        base_weight = np.where(active, w, 0.0).astype(np.float32)
+        delta = leaf_value[positions]
+        return GrownTree(
+            split_feature=split_feature, split_bin=split_bin,
+            default_left=default_left, is_leaf=is_leaf, active=active,
+            leaf_value=leaf_value, node_sum=node_sum, gain=gain_arr,
+            positions=positions, delta=jnp.asarray(delta),
+            is_cat_split=np.zeros(max_nodes, bool),
+            cat_words=np.zeros((max_nodes, 1), np.uint32),
+            base_weight=base_weight, split_value=split_value)
+
+    # kept by the Booster predict path so eval DMatrixes can be walked
+    # without re-deriving the topology
+    @property
+    def f_offset(self) -> Optional[int]:
+        return self._f_offset
+
+    def to_tree_model(self, g: GrownTree) -> TreeModel:
+        """Raw thresholds come from the per-level winner exchange
+        (``g.split_value``) — local cuts cover only this rank's features."""
+        return TreeModel.from_heap(
+            split_feature=np.asarray(g.split_feature),
+            split_bin=np.asarray(g.split_bin),
+            split_value=np.asarray(g.split_value),
+            default_left=np.asarray(g.default_left),
+            is_leaf=np.asarray(g.is_leaf), active=np.asarray(g.active),
+            leaf_value=np.asarray(g.leaf_value),
+            sum_hess=np.asarray(g.node_sum[:, 1]),
+            gain=np.asarray(g.gain),
+            is_cat_split=np.asarray(g.is_cat_split),
+            cat_words=np.asarray(g.cat_words),
+            base_weight=np.asarray(g.base_weight))
+
+
+def federated_vertical_margin(trees, tree_info, n_groups: int,
+                              X_local: np.ndarray, f_offset: int,
+                              comm, tree_weights=None) -> np.ndarray:
+    """Decision-bit prediction for vertically partitioned data (reference:
+    the column-split predictor's bit-vector protocol — each worker fills
+    routing decisions for nodes whose split feature it owns, the bits are
+    OR-combined across workers, then every worker walks the completed
+    tree; ``src/predictor/cpu_predictor.cc`` ``MaskOneRow``/AllReduce path,
+    GPU variant ``src/predictor/gpu_predictor.cu:627-722``).
+
+    trees: full TreeModels (thresholds are globally known under plain —
+    non-encrypted — column split, exactly as in the reference).
+    X_local: [n, F_local] raw values of this rank's feature block.
+    Returns the margin [n, n_groups] WITHOUT base score.
+    """
+    from .tree import stack_forest
+
+    n = X_local.shape[0]
+    F_loc = X_local.shape[1]
+    out = np.zeros((n, n_groups), np.float32)
+    forest = stack_forest(list(trees))
+    if forest is None:
+        return out
+    if "is_cat_split" in forest:
+        raise NotImplementedError(
+            "vertical federated prediction does not support categorical "
+            "splits yet")
+    T, M = forest["split_feature"].shape
+    depth = int(forest["depth"])
+    info = np.asarray(tree_info, np.int32)
+    weights = (np.ones(T, np.float32) if tree_weights is None
+               else np.asarray(tree_weights, np.float32))
+
+    # chunk trees so the [n, Tc * M] bit matrix stays bounded (~4 MB/rank)
+    chunk = max(1, (1 << 22) // max(n * M, 1))
+    for t0 in range(0, T, chunk):
+        t1 = min(T, t0 + chunk)
+        sf = forest["split_feature"][t0:t1]          # [Tc, M]
+        sv = forest["split_value"][t0:t1]
+        dl = forest["default_left"][t0:t1]
+        leaf = forest["is_leaf"][t0:t1]
+        owned = ~leaf & (sf >= f_offset) & (sf < f_offset + F_loc)
+        x = X_local[:, np.clip(sf - f_offset, 0, F_loc - 1)]  # [n, Tc, M]
+        go_right = x > sv[None, :, :]
+        go_right = np.where(np.isnan(x), ~dl[None, :, :], go_right)
+        bits = (go_right & owned[None, :, :]).astype(np.uint8)
+        bits = np.asarray(comm.allreduce(bits.reshape(n, -1), op="sum"),
+                          np.uint8).reshape(n, t1 - t0, M) > 0
+
+        lc = forest["left_child"][t0:t1]
+        rc = forest["right_child"][t0:t1]
+        lv = forest["leaf_value"][t0:t1]
+        pos = np.zeros((n, t1 - t0), np.int32)
+        ar = np.arange(t1 - t0)[None, :]
+        for _ in range(depth):
+            gr = np.take_along_axis(bits, pos[:, :, None],
+                                    axis=2)[:, :, 0]
+            child = np.where(gr, rc[ar, pos], lc[ar, pos])
+            pos = np.where(leaf[ar, pos], pos, child)
+        vals = lv[ar, pos] * weights[t0:t1][None, :]            # [n, Tc]
+        for g in range(n_groups):
+            sel = info[t0:t1] == g
+            if sel.any():
+                out[:, g] += vals[:, sel].sum(axis=1)
+    return out
