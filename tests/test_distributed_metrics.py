@@ -189,3 +189,33 @@ def test_prune_refresh_under_communicator():
     results = _run_world(2, fn)
     np.testing.assert_array_equal(results[0][0], results[1][0])
     np.testing.assert_array_equal(results[0][1], results[1][1])
+
+
+@pytest.mark.parametrize("name", ["auc", "aucpr"])
+def test_large_scale_auc_curve_merge(name, monkeypatch):
+    """Above XTPU_AUC_EXACT_MAX the distributed AUC switches to the
+    reference's local-curve merge (auc.cc:308-314): no O(global rows)
+    gather. Tolerance: the merge ignores cross-worker ranking, so with
+    i.i.d. shards |merged - exact| < 0.01 at 4 x 2500 rows."""
+    rng = np.random.RandomState(11)
+    n, world = 10_000, 4
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    p = np.clip(rng.rand(n) * 0.5 + y * 0.35, 1e-6, 1 - 1e-6)
+    w = rng.rand(n) + 0.5
+
+    metric = get_metric(name)
+    exact = metric(p, MetaInfo(labels=y, weights=w))
+
+    monkeypatch.setenv("XTPU_AUC_EXACT_MAX", "1000")
+
+    def fn(comm, rank):
+        s, e = _shards(n, world)[rank]
+        return metric(p[s:e], MetaInfo(labels=y[s:e], weights=w[s:e]))
+
+    merged = _run_world(world, fn)
+    assert all(v == merged[0] for v in merged)  # rank-independent
+    assert abs(merged[0] - exact) < 0.01
+    # below the gate the exact path still runs: bit-equal to global
+    monkeypatch.setenv("XTPU_AUC_EXACT_MAX", "1000000")
+    gathered = _run_world(world, fn)
+    assert all(v == pytest.approx(exact, abs=1e-12) for v in gathered)

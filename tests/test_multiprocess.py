@@ -25,6 +25,7 @@ _WORKER = textwrap.dedent("""
 
     rank = int(sys.argv[1]); world = int(sys.argv[2]); coord = sys.argv[3]
     out_path = sys.argv[4]
+    tree_method = sys.argv[5] if len(sys.argv) > 5 else "hist"
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -50,6 +51,7 @@ _WORKER = textwrap.dedent("""
     with launch.CommunicatorContext():
         bst = launch.train_per_host(
             {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+             "tree_method": tree_method,
              "eval_metric": ["logloss", "auc"]},
             X_local, y_local, 5,
             evals_result=res, verbose_eval=False)
@@ -78,7 +80,8 @@ def _free_port():
 
 
 @pytest.mark.slow
-def test_two_process_sharded_training(tmp_path):
+@pytest.mark.parametrize("tree_method", ["hist", "approx"])
+def test_two_process_sharded_training(tmp_path, tree_method):
     world = 2
     coord = f"127.0.0.1:{_free_port()}"
     script = tmp_path / "worker.py"
@@ -93,7 +96,7 @@ def test_two_process_sharded_training(tmp_path):
         outs.append(out)
         procs.append(subprocess.Popen(
             [sys.executable, str(script), str(rank), str(world), coord,
-             str(out)], env=env, stdout=subprocess.PIPE,
+             str(out), tree_method], env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT))
     logs = []
     for p in procs:
@@ -112,7 +115,8 @@ def test_two_process_sharded_training(tmp_path):
     X = rng.randn(803, 6).astype(np.float32)
     y = (X @ rng.randn(6) > 0).astype(np.float32)
     bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
-                     "eta": 0.3}, xgb.DMatrix(X, label=y), 5,
+                     "eta": 0.3, "tree_method": tree_method},
+                    xgb.DMatrix(X, label=y), 5,
                     verbose_eval=False)
     preds_single = np.asarray(bst.predict(xgb.DMatrix(X)))
 

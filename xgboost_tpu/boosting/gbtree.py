@@ -227,17 +227,29 @@ class GBTree:
             if self.tree_method == "approx":
                 # GlobalApproxUpdater: re-sketch cuts every iteration with
                 # hessian weights (reference src/tree/updater_approx.cc:55)
-                from ..data.binned import BinnedMatrix
-                from ..data.quantile import sketch_matrix
+                dm = state["dm"]
+                # sketch weight is the hessian AS-IS: the objective already
+                # folded sample weights into gpair (objective/base.py:61),
+                # exactly like the reference's GetHess() extraction
+                # (updater_approx.cc:290-295)
+                if getattr(dm, "presharded", False):
+                    # sharded ingestion: local hessians feed the
+                    # distributed sketch merge; the rebinned matrix comes
+                    # back mesh-sharded (updater_approx.cc:245 sketch sync)
+                    hess = np.asarray(
+                        dm.local_rows(gpair[:, k, 1]), np.float64)
+                    binned = dm.resketch_binned(self.tree_param.max_bin,
+                                                hess)
+                    cuts = binned.cuts
+                else:
+                    from ..data.binned import BinnedMatrix
+                    from ..data.quantile import sketch_matrix
 
-                w = np.asarray(gpair[:, k, 1], np.float64)
-                if info.weights is not None:
-                    w = w * np.asarray(info.weights, np.float64)
-                cuts = sketch_matrix(np.asarray(state["dm"].X),
-                                     self.tree_param.max_bin, w,
-                                     info.feature_types)
-                binned = BinnedMatrix.from_dense(np.asarray(state["dm"].X),
-                                                 cuts)
+                    w = np.asarray(gpair[:, k, 1], np.float64)
+                    cuts = sketch_matrix(np.asarray(dm.X),
+                                         self.tree_param.max_bin, w,
+                                         info.feature_types)
+                    binned = BinnedMatrix.from_dense(np.asarray(dm.X), cuts)
                 # reuse the grower (and its jitted kernels) across re-sketches
                 # when the compiled shapes are unchanged; categorical split
                 # sets depend on the cuts, so those rebuild

@@ -409,16 +409,18 @@ class Booster:
                 # ShardedDMatrix (parallel/launch.py): the global quantized
                 # matrix was already assembled from per-process shards — no
                 # host-global arrays exist anywhere. Must be checked before
-                # the approx/exact branch: those train on raw thresholds of
-                # the (local-only) X and would silently fit 1/N of the data.
-                if tm in ("approx", "exact"):
+                # the exact branch: that trains on raw thresholds of the
+                # (local-only) X and would silently fit 1/N of the data.
+                # approx works: it re-sketches through the distributed
+                # merge every iteration (dm.resketch_binned).
+                if tm == "exact":
                     raise NotImplementedError(
-                        f"tree_method={tm} is not supported with sharded "
-                        "multi-process ingestion; use hist")
+                        "tree_method=exact is not supported with sharded "
+                        "multi-process ingestion; use hist or approx")
                 base = (self.base_margin_ if self.base_margin_ is not None
                         else np.zeros(self.n_groups, np.float32))
                 return self._store_cache(
-                    key, dm.global_binned(),
+                    key, None if tm == "approx" else dm.global_binned(),
                     dm.make_margin(base, self.n_groups), True, dm,
                     dm.device_info(), dm.num_row())
             if is_train and tm in ("approx", "exact"):

@@ -3,17 +3,31 @@
 Binary ROC-AUC via the rank-sum formulation with weight support; multiclass =
 weighted one-vs-rest average (matching the reference's OVR handling).
 
-Distributed evaluation: binary/multiclass AUC allgathers the (label, pred,
-weight) triples so the global ranking — and therefore the metric — is EXACT
-and identical to a single-host evaluation. (The reference instead merges
-local curves approximately: ``GlobalRatio`` of per-worker unnormalised areas,
-``auc.cc:314``; exactness is cheap here because metric evaluation is a
-host-side, once-per-round operation.) Ranking AUC keeps the reference's
-``GlobalRatio(sum_auc, valid_groups)`` (``auc.cc:293``) — query groups never
-span workers, so that merge is already exact.
+Distributed evaluation is two-tier:
+
+- **Exact** (default below ``XTPU_AUC_EXACT_MAX`` = 1M rows per worker):
+  allgather the (label, pred, weight) triples so the global ranking — and
+  therefore the metric — is identical to a single-host evaluation. At
+  HIGGS-scale this would move O(global rows) per worker per eval round,
+  so it is size-gated.
+- **Local-curve merge** (above the gate): each worker computes its local
+  unnormalised area and the merged value is
+  ``GlobalRatio(sum areas, sum pos*neg)`` — exactly the reference's
+  distributed binary AUC (``auc.cc:308-314``: ``EvalBinary`` then
+  ``GlobalRatio(auc, fp*tp)``), which weighs each worker's local AUC by
+  its pair count and ignores cross-worker ranking. With i.i.d. row
+  shards the bias is O(1/sqrt(local rows)); the sharded-vs-global test
+  asserts |merged - exact| < 0.01 on 4x2500 random shards.
+
+Ranking AUC keeps the reference's ``GlobalRatio(sum_auc, valid_groups)``
+(``auc.cc:293``) — query groups never span workers, so that merge is
+already exact. Multiclass OVR always uses the exact gather (the
+reference's multiclass path does not define a distributed merge either).
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -21,8 +35,11 @@ from ..registry import METRICS
 from .base import Metric, global_mean
 
 
-def binary_roc_auc(labels: np.ndarray, preds: np.ndarray,
-                   weights: np.ndarray) -> float:
+def _roc_curve_area(labels, preds, weights):
+    """-> (unnormalised area, total_pos * total_neg); nan-free building
+    block shared by the exact metric and the distributed curve merge."""
+    if len(labels) == 0:  # empty shard: contributes nothing to the merge
+        return 0.0, 0.0
     order = np.argsort(-preds, kind="stable")
     y, p, w = labels[order], preds[order], weights[order]
     pos_w = np.where(y > 0.5, w, 0.0)
@@ -31,7 +48,7 @@ def binary_roc_auc(labels: np.ndarray, preds: np.ndarray,
     cum_neg = np.cumsum(neg_w)
     total_pos, total_neg = cum_pos[-1], cum_neg[-1]
     if total_pos <= 0 or total_neg <= 0:
-        return float("nan")
+        return 0.0, 0.0
     # group ties: area added per distinct prediction via trapezoid rule
     boundary = np.concatenate([p[1:] != p[:-1], [True]])
     tp = cum_pos[boundary]
@@ -39,11 +56,19 @@ def binary_roc_auc(labels: np.ndarray, preds: np.ndarray,
     tp0 = np.concatenate([[0.0], tp[:-1]])
     fp0 = np.concatenate([[0.0], fp[:-1]])
     area = np.sum((fp - fp0) * (tp + tp0) / 2.0)
-    return float(area / (total_pos * total_neg))
+    return float(area), float(total_pos * total_neg)
 
 
-def binary_pr_auc(labels: np.ndarray, preds: np.ndarray,
-                  weights: np.ndarray) -> float:
+def binary_roc_auc(labels: np.ndarray, preds: np.ndarray,
+                   weights: np.ndarray) -> float:
+    area, norm = _roc_curve_area(labels, preds, weights)
+    return float(area / norm) if norm > 0 else float("nan")
+
+
+def _pr_curve_area(labels, preds, weights):
+    """-> (total_pos-scaled area, total_pos) for the PR curve merge."""
+    if len(labels) == 0:  # empty shard: contributes nothing to the merge
+        return 0.0, 0.0
     order = np.argsort(-preds, kind="stable")
     y, p, w = labels[order], preds[order], weights[order]
     pos_w = np.where(y > 0.5, w, 0.0)
@@ -52,14 +77,19 @@ def binary_pr_auc(labels: np.ndarray, preds: np.ndarray,
     cum_neg = np.cumsum(neg_w)
     total_pos = cum_pos[-1]
     if total_pos <= 0:
-        return float("nan")
+        return 0.0, 0.0
     boundary = np.concatenate([p[1:] != p[:-1], [True]])
     tp = cum_pos[boundary]
     fp = cum_neg[boundary]
     prec = tp / np.maximum(tp + fp, 1e-16)
-    rec = tp / total_pos
-    rec0 = np.concatenate([[0.0], rec[:-1]])
-    return float(np.sum((rec - rec0) * prec))
+    tp0 = np.concatenate([[0.0], tp[:-1]])
+    return float(np.sum((tp - tp0) * prec)), float(total_pos)
+
+
+def binary_pr_auc(labels: np.ndarray, preds: np.ndarray,
+                  weights: np.ndarray) -> float:
+    area, norm = _pr_curve_area(labels, preds, weights)
+    return float(area / norm) if norm > 0 else float("nan")
 
 
 def _gather_rows(y: np.ndarray, p: np.ndarray, w: np.ndarray, info):
@@ -82,6 +112,27 @@ def _gather_rows(y: np.ndarray, p: np.ndarray, w: np.ndarray, info):
 class _AucBase(Metric):
     maximize = True
     _fn = staticmethod(binary_roc_auc)
+    _curve = staticmethod(_roc_curve_area)
+
+    def _curve_merge(self, y, p, w, info):
+        """Reference local-curve merge for large distributed evals
+        (``auc.cc:308-314``): None -> caller should use the exact path.
+        The size decision uses a max-allreduce so every rank branches the
+        same way regardless of shard-size skew."""
+        from ..parallel.collective import get_communicator
+
+        comm = get_communicator()
+        if (not comm.is_distributed()
+                or getattr(info, "data_split_mode", "row") != "row"):
+            return None
+        exact_max = int(os.environ.get("XTPU_AUC_EXACT_MAX", 1_000_000))
+        n_max = int(comm.allreduce(np.asarray([len(y)], np.int64),
+                                   op="max")[0])
+        if n_max <= exact_max:
+            return None
+        area, norm = self._curve(y, p, w)
+        s = comm.allreduce(np.asarray([area, norm], np.float64), op="sum")
+        return float(s[0] / s[1]) if s[1] > 0 else float("nan")
 
     def __call__(self, preds, info) -> float:
         y = np.asarray(info.labels, dtype=np.float64).reshape(-1)
@@ -101,6 +152,10 @@ class _AucBase(Metric):
                     total += a
                     valid += 1.0
             return float(global_mean(total, valid, info))
+        if p.ndim == 1 or p.shape[1] == 1:
+            merged = self._curve_merge(y, p.reshape(-1), w, info)
+            if merged is not None:
+                return merged
         y, p, w = _gather_rows(y, p, w, info)
         if p.ndim == 2 and p.shape[1] > 1:
             # multiclass OVR, class-weighted like the reference
@@ -119,9 +174,11 @@ class _AucBase(Metric):
 class AUC(_AucBase):
     name = "auc"
     _fn = staticmethod(binary_roc_auc)
+    _curve = staticmethod(_roc_curve_area)
 
 
 @METRICS.register("aucpr")
 class AUCPR(_AucBase):
     name = "aucpr"
     _fn = staticmethod(binary_pr_auc)
+    _curve = staticmethod(_pr_curve_area)

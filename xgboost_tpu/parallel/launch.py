@@ -162,7 +162,6 @@ class ShardedDMatrix(DMatrix):
 
         from ..context import DATA_AXIS
         from ..data.adapters import to_dense
-        from ..data.binned import (BinnedMatrix, _dtype_for, search_bin_into)
 
         comm = comm if comm is not None else collective.get_communicator()
         X_local, _, _ = to_dense(data, np.nan)
@@ -180,49 +179,74 @@ class ShardedDMatrix(DMatrix):
         self.info.validate(n_local)
         self.missing = np.nan
         self._n_local = n_local
+        self._comm = comm
+
+        self._has_missing = bool(int(comm.allreduce(
+            np.asarray([int(np.isnan(X_local).any())]), op="max")[0]))
+        # equal per-process blocks: pad to the global max local count,
+        # rounded up to a multiple of this process's device count
+        local_devs = jax.local_device_count()
+        n_max = int(comm.allreduce(np.asarray([n_local]), op="max")[0])
+        self._n_block = ((max(n_max, 1) + local_devs - 1)
+                         // local_devs) * local_devs
+        self._row_sharding = jsh.NamedSharding(
+            mesh, jsh.PartitionSpec(DATA_AXIS, None))
+        vec_sh = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS))
+        self._mesh = mesh
+        self.n_global = self._n_block * jax.process_count()
 
         # 1. global cuts from the distributed sketch merge
         cuts = collective.distributed_sketch(X_local, max_bin, weights=w,
                                              comm=comm)
-        has_missing = bool(int(comm.allreduce(
-            np.asarray([int(np.isnan(X_local).any())]), op="max")[0]))
+        # 2.-4. bin locally, pad, assemble the global quantized matrix
+        self._binned_g = self._assemble_binned(cuts)
+
+        yp = np.zeros(self._n_block, np.float32)
+        if y is not None:
+            yp[:n_local] = y.reshape(n_local, -1)[:, 0] if y.ndim > 1 else y
+        wp = np.zeros(self._n_block, np.float32)
+        wp[:n_local] = 1.0 if w is None else w
+        self._labels_g = jax.make_array_from_process_local_data(vec_sh, yp)
+        self._weights_g = jax.make_array_from_process_local_data(vec_sh, wp)
+
+    def _assemble_binned(self, cuts):
+        """Local binning against (identical-everywhere) global cuts, padded
+        to the equal per-process block and assembled into one mesh-sharded
+        global quantized matrix."""
+        import jax
+
+        from ..data.binned import BinnedMatrix, _dtype_for, search_bin_into
+
+        n_local, F = self.X.shape
+        has_missing = self._has_missing
         max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)
         missing_bin = max_nbins - 1 if has_missing else max_nbins
-
-        # 2. local binning against the (identical-everywhere) global cuts
         bins_local = np.empty(
             (n_local, F), _dtype_for(max(max_nbins - 1, 1)))
-        search_bin_into(X_local, cuts, min(missing_bin, max_nbins - 1),
+        search_bin_into(self.X, cuts, min(missing_bin, max_nbins - 1),
                         bins_local)
-
-        # 3. equal per-process blocks: pad to the global max local count,
-        # rounded up to a multiple of this process's device count
-        local_devs = jax.local_device_count()
-        n_max = int(comm.allreduce(np.asarray([n_local]), op="max")[0])
-        n_block = ((max(n_max, 1) + local_devs - 1) // local_devs) * local_devs
-        pad = n_block - n_local
+        pad = self._n_block - n_local
         if pad:
             fill = np.full((pad, F), min(missing_bin, max_nbins - 1),
                            bins_local.dtype)
             bins_local = np.concatenate([bins_local, fill])
-        yp = np.zeros(n_block, np.float32)
-        if y is not None:
-            yp[:n_local] = y.reshape(n_local, -1)[:, 0] if y.ndim > 1 else y
-        wp = np.zeros(n_block, np.float32)
-        wp[:n_local] = 1.0 if w is None else w
+        bins_g = jax.make_array_from_process_local_data(self._row_sharding,
+                                                        bins_local)
+        return BinnedMatrix(bins=bins_g, cuts=cuts, max_nbins=max_nbins,
+                            has_missing=has_missing)
 
-        # 4. assemble the global arrays from local blocks
-        row_sh = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS, None))
-        vec_sh = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS))
-        bins_g = jax.make_array_from_process_local_data(row_sh, bins_local)
-        self._labels_g = jax.make_array_from_process_local_data(vec_sh, yp)
-        self._weights_g = jax.make_array_from_process_local_data(vec_sh, wp)
-        self._binned_g = BinnedMatrix(bins=bins_g, cuts=cuts,
-                                      max_nbins=max_nbins,
-                                      has_missing=has_missing)
-        self._row_sharding = row_sh
-        self._mesh = mesh
-        self.n_global = n_block * jax.process_count()
+    def resketch_binned(self, max_bin: int,
+                        hess_local: Optional[np.ndarray]):
+        """Per-iteration hessian-weighted global re-sketch + re-bin — the
+        GlobalApproxUpdater under sharded ingestion (reference
+        ``src/tree/updater_approx.cc:55,245``: sketch sync every
+        iteration). ``hess_local`` is this process's valid-row hessian."""
+        cuts = collective.distributed_sketch(
+            self.X, max_bin,
+            weights=None if hess_local is None
+            else np.asarray(hess_local, np.float64),
+            comm=self._comm)
+        return self._assemble_binned(cuts)
 
     # device-side training views ------------------------------------------
     def device_info(self) -> MetaInfo:
