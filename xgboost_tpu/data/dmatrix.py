@@ -56,6 +56,8 @@ class MetaInfo:
 class DMatrix:
     """In-memory data matrix (reference ``SimpleDMatrix``)."""
 
+    _data_split_mode = "row"  # subclasses with their own __init__ inherit
+
     def __init__(self, data: Any, label: Any = None, *, weight: Any = None,
                  base_margin: Any = None, missing: float = np.nan,
                  feature_names: Optional[List[str]] = None,
@@ -404,6 +406,21 @@ class DMatrix:
             q = np.concatenate(qids)
             _, counts = np.unique(q, return_counts=True)
             self.info.set_group(counts)
+        from ..parallel import collective as _collective
+
+        if (_collective.is_distributed()
+                and self._data_split_mode == "row"):
+            # multi-host external memory: every process streams ITS row
+            # shard; cuts come from the cross-worker summary merge and the
+            # missing-slot layout must agree everywhere (reference:
+            # sketch sync inside QuantileDMatrix construction under rabit,
+            # src/common/quantile.cc:147-276). Every rank must contribute
+            # at least one batch (collectives are symmetric).
+            if need_sketch:
+                summaries = _collective.merge_summaries(
+                    summaries or [], max_bin)
+            has_missing = bool(int(_collective.allreduce(
+                np.asarray([int(has_missing)]), op="max")[0]))
         if ref is not None:
             cuts = ref.binned(max_bin).cuts
         else:

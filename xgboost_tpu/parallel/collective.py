@@ -328,12 +328,42 @@ class CommunicatorContext:
             finalize()
 
 
+def merge_summaries(local: list, max_bin: int,
+                    comm: Optional[Communicator] = None) -> list:
+    """Merge per-feature sketch summaries across workers: allgather ->
+    merge -> prune (reference ``GatherSketchInfo`` + ``AllReduce`` in
+    ``src/common/quantile.cc:147-276``). Shared by resident sharded
+    ingestion and the external-memory iterator path."""
+    from ..data.quantile import FeatureSummary
+
+    comm = comm or get_communicator()
+    if not comm.is_distributed():
+        return local
+    payload = [(s.values, s.weights) for s in local]
+    gathered = comm.allgather_objects(payload)
+    widths = [len(g) for g in gathered]
+    if len(set(widths)) != 1:
+        # zip would silently truncate to the shortest list, destroying the
+        # global sketch far from the cause (e.g. a rank whose iterator
+        # yielded zero batches) — fail loudly at the source instead
+        raise ValueError(
+            "sketch merge: ranks disagree on feature count "
+            f"{dict(enumerate(widths))}; every rank must contribute a "
+            "summary for every feature (empty shards are not supported)")
+    merged = local
+    for rank, remote in enumerate(gathered):
+        if rank == comm.get_rank():
+            continue
+        merged = [a.merge(FeatureSummary(np.asarray(v), np.asarray(w)))
+                  for a, (v, w) in zip(merged, remote)]
+    return [s.prune(max_bin * 8) for s in merged]
+
+
 def distributed_sketch(X_local: np.ndarray, max_bin: int,
                        weights: Optional[np.ndarray] = None,
                        comm: Optional[Communicator] = None):
-    """Build global quantile cuts from row shards: local summaries ->
-    allgather -> merge -> prune (reference ``GatherSketchInfo`` + ``AllReduce``
-    in ``src/common/quantile.cc:147-276``)."""
+    """Build global quantile cuts from row shards (summary-level merge over
+    the communicator)."""
     from ..data.quantile import FeatureSummary, cuts_from_summaries
 
     comm = comm or get_communicator()
@@ -341,16 +371,8 @@ def distributed_sketch(X_local: np.ndarray, max_bin: int,
              for f in range(X_local.shape[1])]
     if not comm.is_distributed():
         return cuts_from_summaries(local, max_bin)
-    payload = [(s.values, s.weights) for s in local]
-    gathered = comm.allgather_objects(payload)
-    merged = local
-    for rank, remote in enumerate(gathered):
-        if rank == comm.get_rank():
-            continue
-        merged = [a.merge(FeatureSummary(np.asarray(v), np.asarray(w)))
-                  for a, (v, w) in zip(merged, remote)]
-    merged = [s.prune(max_bin * 8) for s in merged]
-    return cuts_from_summaries(merged, max_bin)
+    return cuts_from_summaries(merge_summaries(local, max_bin, comm),
+                               max_bin)
 
 
 # -- aggregator helpers (reference src/collective/aggregator.h) ---------------

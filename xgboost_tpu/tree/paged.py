@@ -11,8 +11,12 @@ page histograms accumulate on device, split evaluation reuses the resident
 gather walk. Device memory stays O(2 pages + per-row vectors).
 
 Scope: depthwise single-target growth (the hist hot path). Categorical
-splits, monotone/interaction constraints, column split, and meshes raise
-``NotImplementedError`` — train those on resident matrices.
+splits, monotone/interaction constraints, column split, and device meshes
+raise ``NotImplementedError`` — train those on resident matrices.
+Multi-HOST external memory works: one process per host, each streaming its
+own row shard, with the per-level histogram and root sum crossing hosts
+through the communicator (reference: SparsePageDMatrix under rabit row
+split, ``src/data/sparse_page_dmatrix.cc``).
 """
 
 from __future__ import annotations
@@ -40,8 +44,10 @@ class PagedGrower(TreeGrower):
                  has_missing=True, split_mode="row") -> None:
         if mesh is not None:
             raise NotImplementedError(
-                "external-memory training does not support meshes yet; "
-                "page budgets are per-chip")
+                "external-memory training does not support device meshes; "
+                "page budgets are per-chip. Multi-host external memory "
+                "runs one process per host with a communicator (each host "
+                "streams its own row shard; histograms allreduce)")
         if monotone is not None or constraint_sets is not None:
             raise NotImplementedError(
                 "external-memory training does not support monotone/"
@@ -89,8 +95,24 @@ class PagedGrower(TreeGrower):
         gain = np.zeros(max_nodes, np.float32)
         node_sum = np.zeros((max_nodes, 2), np.float32)
 
+        # Multi-host external memory (reference: rabit row split over
+        # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): each process
+        # streams only ITS row shard's pages; the per-level histogram and
+        # the root gradient sum cross hosts through the communicator —
+        # the same two allreduces the mesh path does with lax.psum.
+        from ..parallel import collective
+
+        comm = collective.get_communicator()
+        distributed = comm.is_distributed() and self.split_mode == "row"
+
+        def allreduce(arr):
+            if not distributed:
+                return arr
+            return jnp.asarray(comm.allreduce(
+                np.asarray(arr, np.float32), op="sum"))
+
         positions = jnp.zeros((n,), jnp.int32)  # device-resident [n]
-        node_sum[0] = np.asarray(jnp.sum(gpair, axis=0))
+        node_sum[0] = np.asarray(allreduce(jnp.sum(gpair, axis=0)))
 
         # One static node width (2^(max_depth-1), the widest level) for
         # EVERY per-page program: per-width jits would compile
@@ -116,6 +138,7 @@ class PagedGrower(TreeGrower):
                 h = build_hist(page, gpair[s:e], rel, n_static, max_nbins,
                                method=hist_kernel)
                 hist_full = h if hist_full is None else hist_full + h
+            hist_full = allreduce(hist_full)
 
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
