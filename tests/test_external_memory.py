@@ -153,7 +153,10 @@ def test_paged_training_under_communicator(tmp_path, monkeypatch):
         finally:
             set_thread_local_communicator(None)
 
-    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    # daemon: a deadlocked worker must fail the assert below, not hang the
+    # pytest process at interpreter exit
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in (0, 1)]
     for t in threads:
         t.start()
     for t in threads:

@@ -51,7 +51,10 @@ TOL = {
 # bf16 dots with bf16 accumulation, so CPU equivalence would need a
 # meaninglessly loose tolerance (see ops/pallas/histogram.py docstring)
 @pytest.mark.parametrize("precision", ["f32", "int8x2"])
-@pytest.mark.parametrize("max_nbins,n_nodes", [(16, 1), (16, 64), (256, 4)])
+# 16/256 bins take the packed SWAR one-hot (B % 4 == 0), 17 the compare
+# fallback (also the missing-slot B = 257 shape class)
+@pytest.mark.parametrize("max_nbins,n_nodes", [(16, 1), (16, 64), (256, 4),
+                                               (17, 4)])
 def test_pallas_interpret_matches_segment(precision, max_nbins, n_nodes):
     n, F = 1000, 5  # ragged: not a multiple of the 128-row tile
     bins, gpair, rel = _data(n, F, max_nbins, n_nodes, seed=max_nbins)
@@ -91,6 +94,20 @@ def test_pallas_interpret_feature_block_padding():
         bins.T, gpair, rel, n_nodes, max_nbins, precision="f32",
         feat_block=8, interpret=True))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_int8x2_feat_block_bit_identity():
+    # the auto (whole-F) feature block and an explicit 8-wide block must
+    # produce identical bits: feature padding rows carry zero gradients
+    # and the per-feature int32 dot accumulation is order-independent
+    n, F, max_nbins, n_nodes = 700, 11, 256, 8
+    bins, gpair, rel = _data(n, F, max_nbins, n_nodes, seed=7)
+    a = np.asarray(build_hist_pallas(bins.T, gpair, rel, n_nodes, max_nbins,
+                                     precision="int8x2", interpret=True))
+    b = np.asarray(build_hist_pallas(bins.T, gpair, rel, n_nodes, max_nbins,
+                                     precision="int8x2", feat_block=8,
+                                     interpret=True))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_int8x2_order_independence_interpret():
