@@ -1,0 +1,2 @@
+/* stub for compile check; see Rinternals.h */
+#include "Rinternals.h"

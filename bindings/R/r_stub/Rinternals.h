@@ -1,0 +1,61 @@
+/* Minimal stub of the R C API surface used by bindings/R/xgboosttpu/src.
+ *
+ * This image ships no R installation, so the committed shim cannot be
+ * compiled against real headers in CI. This stub declares exactly the
+ * symbols the shim uses, with the real R signatures (R 4.x
+ * Rinternals.h/Rdefines.h), so tests/test_perl_binding.py can at least
+ * prove the shim is a well-formed C program against the API it claims to
+ * use. NOT an R emulation — never link against this.
+ */
+#ifndef XGBT_R_STUB_RINTERNALS_H_
+#define XGBT_R_STUB_RINTERNALS_H_
+
+#include <stddef.h>
+
+typedef struct SEXPREC* SEXP;
+typedef ptrdiff_t R_xlen_t;
+typedef void* (*DL_FUNC)(void);
+
+extern SEXP R_NilValue;
+
+#define REALSXP 14
+
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+#define PROTECT(s) Rf_protect(s)
+#define UNPROTECT(n) Rf_unprotect(n)
+
+void Rf_error(const char*, ...);
+SEXP Rf_allocVector(unsigned int, R_xlen_t);
+SEXP Rf_ScalarInteger(int);
+int Rf_asInteger(SEXP);
+double* REAL(SEXP);
+SEXP STRING_ELT(SEXP, R_xlen_t);
+const char* R_CHAR(SEXP);
+#define CHAR(x) R_CHAR(x)
+
+SEXP R_MakeExternalPtr(void*, SEXP, SEXP);
+void* R_ExternalPtrAddr(SEXP);
+void R_ClearExternalPtr(SEXP);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);
+
+char* R_alloc(size_t, int);
+
+#define ISNAN(x) ((x) != (x))
+
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+
+typedef struct _DllInfo DllInfo;
+int R_registerRoutines(DllInfo*, const void*, const R_CallMethodDef*,
+                       const void*, const void*);
+int R_useDynamicSymbols(DllInfo*, int);
+
+#define FALSE 0
+#define TRUE 1
+
+#endif /* XGBT_R_STUB_RINTERNALS_H_ */

@@ -1,0 +1,24 @@
+# R wrappers over the .Call shim (src/xgboosttpu_init.c), mirroring the
+# reference R package's scoring surface (R-package/R/xgb.Booster.R predict
+# path) for models trained by xgboost_tpu or reference XGBoost.
+#
+#   bst <- xgbt.load("model.json")
+#   p <- xgbt.predict(bst, X)                 # matrix (NA = missing)
+#   p <- xgbt.predict(bst, X, margin = TRUE)  # untransformed margins
+
+xgbt.load <- function(model_file) {
+  .Call("XGBTLoadModel_R", as.character(model_file))
+}
+
+xgbt.boosted_rounds <- function(bst) .Call("XGBTBoostedRounds_R", bst)
+xgbt.num_feature <- function(bst) .Call("XGBTNumFeature_R", bst)
+xgbt.num_groups <- function(bst) .Call("XGBTNumGroups_R", bst)
+
+xgbt.predict <- function(bst, X, margin = FALSE) {
+  X <- as.matrix(X)
+  storage.mode(X) <- "double"
+  out <- .Call("XGBTPredict_R", bst, X, nrow(X), ncol(X),
+               as.integer(margin))
+  g <- xgbt.num_groups(bst)
+  if (g > 1L) matrix(out, nrow = nrow(X), ncol = g, byrow = TRUE) else out
+}

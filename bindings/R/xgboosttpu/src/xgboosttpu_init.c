@@ -1,0 +1,110 @@
+/* R .Call shim over the xgboost_tpu C scoring ABI (native/c_api.h).
+ *
+ * Counterpart of the reference's R-package/src/xgboost_R.cc scoring entry
+ * points (XGBoosterCreate_R / XGBoosterLoadModel_R / XGBoosterPredict*_R):
+ * marshalling only — column-major R doubles to row-major float32, NA to
+ * NaN, an external pointer with a finalizer for the booster handle. The
+ * tree walks, schema parsing and objective transforms are in
+ * libxgboost_tpu_native, shared with the Python/perl/C consumers.
+ *
+ * Built by R CMD SHLIB / R CMD INSTALL via src/Makevars; compile-checked
+ * without R by tests/test_perl_binding.py::test_r_binding_source_compiles
+ * against bindings/R/r_stub.
+ */
+#include <math.h>
+#include <stdint.h>
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include "c_api.h"
+
+static void xgbt_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h) {
+    XGBoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void* xgbt_handle(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (!h) Rf_error("xgboosttpu: invalid or freed booster handle");
+  return h;
+}
+
+SEXP XGBTLoadModel_R(SEXP fname) {
+  void* h = NULL;
+  if (XGBoosterCreate(NULL, 0, &h))
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  if (XGBoosterLoadModel(h, CHAR(STRING_ELT(fname, 0)))) {
+    XGBoosterFree(h);
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  }
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, xgbt_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP XGBTBoostedRounds_R(SEXP handle) {
+  int r = 0;
+  if (XGBoosterBoostedRounds(xgbt_handle(handle), &r))
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  return Rf_ScalarInteger(r);
+}
+
+SEXP XGBTNumFeature_R(SEXP handle) {
+  uint64_t f = 0;
+  if (XGBoosterGetNumFeature(xgbt_handle(handle), &f))
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  return Rf_ScalarInteger((int)f);
+}
+
+SEXP XGBTNumGroups_R(SEXP handle) {
+  int g = 0;
+  if (XGBoosterNumGroups(xgbt_handle(handle), &g))
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  return Rf_ScalarInteger(g);
+}
+
+/* x: numeric matrix data (column-major, length nrow*ncol); NA -> missing.
+ * Returns numeric vector of nrow * num_groups predictions, row-major. */
+SEXP XGBTPredict_R(SEXP handle, SEXP x, SEXP nrow, SEXP ncol,
+                   SEXP output_margin) {
+  void* h = xgbt_handle(handle);
+  const uint64_t n = (uint64_t)Rf_asInteger(nrow);
+  const uint64_t f = (uint64_t)Rf_asInteger(ncol);
+  const double* xd = REAL(x);
+  float* buf = (float*)R_alloc((size_t)(n * f), sizeof(float));
+  for (uint64_t r = 0; r < n; ++r)
+    for (uint64_t c = 0; c < f; ++c) {
+      const double v = xd[c * n + r];
+      buf[r * f + c] = ISNAN(v) ? NAN : (float)v;
+    }
+  int g = 0;
+  if (XGBoosterNumGroups(h, &g))
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  float* out = (float*)R_alloc((size_t)(n * (uint64_t)g), sizeof(float));
+  if (XGBoosterPredictFromDense(h, buf, n, f, NAN,
+                                Rf_asInteger(output_margin), out))
+    Rf_error("xgboosttpu: %s", XGBGetLastError());
+  SEXP res = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)(n * (uint64_t)g)));
+  double* rd = REAL(res);
+  for (uint64_t i = 0; i < n * (uint64_t)g; ++i) rd[i] = (double)out[i];
+  UNPROTECT(1);
+  return res;
+}
+
+static const R_CallMethodDef kCallMethods[] = {
+    {"XGBTLoadModel_R", (DL_FUNC)&XGBTLoadModel_R, 1},
+    {"XGBTBoostedRounds_R", (DL_FUNC)&XGBTBoostedRounds_R, 1},
+    {"XGBTNumFeature_R", (DL_FUNC)&XGBTNumFeature_R, 1},
+    {"XGBTNumGroups_R", (DL_FUNC)&XGBTNumGroups_R, 1},
+    {"XGBTPredict_R", (DL_FUNC)&XGBTPredict_R, 5},
+    {NULL, NULL, 0}};
+
+void R_init_xgboosttpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, kCallMethods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

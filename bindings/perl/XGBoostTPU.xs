@@ -1,0 +1,105 @@
+/* Perl XS binding for the xgboost_tpu C scoring ABI (native/c_api.h).
+ *
+ * Counterpart of the reference's R binding shim (R-package/src/xgboost_R.cc):
+ * a thin marshalling layer over the native scoring library — load a model
+ * (native or reference XGBoost schema), predict dense float32 batches. All
+ * heavy lifting (schema parsing, tree walks, NaN/categorical routing,
+ * objective transforms) lives in libxgboost_tpu_native.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "c_api.h"
+
+static void* check(pTHX_ int rc, void* h) {
+  if (rc != 0) croak("xgboost_tpu: %s", XGBGetLastError());
+  return h;
+}
+
+MODULE = XGBoostTPU  PACKAGE = XGBoostTPU  PREFIX = xgbt_
+
+PROTOTYPES: DISABLE
+
+IV
+xgbt__create()
+  CODE:
+    BoosterHandle h = NULL;
+    check(aTHX_ XGBoosterCreate(NULL, 0, &h), NULL);
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+xgbt__free(IV handle)
+  CODE:
+    XGBoosterFree(INT2PTR(BoosterHandle, handle));
+
+void
+xgbt__load_model(IV handle, const char* fname)
+  CODE:
+    check(aTHX_ XGBoosterLoadModel(INT2PTR(BoosterHandle, handle), fname),
+          NULL);
+
+void
+xgbt__load_model_from_buffer(IV handle, SV* buf)
+  CODE:
+    STRLEN len;
+    const char* p = SvPVbyte(buf, len);
+    check(aTHX_ XGBoosterLoadModelFromBuffer(
+        INT2PTR(BoosterHandle, handle), p, (uint64_t)len), NULL);
+
+IV
+xgbt__boosted_rounds(IV handle)
+  CODE:
+    int r = 0;
+    check(aTHX_ XGBoosterBoostedRounds(INT2PTR(BoosterHandle, handle), &r),
+          NULL);
+    RETVAL = r;
+  OUTPUT:
+    RETVAL
+
+UV
+xgbt__num_feature(IV handle)
+  CODE:
+    uint64_t f = 0;
+    check(aTHX_ XGBoosterGetNumFeature(INT2PTR(BoosterHandle, handle), &f),
+          NULL);
+    RETVAL = (UV)f;
+  OUTPUT:
+    RETVAL
+
+IV
+xgbt__num_groups(IV handle)
+  CODE:
+    int g = 0;
+    check(aTHX_ XGBoosterNumGroups(INT2PTR(BoosterHandle, handle), &g),
+          NULL);
+    RETVAL = g;
+  OUTPUT:
+    RETVAL
+
+SV*
+xgbt__predict_dense_raw(IV handle, SV* data, UV n, UV f, double missing, int output_margin)
+  CODE:
+    /* data: packed little-endian float32, n*f*4 bytes; returns the packed
+     * float32 prediction buffer (n * n_groups values) — byte-exact, so
+     * callers can compare bit-for-bit against other bindings */
+    STRLEN len;
+    const char* p = SvPVbyte(data, len);
+    if (len != (STRLEN)(n * f * 4))
+      croak("xgboost_tpu: data buffer is %lu bytes, expected n*f*4 = %lu",
+            (unsigned long)len, (unsigned long)(n * f * 4));
+    int g = 0;
+    check(aTHX_ XGBoosterNumGroups(INT2PTR(BoosterHandle, handle), &g),
+          NULL);
+    RETVAL = newSV(n * g * 4 ? n * g * 4 : 1);
+    SvPOK_on(RETVAL);
+    SvCUR_set(RETVAL, n * g * 4);
+    check(aTHX_ XGBoosterPredictFromDense(
+        INT2PTR(BoosterHandle, handle), (const float*)p,
+        (uint64_t)n, (uint64_t)f, (float)missing, output_margin,
+        (float*)SvPVX(RETVAL)), NULL);
+  OUTPUT:
+    RETVAL

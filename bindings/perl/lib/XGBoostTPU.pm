@@ -1,0 +1,77 @@
+package XGBoostTPU;
+
+# Perl binding for the xgboost_tpu C scoring ABI. Scores models trained by
+# xgboost_tpu (or by reference XGBoost - both schemas load) without Python:
+#
+#   my $bst = XGBoostTPU->new(model_file => "model.json");
+#   my $preds = $bst->predict([[5.1, 3.5, 1.4], [6.2, 3.4, 5.4]]);
+#
+# Training stays in Python (the engine is JAX; docs/c_abi.md records the
+# decision) - this is the deployment-side surface, the same split the
+# reference's R/JVM users rely on for serving.
+
+use strict;
+use warnings;
+
+our $VERSION = '0.1.0';
+
+require XSLoader;
+XSLoader::load('XGBoostTPU', $VERSION);
+
+sub new {
+    my ($class, %args) = @_;
+    my $self = bless { handle => _create() }, $class;
+    if (defined $args{model_file}) {
+        _load_model($self->{handle}, $args{model_file});
+    } elsif (defined $args{model_buffer}) {
+        _load_model_from_buffer($self->{handle}, $args{model_buffer});
+    }
+    return $self;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    _free($self->{handle}) if defined $self->{handle};
+    delete $self->{handle};
+}
+
+sub load_model {
+    my ($self, $fname) = @_;
+    _load_model($self->{handle}, $fname);
+    return $self;
+}
+
+sub boosted_rounds { _boosted_rounds($_[0]->{handle}) }
+sub num_feature    { _num_feature($_[0]->{handle}) }
+sub num_groups     { _num_groups($_[0]->{handle}) }
+
+# predict(\@rows, %opts) -> \@preds (flat when num_groups == 1, else
+# per-row arrayrefs). Rows are arrayrefs of numbers; undef => missing.
+sub predict {
+    my ($self, $rows, %opts) = @_;
+    my $n = scalar @$rows;
+    my $f = $n ? scalar @{$rows->[0]} : 0;
+    my $nan = unpack('f', pack('L', 0x7FC00000));
+    my $buf = pack('f*', map {
+        my $row = $_;
+        @$row == $f or die "XGBoostTPU: ragged prediction matrix";
+        map { defined($_) ? $_ : $nan } @$row;
+    } @$rows);
+    my $raw = $self->predict_raw($buf, $n, $f, %opts);
+    my @flat = unpack('f*', $raw);
+    my $g = $self->num_groups;
+    return \@flat if $g <= 1;
+    return [map { [@flat[$_ * $g .. $_ * $g + $g - 1]] } 0 .. $n - 1];
+}
+
+# predict_raw($packed_f32, $n, $f, missing => NaN, output_margin => 0)
+# -> packed float32 predictions (n * num_groups values), byte-exact.
+sub predict_raw {
+    my ($self, $buf, $n, $f, %opts) = @_;
+    my $missing = exists $opts{missing}
+        ? $opts{missing} : unpack('f', pack('L', 0x7FC00000));
+    return _predict_dense_raw($self->{handle}, $buf, $n, $f, $missing,
+                              $opts{output_margin} ? 1 : 0);
+}
+
+1;

@@ -324,6 +324,7 @@ struct Model {
   std::vector<double> base_margin;  // margin space, one per group
   int n_groups = 1;
   int num_feature = 0;
+  int num_parallel_tree = 1;
   bool ref_semantics = false;  // true: x < cond left + RIGHT cat sets
   std::string objective;
 
@@ -480,6 +481,18 @@ Model load_model_json(const std::string& text) {
   }
   m.n_groups = std::max({num_class, num_target, 1});
 
+  // forests: trees-per-round multiplier (reference nests it in
+  // gbtree_model_param; the native schema keys it on the booster)
+  for (const JValue* holder : {model, gb}) {
+    if (!holder) continue;
+    const JValue* v = holder->get("num_parallel_tree");
+    if (!v)
+      if (const JValue* gmp = holder->get("gbtree_model_param"))
+        v = gmp->get("num_parallel_tree");
+    if (v)
+      m.num_parallel_tree = std::max(1, static_cast<int>(v->as_num()));
+  }
+
   const JValue* trees;
   const JValue* tinfo;
   if (m.ref_semantics) {
@@ -587,13 +600,30 @@ int XGBoosterLoadModel(BoosterHandle handle, const char* fname) {
   }
 }
 
+// Boosting ITERATIONS, reference semantics (learner.cc BoostedRounds):
+// multi-class models grow one tree per class per round and
+// num_parallel_tree grows forests, so divide the raw tree count by
+// trees-per-round.
 int XGBoosterBoostedRounds(BoosterHandle handle, int* out) {
-  *out = static_cast<int>(static_cast<Model*>(handle)->trees.size());
+  const Model& m = *static_cast<Model*>(handle);
+  int groups = 1;
+  for (int32_t g : m.tree_info) groups = std::max(groups, g + 1);
+  const int per_round = std::max(1, groups * m.num_parallel_tree);
+  *out = static_cast<int>(m.trees.size()) / per_round;
   return 0;
 }
 
 int XGBoosterGetNumFeature(BoosterHandle handle, uint64_t* out) {
   *out = static_cast<uint64_t>(static_cast<Model*>(handle)->num_feature);
+  return 0;
+}
+
+// Values per row in the prediction output (num_class for multi:softprob,
+// num_target for vector-leaf regression, else 1). Not part of the
+// reference ABI (its consumers call XGBoosterPredict* with a JSON config
+// and get the length back); bindings here need it to size the out buffer.
+int XGBoosterNumGroups(BoosterHandle handle, int* out) {
+  *out = static_cast<Model*>(handle)->n_groups;
   return 0;
 }
 

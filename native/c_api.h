@@ -1,0 +1,54 @@
+/* Public C scoring ABI of xgboost_tpu (native/c_api.cc).
+ *
+ * The training/runtime ABI of this framework is Python (the engine is JAX;
+ * see docs/c_abi.md for the decision record). This header is the scoring
+ * subset every non-Python binding attaches to — the same deployment-side
+ * surface the reference's bindings hot-loop on
+ * (reference include/xgboost/c_api.h:1080-1185, R-package/src/xgboost_R.cc,
+ * jvm-packages' JNI layer).
+ *
+ * Conventions (reference-compatible): every function returns 0 on success
+ * and -1 on failure; XGBGetLastError() returns the thread-local message for
+ * the last failing call. Model files may be native-schema or reference
+ * XGBoost JSON/UBJSON.
+ */
+#ifndef XGBOOST_TPU_C_API_H_
+#define XGBOOST_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* BoosterHandle;
+
+const char* XGBGetLastError(void);
+
+int XGBoosterCreate(const void* unused, int unused_len, BoosterHandle* out);
+int XGBoosterFree(BoosterHandle handle);
+
+/* Load from a file path or an in-memory buffer: JSON or UBJSON, native or
+ * reference schema (auto-detected). */
+int XGBoosterLoadModel(BoosterHandle handle, const char* fname);
+int XGBoosterLoadModelFromBuffer(BoosterHandle handle, const void* buf,
+                                 uint64_t len);
+
+int XGBoosterBoostedRounds(BoosterHandle handle, int* out);
+int XGBoosterGetNumFeature(BoosterHandle handle, uint64_t* out);
+/* Values per row in the prediction output (num_class / num_target / 1). */
+int XGBoosterNumGroups(BoosterHandle handle, int* out);
+
+/* Dense row-major [n, f] float32 prediction into out[n * n_groups].
+ * Missing values: pass NaN in data, or a sentinel via `missing` (every
+ * cell equal to it is treated as missing; pass NaN to disable mapping).
+ * output_margin != 0 skips the objective transform. */
+int XGBoosterPredictFromDense(BoosterHandle handle, const float* data,
+                              uint64_t n, uint64_t f, float missing,
+                              int output_margin, float* out);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* XGBOOST_TPU_C_API_H_ */

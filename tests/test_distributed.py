@@ -147,6 +147,65 @@ def test_col_split_with_missing(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_col_split_deep_tree(mesh):
+    # depth > 7 exercises the col-split gather walk + decision psum
+    # (rounds 1-2 capped col split at max_depth <= 7)
+    rng = np.random.RandomState(11)
+    X = rng.randn(4000, 11).astype(np.float32)
+    y = (np.sin(X[:, 0] * 3) + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 9, "eta": 0.4}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_categorical(mesh):
+    # categorical one-hot AND sorted-partition splits under col split: the
+    # winner's cat bitmask words cross the best-split exchange bit-exactly
+    rng = np.random.RandomState(12)
+    codes = rng.randint(0, 24, 3000)
+    eff = rng.randn(24) * 2.0
+    X = np.stack([codes, rng.randn(3000), rng.randn(3000),
+                  rng.randint(0, 5, 3000)], axis=1).astype(np.float32)
+    y = (eff[codes] + X[:, 1] + 0.7 * (X[:, 3] == 2)).astype(np.float32)
+    ft = ["c", "float", "float", "c"]
+    params = {"objective": "reg:squarederror", "max_depth": 5, "eta": 0.3,
+              "max_cat_to_onehot": 8}  # feature 0 partitions, feature 3 onehot
+    dm = lambda: xgb.DMatrix(X, label=y, feature_types=ft,
+                             enable_categorical=True)
+    b1 = xgb.train(params, dm(), 6, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   dm(), 6, verbose_eval=False)
+    assert any(t.is_cat_split.any() for t in b1.gbm.trees)
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.cat_words, t2.cat_words)
+    np.testing.assert_allclose(b1.predict(dm()), b2.predict(dm()),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_monotone_and_interaction(mesh):
+    rng = np.random.RandomState(13)
+    X = rng.randn(2500, 6).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+         + 0.1 * rng.randn(2500)).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 5, "eta": 0.3,
+              "monotone_constraints": "(1,-1,0,0,0,0)",
+              "interaction_constraints": "[[0,1],[2,3],[4,5]]"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 5, verbose_eval=False)
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_col_split_requires_mesh():
     X = np.random.RandomState(0).randn(100, 4).astype(np.float32)
     with pytest.raises(ValueError):

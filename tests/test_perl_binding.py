@@ -1,0 +1,209 @@
+"""Perl binding smoke test (VERDICT r2 item 8: a second language scores a
+model in the test suite).
+
+R and the JVM are absent from this image, but a full perl + XS toolchain is
+present, so the committed ``bindings/perl`` module is built with
+ExtUtils::MakeMaker and driven end-to-end here: train in Python ->
+``save_model`` -> perl loads the model through the native C scoring ABI
+(``native/c_api.h``). Equality contract (same as ``tests/test_c_abi.py``):
+perl's packed-float32 output is BYTE-identical to the ctypes C-ABI call
+(the binding is marshalling-lossless), and allclose(rtol=1e-6) against
+``Booster.predict`` — bitwise equality with Python is unattainable by
+design because the native scorer accumulates/transforms in double while
+JAX computes in float32. The R package source (``bindings/R``) and JVM
+scorer (``bindings/jvm``) marshal the same ABI;
+``test_r_binding_source_compiles`` compile-checks the R shim, and the R
+runtime smoke is a documented skip until an R runtime exists in the image
+(reference analogues: R-package/src/xgboost_R.cc, jvm-packages).
+"""
+
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _have(cmd):
+    return shutil.which(cmd) is not None
+
+
+def _perl_ready():
+    if not (_have("perl") and _have("make")):
+        return False
+    probe = subprocess.run(
+        ["perl", "-MExtUtils::MakeMaker", "-MExtUtils::ParseXS", "-MConfig",
+         "-e", 'print -e "$Config{archlibexp}/CORE/EXTERN.h" ? "ok" : "no"'],
+        capture_output=True, text=True)
+    return probe.returncode == 0 and probe.stdout.strip() == "ok"
+
+
+def _train_models(tmp_path):
+    rng = np.random.RandomState(42)
+    X = rng.randn(400, 6).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.15] = np.nan  # exercise missing routing
+    yb = (np.nan_to_num(X) @ rng.randn(6) > 0).astype(np.float32)
+    bst_b = xgb.train({"objective": "binary:logistic", "max_depth": 4},
+                      xgb.DMatrix(X, label=yb), 8, verbose_eval=False)
+    path_b = str(tmp_path / "binary.json")
+    bst_b.save_model(path_b)
+
+    ym = rng.randint(0, 3, 400)
+    bst_m = xgb.train({"objective": "multi:softprob", "num_class": 3,
+                       "max_depth": 3},
+                      xgb.DMatrix(X, label=ym), 5, verbose_eval=False)
+    path_m = str(tmp_path / "multi.json")
+    bst_m.save_model(path_m)
+    Xq = rng.randn(50, 6).astype(np.float32)
+    Xq[rng.rand(*Xq.shape) < 0.15] = np.nan
+    return (bst_b, path_b), (bst_m, path_m), Xq
+
+
+def _ctypes_predict_bytes(model_path, X, groups, margin):
+    import ctypes
+
+    lib = native.load()
+    lib.XGBGetLastError.restype = ctypes.c_char_p
+    h = ctypes.c_void_p()
+    assert lib.XGBoosterCreate(None, 0, ctypes.byref(h)) == 0
+    try:
+        assert lib.XGBoosterLoadModel(h, model_path.encode()) == 0, \
+            lib.XGBGetLastError().decode()
+        n, f = X.shape
+        out = np.empty(n * groups, np.float32)
+        nan = ctypes.c_float(float("nan"))
+        assert lib.XGBoosterPredictFromDense(
+            h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint64(n), ctypes.c_uint64(f), nan,
+            ctypes.c_int(margin),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))) == 0, \
+            lib.XGBGetLastError().decode()
+        return out.tobytes()
+    finally:
+        lib.XGBoosterFree(h)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _perl_ready(),
+                    reason="perl XS toolchain not available")
+def test_perl_scores_byte_identically(tmp_path):
+    assert native.load() is not None, "native toolchain required"
+
+    (bst_b, path_b), (bst_m, path_m), Xq = _train_models(tmp_path)
+
+    build = tmp_path / "perlbuild"
+    shutil.copytree(os.path.join(REPO, "bindings", "perl"), build)
+    env = {**os.environ, "PERL_MM_USE_DEFAULT": "1"}
+    for cmd in (["perl", "Makefile.PL",
+                 f"NATIVE_DIR={os.path.join(REPO, 'native')}"],
+                ["make"]):
+        r = subprocess.run(cmd, cwd=build, capture_output=True, text=True,
+                           env=env)
+        assert r.returncode == 0, f"{cmd}: {r.stdout}\n{r.stderr}"
+
+    script = tmp_path / "score.pl"
+    script.write_text("""
+use strict; use warnings;
+use blib '%(blib)s';
+use XGBoostTPU;
+my ($model, $xfile, $n, $f, $margin) = @ARGV;
+my $bst = XGBoostTPU->new(model_file => $model);
+open my $fh, '<:raw', $xfile or die $!;
+read $fh, my $buf, $n * $f * 4;
+my $raw = $bst->predict_raw($buf, $n, $f, output_margin => $margin);
+printf "rounds=%%d nfeat=%%d groups=%%d\\n",
+    $bst->boosted_rounds, $bst->num_feature, $bst->num_groups;
+print unpack('H*', $raw), "\\n";
+""" % {"blib": str(build)})
+
+    xfile = tmp_path / "X.f32"
+    xfile.write_bytes(Xq.tobytes())
+
+    for bst, path, groups, margin in ((bst_b, path_b, 1, 0),
+                                      (bst_b, path_b, 1, 1),
+                                      (bst_m, path_m, 3, 0)):
+        r = subprocess.run(
+            ["perl", str(script), path, str(xfile), str(Xq.shape[0]),
+             str(Xq.shape[1]), str(margin)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        header, hexline = r.stdout.strip().split("\n")
+        assert header == (f"rounds={bst.num_boosted_rounds()} "
+                          f"nfeat={Xq.shape[1]} groups={groups}")
+        perl_bytes = bytes.fromhex(hexline)
+        # byte-identical to the C ABI called directly (lossless binding)
+        assert perl_bytes == _ctypes_predict_bytes(
+            path, Xq, groups, margin)
+        # and numerically the Python model (double vs f32 transform ULPs)
+        perl_preds = np.frombuffer(perl_bytes, np.float32)
+        py = bst.predict(xgb.DMatrix(Xq), output_margin=bool(margin))
+        np.testing.assert_allclose(perl_preds,
+                                   np.asarray(py, np.float32).ravel(),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_r_binding_source_compiles():
+    """The committed R shim (bindings/R/xgboosttpu/src) must stay a valid
+    C program against the C ABI: compiled here against a minimal stub of
+    the R API (Rscript itself is absent from this image)."""
+    if shutil.which("gcc") is None and shutil.which("g++") is None:
+        pytest.skip("no C compiler")
+    assert native.load() is not None
+    src = os.path.join(REPO, "bindings", "R", "xgboosttpu", "src",
+                       "xgboosttpu_init.c")
+    stub = os.path.join(REPO, "bindings", "R", "r_stub")
+    out = "/tmp/xgbt_r_shim_check.o"
+    r = subprocess.run(
+        ["gcc" if shutil.which("gcc") else "g++", "-c", src, "-o", out,
+         "-I", stub, "-I", os.path.join(REPO, "native"),
+         "-Wall", "-Werror"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.skipif(not _have("Rscript"), reason="R not in image")
+def test_r_binding_runtime(tmp_path):
+    """Full R smoke (runs only where R exists): install-less scoring via
+    R CMD SHLIB + .Call, compared against Python at the same tolerance as
+    the perl/C tests (the native scorer computes in double, JAX in f32)."""
+    assert native.load() is not None
+    (bst_b, path_b), _, Xq = _train_models(tmp_path)
+    rdir = os.path.join(REPO, "bindings", "R", "xgboosttpu")
+    native_dir = os.path.join(REPO, "native")
+    src = tmp_path / "xgboosttpu_init.c"
+    shutil.copy(os.path.join(rdir, "src", "xgboosttpu_init.c"), src)
+    env = {**os.environ,
+           "PKG_CPPFLAGS": f"-I{native_dir}",
+           "PKG_LIBS": (f"-L{native_dir} -lxgboost_tpu_native "
+                        f"-Wl,-rpath,{native_dir}")}
+    r = subprocess.run(["R", "CMD", "SHLIB", str(src), "-o", "shim.so"],
+                       capture_output=True, text=True, cwd=tmp_path, env=env)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    script = tmp_path / "score.R"
+    script.write_text(f"""
+dyn.load("{tmp_path / 'shim.so'}")
+source(file.path("{rdir}", "R", "xgboosttpu.R"))
+bst <- xgbt.load("{path_b}")
+stopifnot(xgbt.boosted_rounds(bst) == {bst_b.num_boosted_rounds()})
+X <- matrix(readBin("{tmp_path / 'X.f32'}", "double", n={Xq.size},
+                    size=4), nrow={Xq.shape[0]}, byrow=TRUE)
+X[is.nan(X)] <- NA
+p <- xgbt.predict(bst, X)
+writeBin(as.numeric(p), "{tmp_path / 'preds.f64'}", size=8)
+""")
+    (tmp_path / "X.f32").write_bytes(Xq.tobytes())
+    r = subprocess.run(["Rscript", str(script)], capture_output=True,
+                       text=True, cwd=tmp_path)
+    assert r.returncode == 0, r.stderr
+    preds = np.fromfile(tmp_path / "preds.f64", np.float64)
+    py = bst_b.predict(xgb.DMatrix(Xq))
+    np.testing.assert_allclose(preds.astype(np.float32),
+                               np.asarray(py, np.float32),
+                               rtol=1e-6, atol=1e-7)

@@ -236,8 +236,13 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
             if F * B * 2 * N * 4 <= 12 * 2 ** 20:
                 feat_block = F
             else:
+                # split F into the fewest VMEM-fitting blocks, sized to
+                # MINIMIZE feature padding (a cap-sized block can pad F
+                # nearly 2x — every padded feature costs a one-hot build)
                 per_feat = B * 2 * N * 4
-                feat_block = max(8, (12 * 2 ** 20 // per_feat) // 8 * 8)
+                cap = max(8, (12 * 2 ** 20 // per_feat) // 8 * 8)
+                n_blocks = -(-F // cap)
+                feat_block = min(cap, _round_up(-(-F // n_blocks), 8))
         else:
             # f32/bf16 variants stage a [Fb*B, R] scratch — keep it small
             feat_block = 8

@@ -88,7 +88,9 @@ def update_positions(bins: jnp.ndarray, positions: jnp.ndarray,
                      default_left: jnp.ndarray, is_split: jnp.ndarray,
                      missing_bin: int,
                      is_cat_split: Optional[jnp.ndarray] = None,
-                     cat_words: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     cat_words: Optional[jnp.ndarray] = None,
+                     decision_axis: Optional[str] = None,
+                     feat_offset: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Advance rows one level down the tree.
 
     bins: [n, F] local bin ids; positions: [n] current heap node id;
@@ -96,12 +98,27 @@ def update_positions(bins: jnp.ndarray, positions: jnp.ndarray,
     (True where the node was just expanded). Rows at non-split nodes stay put.
     Categorical nodes route by left-set bitmask membership instead of the
     threshold comparison (reference ``CategoricalSplitMatrix`` decision).
+
+    Column split (``decision_axis`` + ``feat_offset``): ``split_feature``
+    carries GLOBAL feature ids while ``bins`` holds this shard's feature
+    slice starting at ``feat_offset``. Each shard computes decisions for
+    the nodes whose split feature it owns; one boolean psum fans them out
+    (the reference partition-bitvector broadcast,
+    ``src/tree/common_row_partitioner.h``) — the same protocol as
+    ``advance_positions_level``'s dense form, expressed over the per-row
+    gather walk so deep levels stay O(n) in memory.
     """
     feat = split_feature[positions]
     thr = split_bin[positions]
     dleft = default_left[positions]
     splitting = is_split[positions]
-    safe_feat = jnp.maximum(feat, 0)
+    if decision_axis is not None:
+        local_feat = feat - feat_offset
+        owned = (local_feat >= 0) & (local_feat < bins.shape[1])
+        safe_feat = jnp.clip(local_feat, 0, bins.shape[1] - 1)
+    else:
+        owned = None
+        safe_feat = jnp.maximum(feat, 0)
     b = jnp.take_along_axis(bins, safe_feat[:, None].astype(jnp.int32),
                             axis=1)[:, 0].astype(jnp.int32)
     missing = b == missing_bin
@@ -111,6 +128,10 @@ def update_positions(bins: jnp.ndarray, positions: jnp.ndarray,
         go_right = jnp.where(is_cat_split[positions],
                              cat_goes_right(b, node_words), go_right)
     go_right = jnp.where(missing, ~dleft, go_right)
+    if decision_axis is not None:
+        contrib = owned & splitting & go_right
+        go_right = jax.lax.psum(contrib.astype(jnp.int32),
+                                decision_axis) > 0
     return jnp.where(splitting,
                      2 * positions + 1 + go_right.astype(positions.dtype),
                      positions)

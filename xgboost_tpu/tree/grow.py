@@ -119,8 +119,11 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         node_lower = jnp.full((max_nodes,), -jnp.inf, jnp.float32)
         node_upper = jnp.full((max_nodes,), jnp.inf, jnp.float32)
     if constraint_sets is not None:
-        # features used on the path to each node (interaction constraints)
-        node_path = jnp.zeros((max_nodes, F), bool)
+        # features used on the path to each node (interaction constraints);
+        # GLOBAL feature width — under column split every shard tracks the
+        # replicated path with global ids
+        F_cons = constraint_sets.shape[1]
+        node_path = jnp.zeros((max_nodes, F_cons), bool)
     n_real_slots = max_nbins - 1 if has_missing else max_nbins
     n_words = (n_real_slots - 1) // 32 + 1 if cat is not None else 1
     is_cat_split = jnp.zeros((max_nodes,), bool)
@@ -131,6 +134,23 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     # node's split-feature column with one [n, F] @ [F, N] MXU matmul (bin ids
     # are < 2^24 so the f32 values are exact).
     bins_f32 = bins.astype(jnp.float32)
+
+    if col_split:
+        # this shard's bins columns are global features [off, off + F);
+        # constraint/cat arrays arrive GLOBAL (padded to world * F by the
+        # grower) — local split evaluation uses the shard's slice, while
+        # post-exchange bookkeeping (node bounds, interaction paths) keeps
+        # indexing the global arrays with the winner's global feature id
+        feat_off = jax.lax.axis_index(axis_name) * F
+        mono_loc = (None if monotone is None else
+                    jax.lax.dynamic_slice(monotone, (feat_off,), (F,)))
+        cat_loc = (None if cat is None else CatInfo(
+            is_cat=jax.lax.dynamic_slice(cat.is_cat, (feat_off,), (F,)),
+            is_onehot=jax.lax.dynamic_slice(cat.is_onehot, (feat_off,),
+                                            (F,))))
+    else:
+        feat_off = None
+        mono_loc, cat_loc = monotone, cat
 
     # The gather-free level ops materialise [n, n_level] intermediates; past
     # this level width the memory cost outweighs the gather cost, so deeper
@@ -246,22 +266,25 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         if constraint_sets is not None:
             # allowed(n) = union of constraint sets containing path(n)
             # (reference FeatureInteractionConstraintHost semantics)
-            path = node_path[lo:lo + n_level]                    # [N,F]
+            path = node_path[lo:lo + n_level]                    # [N,Fc]
             compat = ~jnp.any(path[:, None, :] & ~constraint_sets[None, :, :],
                               axis=2)                            # [N,S]
             allowed = jnp.any(compat[:, :, None]
-                              & constraint_sets[None, :, :], axis=1)  # [N,F]
+                              & constraint_sets[None, :, :], axis=1)  # [N,Fc]
+            if col_split:  # local feature-mask slice of the global allowance
+                allowed = jax.lax.dynamic_slice(
+                    allowed, (0, feat_off), (n_level, F))
             fmask = fmask & allowed
 
         parent_sum = node_sum[lo:lo + n_level]
         res = evaluate_splits(
             hist, parent_sum, n_real_bins, param, feature_mask=fmask,
-            monotone=monotone,
+            monotone=mono_loc,
             node_lower=node_lower[lo:lo + n_level]
             if monotone is not None else None,
             node_upper=node_upper[lo:lo + n_level]
             if monotone is not None else None,
-            cat=cat, has_missing=has_missing)
+            cat=cat_loc, has_missing=has_missing)
 
         if col_split:
             # column-split best-split exchange: all-gather per-shard best
@@ -283,13 +306,23 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
 
             local_feat, local_bin = res.feature, res.bin
             local_dl = res.default_left
-            res = res._replace(
+            local_is_cat, local_words = res.is_cat, res.cat_words
+            repl = dict(
                 gain=jnp.max(gains, axis=0),
                 feature=_sel(res.feature + my * F),
                 bin=_sel(res.bin),
                 default_left=_sel(res.default_left.astype(jnp.int32)) > 0,
                 left_sum=_sel2(res.left_sum),
                 right_sum=_sel2(res.right_sum))
+            if cat is not None:
+                # bitcast (not astype): the winner's uint32 bitmask words
+                # must cross the psum bit-exactly, and only one shard
+                # contributes a nonzero term per node
+                repl["is_cat"] = _sel(res.is_cat.astype(jnp.int32)) > 0
+                repl["cat_words"] = jax.lax.bitcast_convert_type(
+                    _sel2(jax.lax.bitcast_convert_type(
+                        res.cat_words, jnp.int32)), jnp.uint32)
+            res = res._replace(**repl)
 
         # a node exists at this level iff its parent split; it expands unless
         # the best gain fails the gamma / kRtEps test (reference prune rule).
@@ -338,7 +371,7 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                 jnp.where(can_split, r_hi, 0))
         if constraint_sets is not None:
             path = node_path[lo:lo + n_level]
-            fsel = (jnp.arange(F, dtype=jnp.int32)[None, :]
+            fsel = (jnp.arange(F_cons, dtype=jnp.int32)[None, :]
                     == jnp.maximum(res.feature, 0)[:, None]) \
                 & can_split[:, None]
             child_path = path | fsel
@@ -354,15 +387,22 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             delta = delta + jnp.sum(
                 jnp.where(rel_oh, w_level[None, :], 0.0), axis=1)
 
-        if col_split:
+        if col_split and n_level <= DENSE_LEVEL_MAX:
             # only the owning shard can route rows at each node; its local
             # decisions reach every shard through one boolean psum (the
-            # reference's partition-bitvector broadcast)
+            # reference's partition-bitvector broadcast). Categorical
+            # routing stays owner-local: the owner's bins hold the split
+            # feature, so its local cat bitmask words decide
             positions = advance_positions_level(
                 bins_f32, positions, rel,
                 jnp.where(can_split & mine, local_feat, -1),
                 jnp.where(can_split & mine, local_bin, 0),
                 can_split & mine & local_dl, can_split, missing_bin,
+                is_cat=(can_split & mine & local_is_cat)
+                if cat is not None else None,
+                cat_words=jnp.where(
+                    (mine & local_is_cat)[:, None], local_words,
+                    jnp.uint32(0)) if cat is not None else None,
                 decision_axis=axis_name)
         elif n_level <= DENSE_LEVEL_MAX:
             positions = advance_positions_level(
@@ -372,14 +412,18 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                 can_split & res.default_left, can_split, missing_bin,
                 is_cat=(can_split & res.is_cat) if cat is not None else None,
                 cat_words=res.cat_words if cat is not None else None)
-        else:  # deep level: per-row gather walk bounds memory to O(n)
+        else:  # deep level: per-row gather walk bounds memory to O(n);
+            # under col split the walk resolves only owned nodes and one
+            # psum broadcasts the decisions (update_positions docstring)
             is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(
                 can_split)
             positions = update_positions(
                 bins, positions, split_feature, split_bin, default_left,
                 is_split_full, missing_bin,
                 is_cat_split=is_cat_split if cat is not None else None,
-                cat_words=cat_words if cat is not None else None)
+                cat_words=cat_words if cat is not None else None,
+                decision_axis=axis_name if col_split else None,
+                feat_offset=feat_off)
 
         if use_compaction and depth + 1 < max_depth:
             # next level's per-node row counts pick each parent's smaller
@@ -436,25 +480,8 @@ class TreeGrower:
                  constraint_sets: Optional[np.ndarray] = None,
                  has_missing: bool = True,
                  split_mode: str = "row") -> None:
-        if split_mode == "col":
-            if mesh is None:
-                raise ValueError("data_split_mode=col requires a mesh")
-            if param.max_depth > 7:
-                # the owner-shard decision exchange uses the dense
-                # [rows, level] advance at every level; past 2^7 nodes the
-                # intermediates would dominate HBM (row mode switches to a
-                # gather walk there, which cannot express the cross-shard
-                # decision broadcast)
-                raise NotImplementedError(
-                    "data_split_mode=col supports max_depth <= 7")
-            if monotone is not None or constraint_sets is not None:
-                raise NotImplementedError(
-                    "data_split_mode=col does not support monotone/"
-                    "interaction constraints yet")
-            if cuts.is_cat().any():
-                raise NotImplementedError(
-                    "data_split_mode=col does not support categorical "
-                    "features yet")
+        if split_mode == "col" and mesh is None:
+            raise ValueError("data_split_mode=col requires a mesh")
         self.param = param
         self.max_nbins = max_nbins
         self.has_missing = has_missing
@@ -475,6 +502,26 @@ class TreeGrower:
                     is_cat & (n_real <= param.max_cat_to_onehot)))
         else:
             self.cat = None
+        if split_mode == "col":
+            # bins pad the feature axis to a multiple of the mesh width;
+            # the replicated GLOBAL constraint/cat arrays must match so
+            # each shard's dynamic slice [off, off + F_loc) stays in range
+            # (padding columns have n_real == 0 and can never win a split)
+            from ..context import DATA_AXIS
+
+            world = mesh.shape.get(DATA_AXIS, 1)
+            F = int(np.asarray(is_cat).shape[0])
+            pad = (-F) % world
+            if pad:
+                if self.monotone is not None:
+                    self.monotone = jnp.pad(self.monotone, (0, pad))
+                if self.constraint_sets is not None:
+                    self.constraint_sets = jnp.pad(
+                        self.constraint_sets, ((0, 0), (0, pad)))
+                if self.cat is not None:
+                    self.cat = CatInfo(
+                        is_cat=jnp.pad(self.cat.is_cat, (0, pad)),
+                        is_onehot=jnp.pad(self.cat.is_onehot, (0, pad)))
         self._sharded_fn = None
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
