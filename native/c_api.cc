@@ -11,6 +11,8 @@
 // Error contract mirrors the reference: every entry point returns 0/-1 and
 // XGBGetLastError() returns the last failure message for this thread.
 
+#include "c_api.h"  // the public ABI contract — drift becomes a compile error
+
 #include <algorithm>
 #include <cmath>
 #include <cstdint>

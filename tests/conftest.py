@@ -4,6 +4,22 @@ plan; same trick as the reference's InMemoryCommunicator multi-worker tests)."""
 
 import os
 
+# PER-RUN XLA compile cache dir: full-suite runs against the long-lived
+# shared cache crashed repeatedly inside jax 0.9's compilation-cache
+# read/write paths (a killed run leaves truncated entries behind for every
+# later process), and a cacheless long run still segfaulted in
+# backend_compile_and_load once enough programs accumulated in-process
+# (see _clear_jax_caches_between_modules below for that half of the fix).
+# A fresh per-run directory keeps intra-run reuse — dask/multiprocess
+# child processes warm-start from the parent's compiles — with no
+# cross-run corruption surface. xgboost_tpu's cache setup defers to an
+# explicit JAX_COMPILATION_CACHE_DIR, and jax reads it natively.
+import tempfile
+
+os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+    prefix="xtpu_test_jax_cache_")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
 # Must run before jax initializes its backends (jax may already be *imported*
 # by the environment's sitecustomize, but backends are created lazily).
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -41,6 +57,24 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(1994)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Full-suite runs accumulate hundreds of compiled XLA:CPU programs in
+    one process; past a point, fresh compiles started segfaulting inside
+    backend_compile_and_load nondeterministically (jax 0.9, 8-device
+    virtual CPU) — the same tests pass in a short session. Dropping the
+    executable caches at each module boundary keeps the process small and
+    has survived full single-shot runs where the unbounded process did
+    not. Costs per-module recompiles of shared helpers (~seconds)."""
+    yield
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:  # pragma: no cover
+        pass
 
 
 def make_regression(n=500, f=10, rng=None, missing_frac=0.0):

@@ -72,6 +72,18 @@ def test_missing_values_handled():
     assert res["train"]["rmse"][-1] < res["train"]["rmse"][0]
 
 
+def test_legacy_binf_model_rejected(tmp_path):
+    # reference pre-JSON binary models (src/learner.cc 'binf' magic,
+    # deprecated upstream) must fail with an actionable message, not a
+    # JSON decode error
+    p = tmp_path / "old.model"
+    p.write_bytes(b"binf\x00\x00\x00\x04garbage")
+    with pytest.raises(ValueError, match="legacy binary"):
+        xgb.Booster(model_file=str(p))
+    with pytest.raises(ValueError, match="legacy binary"):
+        xgb.Booster().load_model(p.read_bytes())
+
+
 def test_save_load_roundtrip(tmp_path):
     X, y = make_regression(300, 6)
     dm = xgb.DMatrix(X, label=y)

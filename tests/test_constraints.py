@@ -34,6 +34,7 @@ def test_monotone_increasing_and_decreasing():
     assert _is_monotone(bst, 1, -1, f)
 
 
+@pytest.mark.slow
 def test_monotone_unconstrained_differs():
     rng = np.random.RandomState(1)
     n = 2000

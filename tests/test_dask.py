@@ -31,6 +31,7 @@ def test_partition_normalisation_and_shards():
         dxgb.DaskDMatrix(None, Xp, yp[:2])
 
 
+@pytest.mark.slow
 def test_single_worker_train_predict():
     Xp, yp, X, y = _make_data(n_parts=3)
     client = dxgb.LocalProcessClient(n_workers=1)
@@ -61,6 +62,7 @@ def test_two_process_train_matches_single():
                                single.predict(dm), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sklearn_facade():
     Xp, yp, X, y = _make_data(n_parts=2)
     client = dxgb.LocalProcessClient(n_workers=1)

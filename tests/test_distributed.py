@@ -147,6 +147,7 @@ def test_col_split_with_missing(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_col_split_deep_tree(mesh):
     # depth > 7 exercises the col-split gather walk + decision psum
     # (rounds 1-2 capped col split at max_depth <= 7)

@@ -1210,9 +1210,23 @@ class Booster:
         from .utils.ubjson import dumps_ubjson
         return bytearray(dumps_ubjson(obj))
 
+    @staticmethod
+    def _reject_legacy_binary(head: bytes) -> None:
+        # reference legacy "binf" binary models (src/learner.cc binary
+        # path, deprecated there in 1.6 and removed semantics in 2.x):
+        # not supported here — fail with a pointer instead of a JSON error
+        if head.lstrip(b"\x00").startswith(b"binf") or head.startswith(
+                b"bs64"):
+            raise ValueError(
+                "this is a legacy binary ('binf') XGBoost model; the "
+                "deprecated pre-JSON format is not supported — re-save it "
+                "as JSON/UBJSON with reference XGBoost >= 1.6 "
+                "(booster.save_model('model.json')) and load that instead")
+
     def load_model(self, fname: Union[str, bytes, bytearray]) -> None:
         if isinstance(fname, (bytes, bytearray)):
             raw = bytes(fname)
+            self._reject_legacy_binary(raw[:16])
             # a UBJSON object also begins with the byte '{' — sniff JSON
             # first, fall back to the binary codec
             try:
@@ -1225,8 +1239,11 @@ class Booster:
             with open(fname, "rb") as fh:
                 obj = load_ubjson(fh)
         else:
-            with open(fname) as fh:
-                obj = json.load(fh)
+            with open(fname, "rb") as fh:
+                head = fh.read(16)
+                self._reject_legacy_binary(head)
+                fh.seek(0)
+                obj = json.loads(fh.read().decode())
         self._model_from_json(obj)
 
     def _model_to_json(self) -> dict:

@@ -143,10 +143,31 @@ class LocalProcessClient:
     def gather(self, futures: Sequence[int]) -> List[Any]:
         import multiprocessing as mp
 
+        # Bounded wait: a worker wedged in the distributed rendezvous
+        # (e.g. another process grabbed the probed coordinator port between
+        # Tracker's bind-and-release and rank 0's bind — a TOCTOU race two
+        # concurrent test sessions can hit) must surface as an error, not
+        # hang the caller forever in Pool.__exit__'s untimed join.
+        timeout = float(os.environ.get("XTPU_LOCAL_CLIENT_TIMEOUT", 600))
         ctx = mp.get_context("spawn")
-        with ctx.Pool(processes=max(len(self._pending), 1)) as pool:
+        pool = ctx.Pool(processes=max(len(self._pending), 1))
+        try:
             payloads = [pickle.dumps(job) for job in self._pending]
-            results = pool.map(_spawn_worker, payloads)
+            async_res = pool.map_async(_spawn_worker, payloads)
+            try:
+                results = async_res.get(timeout)
+            except mp.TimeoutError:
+                for p in getattr(pool, "_pool", []):
+                    if p.is_alive():
+                        p.kill()
+                raise RuntimeError(
+                    f"LocalProcessClient: workers did not finish within "
+                    f"{timeout:.0f}s (distributed rendezvous wedged?); "
+                    f"killed. Raise XTPU_LOCAL_CLIENT_TIMEOUT if the job "
+                    f"is legitimately that slow.") from None
+        finally:
+            pool.terminate()
+            pool.join()
         self._pending = []
         return [pickle.loads(r) for r in results]
 
