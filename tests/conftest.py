@@ -16,8 +16,14 @@ import os
 # explicit JAX_COMPILATION_CACHE_DIR, and jax reads it natively.
 import tempfile
 
-os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+# Opt-in warm dev loop: point XTPU_TEST_JAX_CACHE_DIR at a persistent
+# directory you own and repeated runs skip all XLA recompiles (the cold
+# default run is compile-dominated). The default stays a throwaway dir
+# because a shared cache is corruptible by killed runs (above).
+_cache_dir = os.environ.get("XTPU_TEST_JAX_CACHE_DIR") or tempfile.mkdtemp(
     prefix="xtpu_test_jax_cache_")
+os.makedirs(_cache_dir, exist_ok=True)
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
 # Must run before jax initializes its backends (jax may already be *imported*
@@ -36,7 +42,12 @@ try:
 
     # sitecustomize may have imported jax with JAX_PLATFORMS=axon already
     # latched into the config; env alone is not enough at this point.
+    # Same for the cache dir: config env vars are read at jax import time,
+    # so the JAX_COMPILATION_CACHE_DIR set above only reaches THIS process
+    # through an explicit update (spawned children do get it via env).
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     from jax._src import xla_bridge as _xb
 
     for _name in list(getattr(_xb, "_backend_factories", {})):

@@ -54,6 +54,7 @@ def test_categorical_sorted_partition():
     assert multi
 
 
+@pytest.mark.slow
 def test_categorical_pandas():
     X, y, _ = _cat_data(n_cat=5, seed=2)
     df = pd.DataFrame({

@@ -2,6 +2,7 @@
 tests/python/test_monotone_constraints.py and interaction tests)."""
 
 import numpy as np
+import pytest
 
 import xgboost_tpu as xgb
 

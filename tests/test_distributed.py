@@ -163,6 +163,7 @@ def test_col_split_deep_tree(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_col_split_categorical(mesh):
     # categorical one-hot AND sorted-partition splits under col split: the
     # winner's cat bitmask words cross the best-split exchange bit-exactly
@@ -188,6 +189,7 @@ def test_col_split_categorical(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_col_split_monotone_and_interaction(mesh):
     rng = np.random.RandomState(13)
     X = rng.randn(2500, 6).astype(np.float32)
