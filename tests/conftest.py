@@ -20,9 +20,11 @@ import tempfile
 # directory you own and repeated runs skip all XLA recompiles (the cold
 # default run is compile-dominated). The default stays a throwaway dir
 # because a shared cache is corruptible by killed runs (above).
-_cache_dir = os.environ.get("XTPU_TEST_JAX_CACHE_DIR") or tempfile.mkdtemp(
-    prefix="xtpu_test_jax_cache_")
+_cache_dir = os.environ.get("XTPU_TEST_JAX_CACHE_DIR")
+_cache_dir = (os.path.abspath(os.path.expanduser(_cache_dir)) if _cache_dir
+              else tempfile.mkdtemp(prefix="xtpu_test_jax_cache_"))
 os.makedirs(_cache_dir, exist_ok=True)
+os.environ["XTPU_TEST_JAX_CACHE_DIR"] = _cache_dir
 os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
@@ -47,7 +49,9 @@ try:
     # through an explicit update (spawned children do get it via env).
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
     from jax._src import xla_bridge as _xb
 
     for _name in list(getattr(_xb, "_backend_factories", {})):

@@ -78,11 +78,13 @@ class Objective:
 
         k = self.n_targets(info)
         zero = jnp.zeros((len(info.labels), k), dtype=jnp.float32)
-        gpair = np.asarray(self.get_gradient(zero, info))
+        # reduce ON DEVICE and pull only the [2, k] sums: materialising the
+        # [n, k, 2] gradient host-side costs an n-proportional transfer
+        # (~0.9 s of every train() call at 1M rows over the tunnel)
+        gpair = jnp.asarray(self.get_gradient(zero, info))
+        sums = gpair.sum(axis=0).T                       # one pass -> [2, k]
         row_split = getattr(info, "data_split_mode", "row") == "row"
-        gh = global_sum(
-            np.stack([gpair[..., 0].sum(axis=0), gpair[..., 1].sum(axis=0)]),
-            row_split=row_split)
+        gh = global_sum(np.asarray(sums), row_split=row_split)
         g, h = gh[0], gh[1]
         return np.where(h <= 0, 0.0, -g / np.maximum(h, 1e-10)).astype(np.float32)
 
