@@ -212,3 +212,48 @@ def test_weighted_training():
     hi = np.mean((p[:300] - y[:300]) ** 2)
     lo = np.mean((p[300:] - y[300:]) ** 2)
     assert hi < lo  # heavily weighted rows fit better
+
+
+@pytest.mark.parametrize("obj", ["rank:ndcg", "rank:pairwise"])
+@pytest.mark.parametrize("exp_gain", [True, False])
+def test_lambdarank_device_matches_host_loop(obj, exp_gain, monkeypatch):
+    # the padded [G, L, L] device gradient must reproduce the per-group
+    # host loop's math (topk default = deterministic all-anchor pairs),
+    # f32 vs f64 tolerance only; ragged groups + per-query weights
+    from xgboost_tpu.objective import get_objective
+
+    rng = np.random.RandomState(3)
+    sizes = [1, 7, 30, 2, 13]
+    y = np.concatenate([rng.randint(0, 4, s) for s in sizes]).astype(
+        np.float32)
+    s = rng.randn(len(y)).astype(np.float32)
+    ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    w = rng.rand(len(sizes)).astype(np.float32) + 0.5
+    info = _Info(y, group_ptr=ptr, weights=w)
+    params = {"ndcg_exp_gain": str(exp_gain).lower()}
+
+    o_dev = get_objective(obj, dict(params))
+    g_dev = np.asarray(o_dev.get_gradient(s, info))
+    monkeypatch.setenv("XTPU_RANK_HOST", "1")
+    o_host = get_objective(obj, dict(params))
+    g_host = np.asarray(o_host.get_gradient(s, info))
+    np.testing.assert_allclose(g_dev, g_host, rtol=2e-4, atol=1e-6)
+
+
+def test_lambdarank_device_respects_num_pair_cap(monkeypatch):
+    # kcap anchors only the currently top-ranked docs (pre-orientation),
+    # exactly like the host _pairs
+    from xgboost_tpu.objective import get_objective
+
+    rng = np.random.RandomState(5)
+    y = rng.randint(0, 3, 40).astype(np.float32)
+    s = rng.randn(40).astype(np.float32)
+    ptr = np.asarray([0, 18, 40], np.int64)
+    info = _Info(y, group_ptr=ptr)
+    params = {"lambdarank_num_pair_per_sample": 4}
+    g_dev = np.asarray(get_objective("rank:ndcg", dict(params))
+                       .get_gradient(s, info))
+    monkeypatch.setenv("XTPU_RANK_HOST", "1")
+    g_host = np.asarray(get_objective("rank:ndcg", dict(params))
+                        .get_gradient(s, info))
+    np.testing.assert_allclose(g_dev, g_host, rtol=2e-4, atol=1e-6)

@@ -142,3 +142,22 @@ def test_pallas_compiled_on_tpu_matches_segment():
         scale = max(np.abs(ref).max(), 1.0)
         np.testing.assert_allclose(got / scale, ref / scale,
                                    **TOL[precision])
+
+
+@pytest.mark.skipif(os.environ.get("BENCH_TPU") != "1",
+                    reason="real-chip smoke test; set BENCH_TPU=1")
+def test_pallas_wide_feature_matrix_fits_vmem_on_tpu():
+    # F=136 (MSLR-shape): the whole-F accumulator would be 8.9 MB at
+    # N=32 — the feat_block auto-pick must leave scoped-VMEM headroom for
+    # the one-hot plane/PT4/temporaries (a 12 MB budget OOMed Mosaic at
+    # 17.53M > 16M); only a real-chip compile exercises that limit
+    import jax
+
+    assert jax.default_backend() == "tpu"
+    n, F, max_nbins, n_nodes = 50_000, 136, 256, 32
+    bins, gpair, rel = _data(n, F, max_nbins, n_nodes, seed=2)
+    ref = _reference(bins, gpair, rel, n_nodes, max_nbins)
+    got = np.asarray(build_hist_pallas(
+        bins.T, gpair, rel, n_nodes, max_nbins, precision="int8x2"))
+    scale = max(np.abs(ref).max(), 1.0)
+    np.testing.assert_allclose(got / scale, ref / scale, **TOL["int8x2"])

@@ -1,18 +1,31 @@
 """LambdaRank objectives: rank:ndcg, rank:map, rank:pairwise.
 
 Reference: ``src/objective/lambdarank_obj.cc:44-160,620-628`` + caches in
-``src/common/ranking_utils.h``. Per query group, pairs (i, j) with
+``src/common/ranking_utils.h`` and the CUDA pair kernels in
+``src/objective/lambdarank_obj.cu``. Per query group, pairs (i, j) with
 label_i > label_j get the RankNet lambda scaled by the metric delta
 (|ΔNDCG| / |ΔMAP| / 1). Pair generation follows the reference's two modes:
 ``mean`` (k random pairs per doc) and ``topk`` (pairs anchored at the current
-top-k). Gradients are computed per group with numpy on host — ragged groups
-don't fit static XLA shapes; the tree build (the hot path) stays on device.
+top-k).
+
+The default ``topk`` mode is RNG-free (anchors × all docs, deterministic),
+so for rank:ndcg / rank:pairwise the gradient runs ON DEVICE: groups pad
+into a ``[G, L]`` matrix (L = longest group), per-group ranks come from two
+stable argsorts, and the full pair interaction is a ``[G, L, L]`` VPU
+tensor, chunked over groups by ``lax.map`` to bound memory — the TPU
+answer to the reference's per-pair CUDA kernels. At 200k x 136 with 800
+groups this is ~100x the per-group numpy loop, which remains the fallback
+for ``mean`` sampling and rank:map (MAP's prefix statistics are cheap host
+work) and can be forced with XTPU_RANK_HOST=1.
 """
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -26,6 +39,69 @@ def _dcg_discount(ranks: np.ndarray) -> np.ndarray:
 
 def _gains(labels: np.ndarray, exp_gain: bool) -> np.ndarray:
     return (np.power(2.0, labels) - 1.0) if exp_gain else labels
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kcap", "L", "exp_gain", "pairwise", "chunk",
+                     "n_groups"))
+def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
+                        kcap, L, exp_gain, pairwise, chunk, n_groups):
+    """All-pairs LambdaRank lambdas over padded [G, L] groups.
+
+    Exactly the host loop's math (orientation, RankNet clip, 1e-16 hessian
+    floor) in f32. ``kcap`` = 0 means every doc anchors (the topk default);
+    otherwise only docs currently ranked < kcap anchor pairs — matching the
+    anchor-before-orientation semantics of ``_pairs``.
+    """
+    Gp = -(-n_groups // chunk) * chunk
+    s_pad = jnp.full((Gp, L), -jnp.inf, jnp.float32).at[qidx, slot].set(s)
+    y_pad = jnp.zeros((Gp, L), jnp.float32).at[qidx, slot].set(y)
+    valid = jnp.zeros((Gp, L), bool).at[qidx, slot].set(True)
+    sz = jnp.zeros((Gp,), jnp.int32).at[:n_groups].set(
+        sizes.astype(jnp.int32))
+    kc = sz if kcap == 0 else jnp.minimum(kcap, sz)
+    disc = 1.0 / jnp.log2(jnp.arange(L, dtype=jnp.float32) + 2.0)
+
+    def gains_j(v):
+        return (jnp.exp2(v) - 1.0) if exp_gain else v
+
+    def one_chunk(args):
+        sp, yp, vp, kcc = args                       # [C, L] / [C]
+        order = jnp.argsort(-sp, axis=1, stable=True)
+        rank_of = jnp.argsort(order, axis=1, stable=True)  # inverse perm
+        y_desc = -jnp.sort(-yp, axis=1)
+        idcg = jnp.sum(gains_j(y_desc) * disc[None, :], axis=1)
+        inv_idcg = jnp.where(idcg > 0, 1.0 / idcg, 0.0)
+        gv = gains_j(yp)                              # [C, L]
+        dv = disc[rank_of]                            # [C, L]
+        yi, yj = yp[:, :, None], yp[:, None, :]
+        mask = (vp[:, :, None] & vp[:, None, :] & (yi != yj)
+                & (rank_of < kcc[:, None])[:, :, None])
+        a_is_i = yi > yj
+        if pairwise:
+            delta = jnp.float32(1.0)
+        else:
+            delta = jnp.abs((gv[:, :, None] - gv[:, None, :])
+                            * (dv[:, :, None] - dv[:, None, :])
+                            ) * inv_idcg[:, None, None]
+        sij = jnp.where(a_is_i, sp[:, :, None] - sp[:, None, :],
+                        sp[:, None, :] - sp[:, :, None])
+        p = 1.0 / (1.0 + jnp.exp(jnp.clip(sij, -50.0, 50.0)))
+        lam = jnp.where(mask, -p * delta, 0.0)
+        hes = jnp.where(mask, jnp.maximum(p * (1.0 - p) * delta, 1e-16),
+                        0.0)
+        g = (jnp.where(a_is_i, lam, -lam).sum(axis=2)
+             + jnp.where(a_is_i, -lam, lam).sum(axis=1))
+        h = hes.sum(axis=2) + hes.sum(axis=1)
+        return g, h
+
+    cs = lambda a: a.reshape(Gp // chunk, chunk, *a.shape[1:])
+    g_pad, h_pad = jax.lax.map(one_chunk,
+                               (cs(s_pad), cs(y_pad), cs(valid), cs(kc)))
+    g = g_pad.reshape(Gp, L)[qidx, slot] * w_row
+    h = h_pad.reshape(Gp, L)[qidx, slot] * w_row
+    return jnp.stack([g, h], axis=-1)[:, None, :]    # [n, 1, 2] f32
 
 
 class _LambdaRankBase(Objective):
@@ -52,15 +128,63 @@ class _LambdaRankBase(Objective):
     def _delta(self, y, i, j, rank_of, inv_idcg, exp_gain) -> np.ndarray:
         raise NotImplementedError
 
+    def _device_layout(self, info):
+        """Cached padded-group indexing arrays (+ per-row weights). The key
+        hashes the CONTENT of labels/groups/weights, not object identity:
+        a mutated-in-place MetaInfo or a recycled id() must rebuild, or the
+        device gradient would silently use stale y/slots (the host path
+        re-reads them every call). Hashing ~1 MB of label bytes is ~0.1 ms
+        against a multi-hundred-ms gradient."""
+        ptr = np.asarray(info.group_ptr, dtype=np.int64)
+        y_np = np.asarray(info.labels, np.float32).reshape(-1)
+        w_np = (None if info.weights is None
+                else np.asarray(info.weights, np.float32))
+        key = (hash(ptr.tobytes()), hash(y_np.tobytes()),
+               None if w_np is None else hash(w_np.tobytes()))
+        cached = getattr(self, "_dev_layout", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        sizes = np.diff(ptr)
+        G, L = len(sizes), int(sizes.max(initial=1))
+        qidx = np.repeat(np.arange(G, dtype=np.int32), sizes)
+        slot = (np.arange(ptr[-1], dtype=np.int32)
+                - np.repeat(ptr[:-1], sizes).astype(np.int32))
+        if w_np is not None:
+            w_row = np.repeat(w_np, sizes) if len(w_np) == G else w_np
+        else:
+            w_row = np.ones(int(ptr[-1]), np.float32)
+        layout = dict(
+            G=G, L=L,
+            qidx=jnp.asarray(qidx), slot=jnp.asarray(slot),
+            sizes=jnp.asarray(sizes, jnp.int32),
+            w_row=jnp.asarray(w_row),
+            y=jnp.asarray(y_np),
+            # chunk groups so one [C, L, L] pair block stays ~64 MB
+            chunk=max(1, min(G, (1 << 24) // max(L * L, 1))))
+        self._dev_layout = (key, layout)
+        return layout
+
     def get_gradient(self, preds, info, iteration=0):
         if info.group_ptr is None:
             raise ValueError(f"{self.name} requires query group information "
                              "(set group= or qid= on the DMatrix)")
+        method = str(self.params.get("lambdarank_pair_method", "topk"))
+        exp_gain = str(self.params.get("ndcg_exp_gain", "true")).lower() \
+            not in ("false", "0")
+        if (method == "topk" and self.name in ("rank:ndcg", "rank:pairwise")
+                and os.environ.get("XTPU_RANK_HOST") != "1"):
+            lay = self._device_layout(info)
+            n = lay["y"].shape[0]
+            s = jnp.asarray(preds, jnp.float32).reshape(-1)[:n]
+            kcap = int(self.params.get("lambdarank_num_pair_per_sample", 0))
+            return _lambda_grad_device(
+                s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
+                lay["w_row"], kcap=kcap, L=lay["L"], exp_gain=exp_gain,
+                pairwise=self.name == "rank:pairwise", chunk=lay["chunk"],
+                n_groups=lay["G"])
         y_all = np.asarray(info.labels, dtype=np.float64).reshape(-1)
         s_all = np.asarray(preds, dtype=np.float64).reshape(-1)[: len(y_all)]
         ptr = np.asarray(info.group_ptr, dtype=np.int64)
-        exp_gain = str(self.params.get("ndcg_exp_gain", "true")).lower() \
-            not in ("false", "0")
         rng = np.random.RandomState(int(self.params.get("seed", 0))
                                     + iteration)
         g = np.zeros_like(s_all)

@@ -232,15 +232,20 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
             # the node-scatter PT4 is built once per ROW block instead of
             # once per (feature block, row block). Pallas block specs
             # allow any first-dim size equal to the full array dim;
-            # otherwise fall back to a multiple of 8.
-            if F * B * 2 * N * 4 <= 12 * 2 ** 20:
+            # otherwise fall back to a multiple of 8. Budget: the 16M
+            # scoped-VMEM limit must also hold the one-hot plane, PT4,
+            # double-buffered input blocks and SWAR temporaries — 8M for
+            # the accumulator leaves that headroom (a 12M budget OOMed
+            # the Mosaic stack at F=136, B=256, N=32: 17.53M > 16M).
+            budget = 8 * 2 ** 20
+            if F * B * 2 * N * 4 <= budget:
                 feat_block = F
             else:
                 # split F into the fewest VMEM-fitting blocks, sized to
                 # MINIMIZE feature padding (a cap-sized block can pad F
                 # nearly 2x — every padded feature costs a one-hot build)
                 per_feat = B * 2 * N * 4
-                cap = max(8, (12 * 2 ** 20 // per_feat) // 8 * 8)
+                cap = max(8, (budget // per_feat) // 8 * 8)
                 n_blocks = -(-F // cap)
                 feat_block = min(cap, _round_up(-(-F // n_blocks), 8))
         else:
