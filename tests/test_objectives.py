@@ -232,6 +232,7 @@ def test_lambdarank_device_matches_host_loop(obj, exp_gain, monkeypatch):
     info = _Info(y, group_ptr=ptr, weights=w)
     params = {"ndcg_exp_gain": str(exp_gain).lower()}
 
+    monkeypatch.delenv("XTPU_RANK_HOST", raising=False)
     o_dev = get_objective(obj, dict(params))
     g_dev = np.asarray(o_dev.get_gradient(s, info))
     monkeypatch.setenv("XTPU_RANK_HOST", "1")
@@ -251,6 +252,7 @@ def test_lambdarank_device_respects_num_pair_cap(monkeypatch):
     ptr = np.asarray([0, 18, 40], np.int64)
     info = _Info(y, group_ptr=ptr)
     params = {"lambdarank_num_pair_per_sample": 4}
+    monkeypatch.delenv("XTPU_RANK_HOST", raising=False)
     g_dev = np.asarray(get_objective("rank:ndcg", dict(params))
                        .get_gradient(s, info))
     monkeypatch.setenv("XTPU_RANK_HOST", "1")

@@ -15,6 +15,7 @@ category codes follow the missing direction.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, Optional
 
 import jax
@@ -132,7 +133,17 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
 
 
 class ForestPredictor:
-    """Holds the stacked device forest and dispatches prediction variants."""
+    """Holds the stacked device forest and dispatches prediction variants.
+
+    The stacked arrays pad BOTH axes to the next power of two — extra
+    trees are inert single leaves with tree weight 0 (their contribution
+    is exactly 0.0, so results are bit-identical) and extra node slots
+    are unreachable leaves. A growing forest therefore compiles
+    O(log T) distinct walk programs instead of one per tree count —
+    without this, dart (whose dropped-tree margin recompute runs per
+    round) and predict-after-every-round loops recompiled every round,
+    and the ≤2x padded walk FLOPs are noise next to a 20-40 s tunnel
+    compile each."""
 
     def __init__(self, forest: Dict[str, np.ndarray], tree_info: np.ndarray,
                  n_groups: int, tree_weights: Optional[np.ndarray] = None) -> None:
@@ -140,39 +151,102 @@ class ForestPredictor:
         self.max_depth = int(forest.pop("depth", 0))
         self.n_trees, self.max_nodes = forest["split_feature"].shape
         self.n_groups = n_groups
-        self.dev = {k: jnp.asarray(v) for k, v in forest.items()}
+        Tp = 1 << max(self.n_trees - 1, 0).bit_length()
+        Mp = 1 << max(self.max_nodes - 1, 0).bit_length()
+        pad_fill = {"split_feature": -1, "left_child": -1, "right_child": -1,
+                    "default_left": False, "is_leaf": True}
+
+        def pad(k, v):
+            pt, pm = Tp - v.shape[0], Mp - v.shape[1]
+            if pt == 0 and pm == 0:
+                return v
+            width = [(0, pt), (0, pm)] + [(0, 0)] * (v.ndim - 2)
+            return np.pad(v, width, constant_values=pad_fill.get(k, 0))
+
+        padded = {k: pad(k, np.asarray(v)) for k, v in forest.items()}
         self.has_cat = "cat_words" in forest
         w = np.ones(self.n_trees) if tree_weights is None else tree_weights
-        self.tree_weight = jnp.asarray(w, dtype=jnp.float32)
-        onehot = np.zeros((self.n_trees, n_groups), dtype=np.float32)
+        w_pad = np.pad(np.asarray(w, np.float32), (0, Tp - self.n_trees))
+        onehot = np.zeros((Tp, n_groups), dtype=np.float32)
         onehot[np.arange(self.n_trees), np.asarray(tree_info)] = 1.0
-        self.group_onehot = jnp.asarray(onehot)
+        self._padded, self._w_pad, self._onehot = padded, w_pad, onehot
+        self._chunk_cache = {}
 
-    def _cat_args(self):
+    def _chunk_devs(self, n_rows: int):
+        """Per-chunk device forests, chunk size adapted to the batch: the
+        axon AOT compile helper crashes on walk programs past roughly
+        2^24-2^25 row-tree pairs ([581k, 64] dies, [581k, 16] compiles),
+        so the tree axis is split to keep n_rows * chunk under 2^24 —
+        also bounding the compiled-program set. Override with
+        XTPU_PREDICT_TREE_CHUNK."""
+        env = os.environ.get("XTPU_PREDICT_TREE_CHUNK")
+        if env:
+            step = max(1, int(env))
+        else:
+            budget = (1 << 24) // max(n_rows, 1)
+            step = max(8, min(self.TREE_CHUNK,
+                              1 << max(budget, 1).bit_length() - 1))
+        if step not in self._chunk_cache:
+            Tp = self._padded["split_feature"].shape[0]
+            chunks = []
+            for lo in range(0, Tp, step):
+                hi = min(lo + step, Tp)
+                chunks.append(dict(
+                    dev={k: jnp.asarray(v[lo:hi])
+                         for k, v in self._padded.items()},
+                    tree_weight=jnp.asarray(self._w_pad[lo:hi]),
+                    group_onehot=jnp.asarray(self._onehot[lo:hi])))
+            self._chunk_cache[step] = chunks
+        return self._chunk_cache[step]
+
+    # Walk programs are additionally bounded to TREE_CHUNK trees per
+    # dispatch: margins of chunks sum exactly (each tree's contribution is
+    # independent), the compiled-program set stays small AND bounded in
+    # size — the axon tunnel's AOT compile helper crashes outright on
+    # [rows, T] walk programs past a few hundred thousand row-tree pairs
+    # per gather (docs/performance.md "known environment limitation").
+    TREE_CHUNK = 64
+
+    def _cat_args(self, dev):
         if self.has_cat:
-            return self.dev["is_cat_split"], self.dev["cat_words"]
+            return dev["is_cat_split"], dev["cat_words"]
         return None, None
 
+    def _walk_chunked(self, run, base, n_rows):
+        based = jnp.asarray(base, dtype=jnp.float32)
+        zero = jnp.zeros_like(based)
+        m_total, pos_parts = None, []
+        for i, ch in enumerate(self._chunk_devs(n_rows)):
+            m, pos = run(ch, based if i == 0 else zero)
+            m_total = m if m_total is None else m_total + m
+            pos_parts.append(pos)
+        pos = (pos_parts[0] if len(pos_parts) == 1
+               else jnp.concatenate(pos_parts, axis=1))
+        return m_total, pos[:, : self.n_trees]
+
     def margin(self, X: jnp.ndarray, base: np.ndarray):
-        ics, cw = self._cat_args()
-        m, pos = _predict_margin(
-            self.dev["split_feature"], self.dev["split_value"],
-            self.dev["default_left"], self.dev["is_leaf"],
-            self.dev["left_child"], self.dev["right_child"],
-            self.dev["leaf_value"], self.tree_weight, self.group_onehot,
-            jnp.asarray(X, dtype=jnp.float32),
-            jnp.asarray(base, dtype=jnp.float32), self.max_depth,
-            ics, cw)
-        return m, pos
+        Xd = jnp.asarray(X, dtype=jnp.float32)
+
+        def run(ch, b):
+            d = ch["dev"]
+            ics, cw = self._cat_args(d)
+            return _predict_margin(
+                d["split_feature"], d["split_value"], d["default_left"],
+                d["is_leaf"], d["left_child"], d["right_child"],
+                d["leaf_value"], ch["tree_weight"], ch["group_onehot"],
+                Xd, b, self.max_depth, ics, cw)
+
+        return self._walk_chunked(run, base, int(Xd.shape[0]))
 
     def margin_binned(self, bins: jnp.ndarray, missing_bin: int,
                       base: np.ndarray):
-        ics, cw = self._cat_args()
-        m, pos = _predict_margin_binned(
-            self.dev["split_feature"], self.dev["split_bin"],
-            self.dev["default_left"], self.dev["is_leaf"],
-            self.dev["left_child"], self.dev["right_child"],
-            self.dev["leaf_value"], self.tree_weight, self.group_onehot,
-            bins, jnp.asarray(base, dtype=jnp.float32), self.max_depth,
-            missing_bin, ics, cw)
-        return m, pos
+        def run(ch, b):
+            d = ch["dev"]
+            ics, cw = self._cat_args(d)
+            return _predict_margin_binned(
+                d["split_feature"], d["split_bin"], d["default_left"],
+                d["is_leaf"], d["left_child"], d["right_child"],
+                d["leaf_value"], ch["tree_weight"], ch["group_onehot"],
+                bins, b, self.max_depth, missing_bin, ics, cw)
+
+        return self._walk_chunked(run, base, int(bins.shape[0]))
