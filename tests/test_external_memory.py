@@ -178,3 +178,169 @@ def test_paged_training_under_communicator(tmp_path, monkeypatch):
         lo = 0 if rank == 0 else n_half
         np.testing.assert_allclose(preds, preds_ref[lo:lo + len(preds)],
                                    rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 scope lift: categorical / monotone / interaction / max_leaves all
+# work on the streamed path, matching the resident path on the same cuts
+# (reference: these features are orthogonal to paging — the external-memory
+# updater reuses the same evaluator, src/tree/updater_quantile_hist.cc).
+
+
+class TypedBatchIter(BatchIter):
+    """BatchIter that also announces feature_types (the reference DataIter
+    ``input_data(..., feature_types=...)`` protocol)."""
+
+    def __init__(self, X, y, feature_types, n_batches=4):
+        super().__init__(X, y, n_batches)
+        self.ft = feature_types
+
+    def next(self, input_data) -> int:
+        if self.i >= len(self.parts):
+            return 0
+        idx = self.parts[self.i]
+        input_data(data=self.X[idx], label=self.y[idx],
+                   feature_types=self.ft)
+        self.i += 1
+        return 1
+
+
+def _paged_vs_resident(tmp_path, monkeypatch, make_iter, params, rounds=6,
+                       max_bin=64):
+    """Train the same config on the streamed and the resident tier built
+    from the SAME iterator (identical cuts); return both boosters."""
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "500")
+    it = make_iter()
+    it.cache_prefix = str(tmp_path / "pc")
+    qdm_p = xgb.QuantileDMatrix(it, max_bin=max_bin)
+    assert qdm_p.binned(max_bin).n_pages() > 1
+    qdm_m = xgb.QuantileDMatrix(make_iter(), max_bin=max_bin)
+    bst_p = xgb.train(params, qdm_p, rounds, verbose_eval=False)
+    bst_m = xgb.train(params, qdm_m, rounds, verbose_eval=False)
+    return bst_p, bst_m
+
+
+def _assert_same_forest(bst_p, bst_m):
+    assert len(bst_p.gbm.trees) == len(bst_m.gbm.trees)
+    for tp, tm in zip(bst_p.gbm.trees, bst_m.gbm.trees):
+        np.testing.assert_array_equal(tp.split_feature, tm.split_feature)
+        np.testing.assert_array_equal(tp.split_bin, tm.split_bin)
+        np.testing.assert_array_equal(tp.is_cat_split, tm.is_cat_split)
+        np.testing.assert_array_equal(tp.cat_words, tm.cat_words)
+        # leaves accumulate gradients in page order; the reassociation
+        # drift feeds back through the margin and compounds per round
+        np.testing.assert_allclose(tp.leaf_value, tm.leaf_value,
+                                   rtol=2e-3, atol=1e-5)
+
+
+def test_paged_monotone_matches_resident(tmp_path, monkeypatch):
+    rng = np.random.RandomState(7)
+    X = rng.randn(4000, 4).astype(np.float32)
+    y = (np.sin(2 * X[:, 0]) + X[:, 1]
+         + 0.1 * rng.randn(4000)).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "monotone_constraints": "(1,-1,0,0)"}
+    bst_p, bst_m = _paged_vs_resident(
+        tmp_path, monkeypatch, lambda: BatchIter(X, y, n_batches=4), params)
+    _assert_same_forest(bst_p, bst_m)
+    # the constraint itself must hold on the streamed model: prediction
+    # non-decreasing along feature 0, non-increasing along feature 1
+    base = np.tile(np.median(X, axis=0), (25, 1)).astype(np.float32)
+    for f, sign in ((0, +1), (1, -1)):
+        grid = base.copy()
+        grid[:, f] = np.linspace(X[:, f].min(), X[:, f].max(), 25)
+        p = bst_p.predict(xgb.DMatrix(grid))
+        d = np.diff(p) * sign
+        assert (d >= -1e-5).all()
+
+
+def test_paged_interaction_matches_resident(tmp_path, monkeypatch):
+    rng = np.random.RandomState(8)
+    X = rng.randn(4000, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3]
+         + 0.1 * rng.randn(4000)).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "interaction_constraints": "[[0,1],[2,3]]"}
+    bst_p, bst_m = _paged_vs_resident(
+        tmp_path, monkeypatch, lambda: BatchIter(X, y, n_batches=4), params)
+    _assert_same_forest(bst_p, bst_m)
+    groups = [{0, 1}, {2, 3}]
+    for tree in bst_p.gbm.trees:
+        def walk(h, path):
+            if h >= len(tree.is_leaf) or tree.is_leaf[h]:
+                if path:
+                    assert any(path <= g for g in groups), path
+                return
+            path = path | {int(tree.split_feature[h])}
+            walk(2 * h + 1, path)
+            walk(2 * h + 2, path)
+        walk(0, set())
+
+
+def test_paged_max_leaves_matches_resident(tmp_path, monkeypatch):
+    X, y = _data(seed=11)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3,
+              "max_bin": 64, "max_leaves": 9}
+    bst_p, bst_m = _paged_vs_resident(
+        tmp_path, monkeypatch, lambda: BatchIter(X, y, n_batches=4), params)
+    _assert_same_forest(bst_p, bst_m)
+    for tree in bst_p.gbm.trees:  # compact layout: every node exists
+        assert int(tree.is_leaf.sum()) <= 9
+
+
+def test_paged_categorical_matches_resident(tmp_path, monkeypatch):
+    rng = np.random.RandomState(12)
+    n, k = 4000, 9
+    cat = rng.randint(0, k, n).astype(np.float32)
+    num = rng.randn(n, 3).astype(np.float32)
+    X = np.column_stack([cat, num]).astype(np.float32)
+    effect = rng.randn(k)
+    y = (effect[cat.astype(int)] + 0.5 * num[:, 0]
+         + 0.1 * rng.randn(n) > 0).astype(np.float32)
+    ft = ["c", "float", "float", "float"]
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "max_cat_to_onehot": 4}
+    bst_p, bst_m = _paged_vs_resident(
+        tmp_path, monkeypatch,
+        lambda: TypedBatchIter(X, y, ft, n_batches=4), params)
+    _assert_same_forest(bst_p, bst_m)
+    # at least one categorical split was actually chosen
+    assert any(t.is_cat_split.any() for t in bst_p.gbm.trees)
+    # the streamed categorical model predicts sensibly on a raw matrix
+    dmx = xgb.DMatrix(X, feature_types=ft, enable_categorical=True)
+    p = bst_p.predict(dmx)
+    from sklearn.metrics import roc_auc_score
+
+    assert roc_auc_score(y, p) > 0.9
+
+
+def test_iterator_cat_types_announced_late(tmp_path):
+    """feature_types may arrive on ANY batch; category codes seen in
+    batches before the announcement must still be covered by the cuts."""
+    X0 = np.asarray([[8.0], [1.0]], np.float32)   # max code ONLY here
+    X1 = np.asarray([[2.0], [0.0]], np.float32)
+    y0 = np.asarray([1.0, 0.0], np.float32)
+    y1 = np.asarray([0.0, 1.0], np.float32)
+
+    class LateTypesIter(xgb.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.i = 0
+
+        def next(self, input_data) -> int:
+            if self.i == 0:
+                input_data(data=X0, label=y0)
+            elif self.i == 1:
+                input_data(data=X1, label=y1, feature_types=["c"])
+            else:
+                return 0
+            self.i += 1
+            return 1
+
+        def reset(self) -> None:
+            self.i = 0
+
+    qdm = xgb.QuantileDMatrix(LateTypesIter(), max_bin=16)
+    cuts = qdm.binned(16).cuts
+    assert cuts.is_cat()[0]
+    assert cuts.n_real_bins()[0] == 9  # codes 0..8

@@ -184,8 +184,11 @@ class ForestPredictor:
             step = max(1, int(env))
         else:
             budget = (1 << 24) // max(n_rows, 1)
-            step = max(8, min(self.TREE_CHUNK,
-                              1 << max(budget, 1).bit_length() - 1))
+            # largest pow2 <= budget, clamped to [1, TREE_CHUNK]; no floor —
+            # for multi-million-row batches the budget drops below 8 and
+            # forcing 8 trees/dispatch would put the walk program right
+            # back in the compile-helper crash range
+            step = min(self.TREE_CHUNK, 1 << max(budget, 1).bit_length() - 1)
         if step not in self._chunk_cache:
             Tp = self._padded["split_feature"].shape[0]
             chunks = []

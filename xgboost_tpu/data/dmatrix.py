@@ -349,11 +349,35 @@ class DMatrix:
         n_feat = 0
         has_missing = False
         need_sketch = ref is None
+        feature_names: Optional[List[str]] = None
+        feature_types: Optional[List[str]] = None
+        cat_max: Optional[np.ndarray] = None  # exact per-feature max code
         for batch in it.collect():
-            X, _, _ = to_dense(batch["data"], missing)
+            X, bn, bt = to_dense(batch["data"], missing,
+                                 batch.get("feature_names"),
+                                 batch.get("feature_types"))
             n_rows += X.shape[0]
             n_feat = X.shape[1]
             has_missing = has_missing or bool(np.isnan(X).any())
+            if bn is not None:
+                feature_names = list(bn)
+            if bt is not None:
+                feature_types = list(bt)
+            # category codes must cover every batch EXACTLY — the sketch's
+            # strided subsample may skip the max code, and a missing top
+            # category would fold rows into the wrong bin (reference:
+            # categories bypass the sketch entirely, src/common/
+            # hist_util.cc CutsBuilder for categorical). Tracked for ALL
+            # columns unconditionally: feature_types may be announced on
+            # any batch, and codes seen before the announcement count too.
+            with np.errstate(all="ignore"):
+                batch_max = np.nanmax(
+                    np.where(np.isnan(X), -np.inf, X), axis=0,
+                    initial=-np.inf)
+            if cat_max is None:
+                cat_max = batch_max
+            else:
+                cat_max = np.maximum(cat_max, batch_max)
             for key, dest in (("label", labels), ("weight", weights),
                               ("base_margin", margins),
                               ("label_lower_bound", lbound),
@@ -391,7 +415,9 @@ class DMatrix:
                     summaries = [a.merge(b).prune(max_bin * 8)
                                  for a, b in zip(summaries, batch_s)]
         self.X = None  # external-memory: no whole raw matrix
-        self.info = MetaInfo()
+        self.info = MetaInfo(feature_names=feature_names,
+                             feature_types=feature_types,
+                             data_split_mode=self._data_split_mode)
         if labels:
             self.info.labels = np.concatenate(labels)
         if weights:
@@ -424,7 +450,22 @@ class DMatrix:
         if ref is not None:
             cuts = ref.binned(max_bin).cuts
         else:
-            cuts = cuts_from_summaries(summaries or [], max_bin)
+            if (feature_types is not None and "c" in feature_types
+                    and cat_max is not None and summaries is not None):
+                # override the (possibly subsampled) summary for categorical
+                # features with the exact observed code range: the cat
+                # branch of cuts_from_summaries only reads values.max()
+                if (_collective.is_distributed()
+                        and self._data_split_mode == "row"):
+                    cat_max = _collective.allreduce(
+                        np.asarray(cat_max, np.float32), op="max")
+                for f, t in enumerate(feature_types or []):
+                    if t == "c" and f < len(summaries):
+                        m = max(float(cat_max[f]), 0.0)
+                        summaries[f] = FeatureSummary.from_data(
+                            np.asarray([0.0, m], np.float32))
+            cuts = cuts_from_summaries(summaries or [], max_bin,
+                                       feature_types)
 
         # pass 2: quantize batch-by-batch into one preallocated matrix
         max_nbins = int(cuts.n_real_bins().max(initial=0)) + int(has_missing)

@@ -10,9 +10,11 @@ page histograms accumulate on device, split evaluation reuses the resident
 ``evaluate_splits`` kernel, and positions advance page-by-page with the
 gather walk. Device memory stays O(2 pages + per-row vectors).
 
-Scope: depthwise single-target growth (the hist hot path). Categorical
-splits, monotone/interaction constraints, column split, and device meshes
-raise ``NotImplementedError`` — train those on resident matrices.
+Scope: depthwise growth (the hist hot path), single-target, row split.
+Categorical splits, monotone/interaction constraints and ``max_leaves``
+all work (same kernels as the resident path; constraint bookkeeping lives
+on the host beside the tree arrays). Column split, lossguide and device
+meshes raise ``NotImplementedError`` — train those on resident matrices.
 Multi-HOST external memory works: one process per host, each streaming its
 own row shard, with the per-level histogram and root sum crossing hosts
 through the communicator (reference: SparsePageDMatrix under rabit row
@@ -48,22 +50,12 @@ class PagedGrower(TreeGrower):
                 "page budgets are per-chip. Multi-host external memory "
                 "runs one process per host with a communicator (each host "
                 "streams its own row shard; histograms allreduce)")
-        if monotone is not None or constraint_sets is not None:
-            raise NotImplementedError(
-                "external-memory training does not support monotone/"
-                "interaction constraints yet")
         if split_mode != "row":
             raise NotImplementedError(
                 "external-memory training supports data_split_mode=row only")
-        if cuts.is_cat().any():
-            raise NotImplementedError(
-                "external-memory training does not support categorical "
-                "features yet")
-        if param.max_leaves > 0:
-            raise NotImplementedError(
-                "external-memory training does not support max_leaves yet")
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
-                         mesh=None, monotone=None, constraint_sets=None,
+                         mesh=None, monotone=monotone,
+                         constraint_sets=constraint_sets,
                          has_missing=has_missing, split_mode="row")
 
     def grow(self, paged, gpair: jnp.ndarray, n_real_bins,
@@ -74,6 +66,11 @@ class PagedGrower(TreeGrower):
         max_nodes = 2 ** (max_depth + 1) - 1
         max_nbins = self.max_nbins
         missing_bin = paged.missing_bin
+        cat = self.cat
+        mono_np = (None if self.monotone is None
+                   else np.asarray(self.monotone))
+        cons = (None if self.constraint_sets is None
+                else np.asarray(self.constraint_sets))
         hist_kernel = self.hist_method
         for suffix in ("+sub", "+nosub"):
             if hist_kernel.endswith(suffix):
@@ -94,6 +91,16 @@ class PagedGrower(TreeGrower):
         active[0] = True
         gain = np.zeros(max_nodes, np.float32)
         node_sum = np.zeros((max_nodes, 2), np.float32)
+        n_real_slots = max_nbins - 1 if self.has_missing else max_nbins
+        n_words = (n_real_slots - 1) // 32 + 1 if cat is not None else 1
+        is_cat_split = np.zeros(max_nodes, bool)
+        cat_words = np.zeros((max_nodes, n_words), np.uint32)
+        if mono_np is not None:
+            # per-node weight bounds (reference TreeEvaluator lower/upper)
+            node_lower = np.full(max_nodes, -np.inf, np.float32)
+            node_upper = np.full(max_nodes, np.inf, np.float32)
+        if cons is not None:
+            node_path = np.zeros((max_nodes, cons.shape[1]), bool)
 
         # Multi-host external memory (reference: rabit row split over
         # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): each process
@@ -157,12 +164,38 @@ class PagedGrower(TreeGrower):
             else:
                 fmask = fmask_level[None, :]
 
+            if cons is not None:
+                # allowed(n) = union of constraint sets containing path(n)
+                # (reference FeatureInteractionConstraintHost semantics,
+                # mirrored from _grow on host arrays)
+                path = node_path[lo:lo + n_level]              # [N, Fc]
+                compat = ~np.any(path[:, None, :] & ~cons[None, :, :],
+                                 axis=2)                       # [N, S]
+                allowed = np.any(compat[:, :, None] & cons[None, :, :],
+                                 axis=1)                       # [N, Fc]
+                allowed_pad = np.zeros((n_static, allowed.shape[1]), bool)
+                allowed_pad[:n_level] = allowed
+                if fmask.shape[0] == 1:
+                    fmask = jnp.broadcast_to(fmask,
+                                             (n_static, fmask.shape[1]))
+                fmask = fmask & jnp.asarray(allowed_pad)
+
+            mono_kw = {}
+            if mono_np is not None:
+                lo_pad = np.full(n_static, -np.inf, np.float32)
+                hi_pad = np.full(n_static, np.inf, np.float32)
+                lo_pad[:n_level] = node_lower[lo:lo + n_level]
+                hi_pad[:n_level] = node_upper[lo:lo + n_level]
+                mono_kw = dict(monotone=self.monotone,
+                               node_lower=jnp.asarray(lo_pad),
+                               node_upper=jnp.asarray(hi_pad))
+
             parent_pad = np.zeros((n_static, 2), np.float32)
             parent_pad[:n_level] = node_sum[lo:lo + n_level]
             res = evaluate_splits(hist_full, jnp.asarray(parent_pad),
                                   jnp.asarray(n_real),
-                                  param, feature_mask=fmask,
-                                  has_missing=self.has_missing)
+                                  param, feature_mask=fmask, cat=cat,
+                                  has_missing=self.has_missing, **mono_kw)
 
             res_gain = np.asarray(res.gain)[:n_level]
             can_split = (active[lo:lo + n_level]
@@ -177,6 +210,12 @@ class PagedGrower(TreeGrower):
                 & np.asarray(res.default_left)[:n_level]
             is_leaf[idx] = ~can_split
             gain[idx] = np.where(can_split, res_gain, 0.0)
+            if cat is not None:
+                r_iscat = np.asarray(res.is_cat)[:n_level]
+                r_words = np.asarray(res.cat_words)[:n_level]
+                is_cat_split[idx] = can_split & r_iscat
+                cat_words[idx] = np.where(
+                    (can_split & r_iscat)[:, None], r_words, np.uint32(0))
             li, ri = 2 * idx + 1, 2 * idx + 2
             active[li] = can_split
             active[ri] = can_split
@@ -184,6 +223,33 @@ class PagedGrower(TreeGrower):
             rs = np.asarray(res.right_sum)[:n_level]
             node_sum[li] = np.where(can_split[:, None], ls, 0.0)
             node_sum[ri] = np.where(can_split[:, None], rs, 0.0)
+            if mono_np is not None:
+                plo = node_lower[lo:lo + n_level]
+                phi = node_upper[lo:lo + n_level]
+                wl = np.clip(np.asarray(calc_weight(
+                    jnp.asarray(ls[:, 0]), jnp.asarray(ls[:, 1]), param)),
+                    plo, phi)
+                wr = np.clip(np.asarray(calc_weight(
+                    jnp.asarray(rs[:, 0]), jnp.asarray(rs[:, 1]), param)),
+                    plo, phi)
+                mid = (wl + wr) * 0.5
+                mc = mono_np[np.maximum(r_feat, 0)]
+                # c=+1: left must stay <= mid, right >= mid; c=-1 mirrored
+                l_hi = np.where(mc > 0, mid, phi)
+                r_lo = np.where(mc > 0, mid, plo)
+                l_lo = np.where(mc < 0, mid, plo)
+                r_hi = np.where(mc < 0, mid, phi)
+                node_lower[li] = np.where(can_split, l_lo, 0.0)
+                node_upper[li] = np.where(can_split, l_hi, 0.0)
+                node_lower[ri] = np.where(can_split, r_lo, 0.0)
+                node_upper[ri] = np.where(can_split, r_hi, 0.0)
+            if cons is not None:
+                fsel = ((np.arange(cons.shape[1])[None, :]
+                         == np.maximum(r_feat, 0)[:, None])
+                        & can_split[:, None])
+                child_path = node_path[lo:lo + n_level] | fsel
+                node_path[li] = child_path
+                node_path[ri] = child_path
 
             if not can_split.any():
                 # no node split at this level -> no deeper nodes exist;
@@ -210,6 +276,14 @@ class PagedGrower(TreeGrower):
                     bin_d = jnp.asarray(bin_pad)
                     dl_d = jnp.asarray(dl_pad)
                     cs_d = jnp.asarray(cs_pad)
+                    cat_kw = {}
+                    if cat is not None:
+                        ic_pad = np.zeros(n_static, bool)
+                        cw_pad = np.zeros((n_static, n_words), np.uint32)
+                        ic_pad[:n_level] = is_cat_split[idx]
+                        cw_pad[:n_level] = cat_words[idx]
+                        cat_kw = dict(is_cat=jnp.asarray(ic_pad),
+                                      cat_words=jnp.asarray(cw_pad))
                     for s, e, page in paged.pages():
                         rel = jnp.where(
                             (positions[s:e] >= lo)
@@ -218,7 +292,8 @@ class PagedGrower(TreeGrower):
                             n_static).astype(jnp.int32)
                         new_pos.append(advance_positions_level(
                             page.astype(jnp.float32), positions[s:e], rel,
-                            feat_d, bin_d, dl_d, cs_d, missing_bin))
+                            feat_d, bin_d, dl_d, cs_d, missing_bin,
+                            **cat_kw))
                 else:  # deep levels: per-row gather walk, O(page) memory
                     sf_d = jnp.asarray(split_feature)
                     sb_d = jnp.asarray(split_bin)
@@ -226,24 +301,34 @@ class PagedGrower(TreeGrower):
                     is_split_full = np.zeros(max_nodes, bool)
                     is_split_full[idx] = can_split
                     isf_d = jnp.asarray(is_split_full)
+                    cat_kw = {}
+                    if cat is not None:
+                        cat_kw = dict(is_cat_split=jnp.asarray(is_cat_split),
+                                      cat_words=jnp.asarray(cat_words))
                     for s, e, page in paged.pages():
                         new_pos.append(update_positions(
                             page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
-                            missing_bin))
+                            missing_bin, **cat_kw))
                 positions = jnp.concatenate(new_pos)
 
-        w = calc_weight(jnp.asarray(node_sum[:, 0]),
-                        jnp.asarray(node_sum[:, 1]), param) * param.eta
-        w = np.asarray(w)
+        w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
+                                   jnp.asarray(node_sum[:, 1]), param))
+        if mono_np is not None:
+            w = np.clip(w, node_lower, node_upper)
+        w = w * param.eta
         leaf_value = np.where(active & is_leaf, w, 0.0).astype(np.float32)
         base_weight = np.where(active, w, 0.0).astype(np.float32)
         delta = jnp.asarray(leaf_value)[positions]  # device gather [n]
 
-        return GrownTree(
+        g = GrownTree(
             split_feature=split_feature, split_bin=split_bin,
             default_left=default_left, is_leaf=is_leaf, active=active,
             leaf_value=leaf_value, node_sum=node_sum, gain=gain,
             positions=positions, delta=delta,
-            is_cat_split=np.zeros(max_nodes, bool),
-            cat_words=np.zeros((max_nodes, 1), np.uint32),
+            is_cat_split=is_cat_split, cat_words=cat_words,
             base_weight=base_weight)
+        if param.max_leaves > 0:
+            # reference Driver schedule over the fully grown level tree —
+            # the same host-side truncation the resident path applies
+            g = self._truncate_max_leaves(g)
+        return g
