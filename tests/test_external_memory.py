@@ -265,15 +265,15 @@ def test_paged_interaction_matches_resident(tmp_path, monkeypatch):
         tmp_path, monkeypatch, lambda: BatchIter(X, y, n_batches=4), params)
     _assert_same_forest(bst_p, bst_m)
     groups = [{0, 1}, {2, 3}]
-    for tree in bst_p.gbm.trees:
+    for tree in bst_p.gbm.trees:  # compact layout: follow child pointers
         def walk(h, path):
-            if h >= len(tree.is_leaf) or tree.is_leaf[h]:
+            if tree.is_leaf[h]:
                 if path:
                     assert any(path <= g for g in groups), path
                 return
             path = path | {int(tree.split_feature[h])}
-            walk(2 * h + 1, path)
-            walk(2 * h + 2, path)
+            walk(tree.left_child[h], path)
+            walk(tree.right_child[h], path)
         walk(0, set())
 
 

@@ -221,3 +221,111 @@ def test_vertical_matches_pooled_federated_grpc():
         raise errors[0]
     for dump in results:
         assert dump == pooled_dump
+
+
+# ---------------------------------------------------------------------------
+# Round-3 scope lift: categorical + monotone/interaction under vertical
+# federation (reference: the column-split evaluator has no such caps,
+# src/tree/hist/evaluate_splits.h:294-409; categorical decision bits ride
+# the same partition-bitvector sync).
+
+
+def test_vertical_monotone_matches_pooled():
+    rng = np.random.RandomState(31)
+    n, F = 1500, 6
+    X = rng.randn(n, F).astype(np.float32)
+    y = (np.sin(2 * X[:, 0]) + X[:, 1]
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "monotone_constraints": "(1,-1,0,0,0,0)"}
+    pooled = xgb.train(params, xgb.DMatrix(X, label=y), 4,
+                       verbose_eval=False)
+    # structure/thresholds exact; stats excluded — the monotone clipped-gain
+    # arithmetic FMA-fuses differently inside the pooled jit vs the
+    # federated eager evaluator (low-order f32 bits only)
+    pooled_dump = pooled.get_dump(with_stats=False)
+    pooled_pred = pooled.predict(xgb.DMatrix(X))
+
+    def fn(comm, rank):
+        # every party passes the SAME global constraint config
+        world = comm.get_world_size()
+        lo, hi = _column_blocks(X.shape[1], world)[rank]
+        bst = _train_vertical(params, X, y, comm, rank, rounds=4)
+        pred = bst.predict(xgb.DMatrix(X[:, lo:hi]))
+        return bst.get_dump(with_stats=False), np.asarray(pred)
+
+    for dump, pred in _run_threads(3, fn):
+        assert dump == pooled_dump
+        np.testing.assert_allclose(pred, pooled_pred, rtol=1e-5, atol=1e-6)
+
+
+def test_vertical_interaction_matches_pooled():
+    rng = np.random.RandomState(32)
+    n, F = 1500, 9
+    X = rng.randn(n, F).astype(np.float32)
+    # interacting pairs deliberately SPAN parties (blocks are 0-2/3-5/6-8)
+    y = (X[:, 0] * X[:, 4] + X[:, 5] * X[:, 8]
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "interaction_constraints": "[[0,4],[5,8]]"}
+    pooled = xgb.train(params, xgb.DMatrix(X, label=y), 4,
+                       verbose_eval=False)
+    pooled_dump = pooled.get_dump(with_stats=True)
+
+    def fn(comm, rank):
+        return _train_vertical(params, X, y, comm, rank,
+                               rounds=4).get_dump(with_stats=True)
+
+    for dump in _run_threads(3, fn):
+        assert dump == pooled_dump
+    # the constraint really binds: every path stays inside one group
+    groups = [{0, 4}, {5, 8}]
+    for tree in pooled.gbm.trees:
+        def walk(h, path):
+            if tree.is_leaf[h]:
+                if path:
+                    assert any(path <= g for g in groups), path
+                return
+            path = path | {int(tree.split_feature[h])}
+            walk(tree.left_child[h], path)
+            walk(tree.right_child[h], path)
+        walk(0, set())
+
+
+def test_vertical_categorical_matches_pooled():
+    rng = np.random.RandomState(33)
+    n, k = 1500, 8
+    cat0 = rng.randint(0, k, n).astype(np.float32)   # party 0's block
+    num = rng.randn(n, 3).astype(np.float32)
+    cat4 = rng.randint(0, 5, n).astype(np.float32)   # party 1's block
+    X = np.column_stack([cat0, num[:, :2], cat4, num[:, 2]]).astype(
+        np.float32)
+    ft = ["c", "float", "float", "c", "float"]
+    eff = rng.randn(k)
+    y = (eff[cat0.astype(int)] + num[:, 0] + 0.3 * (cat4 == 2)
+         + 0.1 * rng.randn(n) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "max_cat_to_onehot": 4}
+    pooled = xgb.train(params, xgb.DMatrix(
+        X, label=y, feature_types=ft, enable_categorical=True), 4,
+        verbose_eval=False)
+    pooled_dump = pooled.get_dump(with_stats=True)
+    assert any(t.is_cat_split.any() for t in pooled.gbm.trees)
+    pooled_pred = pooled.predict(xgb.DMatrix(
+        X, feature_types=ft, enable_categorical=True))
+
+    def fn(comm, rank):
+        world = comm.get_world_size()
+        lo, hi = _column_blocks(X.shape[1], world)[rank]
+        dm = xgb.DMatrix(X[:, lo:hi], label=y if rank == 0 else None,
+                         feature_types=ft[lo:hi], enable_categorical=True,
+                         data_split_mode="col")
+        p = dict(params, data_split_mode="col")
+        bst = xgb.train(p, dm, 4, verbose_eval=False)
+        pred = bst.predict(xgb.DMatrix(
+            X[:, lo:hi], feature_types=ft[lo:hi], enable_categorical=True))
+        return bst.get_dump(with_stats=True), np.asarray(pred)
+
+    for dump, pred in _run_threads(2, fn):
+        assert dump == pooled_dump
+        np.testing.assert_allclose(pred, pooled_pred, rtol=1e-5, atol=1e-6)

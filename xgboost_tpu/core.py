@@ -327,11 +327,26 @@ class Booster:
 
         nf = n_features or (len(self.feature_names)
                             if self.feature_names else 0)
-        mono = parse_monotone_constraints(
-            self.tree_param.monotone_constraints, nf)
-        ics = parse_interaction_constraints(
-            self.tree_param.interaction_constraints or None, nf,
-            self.feature_names)
+        if self._is_vertical_federated():
+            # constraints index GLOBAL features, but nf counts only this
+            # party's block — parse against the summed per-party width
+            # (symmetric collective; every party passes the same config)
+            from .parallel import collective as _coll
+
+            if self.tree_param.monotone_constraints \
+                    or self.tree_param.interaction_constraints:
+                nf = int(_coll.allreduce(
+                    np.asarray([nf], np.float32), op="sum")[0])
+            mono = parse_monotone_constraints(
+                self.tree_param.monotone_constraints, nf)
+            ics = parse_interaction_constraints(
+                self.tree_param.interaction_constraints or None, nf, None)
+        else:
+            mono = parse_monotone_constraints(
+                self.tree_param.monotone_constraints, nf)
+            ics = parse_interaction_constraints(
+                self.tree_param.interaction_constraints or None, nf,
+                self.feature_names)
         tm = self.learner_params.get("tree_method", "auto")
         ms = self.learner_params.get("multi_strategy", "one_output_per_tree")
         if ms not in ("one_output_per_tree", "multi_output_tree"):

@@ -370,14 +370,11 @@ class DMatrix:
             # hist_util.cc CutsBuilder for categorical). Tracked for ALL
             # columns unconditionally: feature_types may be announced on
             # any batch, and codes seen before the announcement count too.
-            with np.errstate(all="ignore"):
-                batch_max = np.nanmax(
-                    np.where(np.isnan(X), -np.inf, X), axis=0,
-                    initial=-np.inf)
-            if cat_max is None:
-                cat_max = batch_max
-            else:
-                cat_max = np.maximum(cat_max, batch_max)
+            if need_sketch:  # ref= copies cuts; cat_max would be unused
+                batch_max = np.fmax.reduce(
+                    X, axis=0, initial=-np.inf)  # NaN-ignoring, no copy
+                cat_max = (batch_max if cat_max is None
+                           else np.fmax(cat_max, batch_max))
             for key, dest in (("label", labels), ("weight", weights),
                               ("base_margin", margins),
                               ("label_lower_bound", lbound),

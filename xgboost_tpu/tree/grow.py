@@ -466,6 +466,41 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                      base_weight=base_weight)
 
 
+def interaction_allowed_host(path_level: np.ndarray,
+                             cons: np.ndarray) -> np.ndarray:
+    """allowed(n) = union of constraint sets containing path(n) — the numpy
+    mirror of `_grow`'s in-jit set algebra (reference
+    ``FeatureInteractionConstraintHost``), shared by the host-loop growers
+    (paged, vertical federated). path_level: [N, Fc]; cons: [S, Fc]."""
+    compat = ~np.any(path_level[:, None, :] & ~cons[None, :, :], axis=2)
+    return np.any(compat[:, :, None] & cons[None, :, :], axis=1)
+
+
+def monotone_child_bounds_host(ls: np.ndarray, rs: np.ndarray,
+                               feat: np.ndarray, plo: np.ndarray,
+                               phi: np.ndarray, mono: np.ndarray, param):
+    """Child weight-bound propagation (reference ``TreeEvaluator``), the
+    numpy mirror of `_grow`'s in-jit update: clip child weights into the
+    parent interval, split it at their midpoint by the constraint sign.
+    Returns ((l_lo, l_hi), (r_lo, r_hi)). Shared by the host-loop growers;
+    ``calc_weight`` runs through jnp so the f32 arithmetic matches the
+    pooled path bit-for-bit."""
+    from .param import calc_weight
+
+    wl = np.clip(np.asarray(calc_weight(
+        jnp.asarray(ls[:, 0]), jnp.asarray(ls[:, 1]), param)), plo, phi)
+    wr = np.clip(np.asarray(calc_weight(
+        jnp.asarray(rs[:, 0]), jnp.asarray(rs[:, 1]), param)), plo, phi)
+    mid = (wl + wr) * 0.5
+    mc = mono[np.maximum(feat, 0)]
+    # c=+1: left must stay <= mid, right >= mid; c=-1 mirrored
+    l_hi = np.where(mc > 0, mid, phi)
+    r_lo = np.where(mc > 0, mid, plo)
+    l_lo = np.where(mc < 0, mid, plo)
+    r_hi = np.where(mc < 0, mid, phi)
+    return (l_lo, l_hi), (r_lo, r_hi)
+
+
 class TreeGrower:
     """Host-side wrapper: sampling keys, colsample_bytree, device->TreeModel.
 

@@ -32,7 +32,8 @@ import numpy as np
 from ..ops.histogram import build_hist
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits
-from .grow import GrownTree, TreeGrower, _sample_features
+from .grow import (GrownTree, TreeGrower, _sample_features,
+                   interaction_allowed_host, monotone_child_bounds_host)
 from .param import calc_weight
 
 _EPS = 1e-6
@@ -165,14 +166,8 @@ class PagedGrower(TreeGrower):
                 fmask = fmask_level[None, :]
 
             if cons is not None:
-                # allowed(n) = union of constraint sets containing path(n)
-                # (reference FeatureInteractionConstraintHost semantics,
-                # mirrored from _grow on host arrays)
-                path = node_path[lo:lo + n_level]              # [N, Fc]
-                compat = ~np.any(path[:, None, :] & ~cons[None, :, :],
-                                 axis=2)                       # [N, S]
-                allowed = np.any(compat[:, :, None] & cons[None, :, :],
-                                 axis=1)                       # [N, Fc]
+                allowed = interaction_allowed_host(
+                    node_path[lo:lo + n_level], cons)          # [N, Fc]
                 allowed_pad = np.zeros((n_static, allowed.shape[1]), bool)
                 allowed_pad[:n_level] = allowed
                 if fmask.shape[0] == 1:
@@ -224,21 +219,9 @@ class PagedGrower(TreeGrower):
             node_sum[li] = np.where(can_split[:, None], ls, 0.0)
             node_sum[ri] = np.where(can_split[:, None], rs, 0.0)
             if mono_np is not None:
-                plo = node_lower[lo:lo + n_level]
-                phi = node_upper[lo:lo + n_level]
-                wl = np.clip(np.asarray(calc_weight(
-                    jnp.asarray(ls[:, 0]), jnp.asarray(ls[:, 1]), param)),
-                    plo, phi)
-                wr = np.clip(np.asarray(calc_weight(
-                    jnp.asarray(rs[:, 0]), jnp.asarray(rs[:, 1]), param)),
-                    plo, phi)
-                mid = (wl + wr) * 0.5
-                mc = mono_np[np.maximum(r_feat, 0)]
-                # c=+1: left must stay <= mid, right >= mid; c=-1 mirrored
-                l_hi = np.where(mc > 0, mid, phi)
-                r_lo = np.where(mc > 0, mid, plo)
-                l_lo = np.where(mc < 0, mid, plo)
-                r_hi = np.where(mc < 0, mid, phi)
+                (l_lo, l_hi), (r_lo, r_hi) = monotone_child_bounds_host(
+                    ls, rs, r_feat, node_lower[lo:lo + n_level],
+                    node_upper[lo:lo + n_level], mono_np, param)
                 node_lower[li] = np.where(can_split, l_lo, 0.0)
                 node_upper[li] = np.where(can_split, l_hi, 0.0)
                 node_lower[ri] = np.where(can_split, r_lo, 0.0)

@@ -23,10 +23,14 @@ model is bit-identical to single-process training on the pooled columns
 cross-rank argmax prefers the lowest rank, which is the pooled argmax's
 lowest-feature preference).
 
-Scope limits (mirrors the mesh col-split caps): no categorical splits, no
-monotone/interaction constraints. Missing-value parity holds when local
-and pooled matrices agree on having missing slots (an all-dense dataset
-or missing present in every party's slice).
+Categorical splits, monotone and interaction constraints all work:
+constraints are GLOBAL-feature-indexed (the same convention as the mesh
+column split — every party passes the same global config, ids offset by
+the rank-ordered feature blocks), category left-sets ride the winner
+exchange as uint32 bitmask words, and the decision-bit sync resolves cat
+nodes owner-locally. Missing-value parity holds when local and pooled
+matrices agree on having missing slots (an all-dense dataset or missing
+present in every party's slice).
 """
 
 from __future__ import annotations
@@ -40,7 +44,8 @@ import numpy as np
 from ..ops.histogram import build_hist
 from ..ops.split import evaluate_splits
 from ..parallel import collective
-from .grow import _EPS, GrownTree, _sample_features
+from .grow import (_EPS, GrownTree, _sample_features,
+                   interaction_allowed_host, monotone_child_bounds_host)
 from .param import TrainParam, calc_weight
 from .tree import TreeModel
 
@@ -58,14 +63,6 @@ class VerticalFederatedGrower:
                  split_mode: str = "col") -> None:
         if split_mode != "col":
             raise ValueError("VerticalFederatedGrower is col-split only")
-        if monotone is not None or constraint_sets is not None:
-            raise NotImplementedError(
-                "vertical federated training does not support monotone/"
-                "interaction constraints yet")
-        if cuts.is_cat().any():
-            raise NotImplementedError(
-                "vertical federated training does not support categorical "
-                "features yet")
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
@@ -73,12 +70,28 @@ class VerticalFederatedGrower:
         self.has_missing = has_missing
         self.split_mode = split_mode
         self.mesh = None
-        self.cat = None
-        self.monotone = None
-        self.constraint_sets = None
+        # constraints arrive GLOBAL-feature-indexed (core._make_booster
+        # parses them against the summed per-party width); categorical info
+        # is LOCAL — this rank's cuts only cover its own feature block
+        self.monotone = (None if monotone is None
+                         else np.asarray(monotone, np.int32))
+        self.constraint_sets = (None if constraint_sets is None
+                                else np.asarray(constraint_sets, bool))
+        is_cat = np.asarray(cuts.is_cat())
+        if is_cat.any():
+            from ..ops.split import CatInfo
+
+            n_real_loc = np.asarray(cuts.n_real_bins())
+            self.cat = CatInfo(
+                is_cat=jnp.asarray(is_cat),
+                is_onehot=jnp.asarray(
+                    is_cat & (n_real_loc <= param.max_cat_to_onehot)))
+        else:
+            self.cat = None
         self.comm = collective.get_communicator()
         self._f_offset: Optional[int] = None
         self._base_global: Optional[np.ndarray] = None
+        self._n_words_global: int = 1
         self._bins_np = None  # (device array, host copy) identity-keyed
 
     # -- one-time topology exchange -------------------------------------------
@@ -86,10 +99,13 @@ class VerticalFederatedGrower:
         if self._f_offset is not None:
             return
         base_local = np.asarray(n_real_bins) > 0
-        parts = self.comm.allgather_objects(base_local)
-        widths = [len(p) for p in parts]
+        nb = self.max_nbins - 1 if self.has_missing else self.max_nbins
+        w_local = (max(nb, 1) - 1) // 32 + 1  # evaluate_splits word width
+        parts = self.comm.allgather_objects((base_local, w_local))
+        widths = [len(p[0]) for p in parts]
         self._f_offset = int(sum(widths[: self.comm.get_rank()]))
-        self._base_global = np.concatenate([np.asarray(p) for p in parts])
+        self._base_global = np.concatenate([np.asarray(p[0]) for p in parts])
+        self._n_words_global = max(p[1] for p in parts)
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
              n_real_bins: jnp.ndarray, key: jax.Array) -> GrownTree:
@@ -125,6 +141,19 @@ class VerticalFederatedGrower:
         active[0] = True
         gain_arr = np.zeros(max_nodes, np.float32)
         node_sum = np.zeros((max_nodes, 2), np.float32)
+        n_words = self._n_words_global
+        is_cat_split = np.zeros(max_nodes, bool)
+        cat_words = np.zeros((max_nodes, n_words), np.uint32)
+        mono = self.monotone            # [F_global] or None
+        cons = self.constraint_sets     # [S, F_global] or None
+        if mono is not None:
+            # replicated per-node weight bounds: every rank sees the same
+            # winner stats, so the bookkeeping stays rank-identical
+            node_lower = np.full(max_nodes, -np.inf, np.float32)
+            node_upper = np.full(max_nodes, np.inf, np.float32)
+            mono_loc = jnp.asarray(mono[off:off + F_loc])
+        if cons is not None:
+            node_path = np.zeros((max_nodes, cons.shape[1]), bool)
         # rows replicate, so the local sum IS the global root sum — but it
         # must use the same XLA reduction as the pooled path (numpy's
         # pairwise summation differs in the low-order f32 bits, and that
@@ -156,14 +185,34 @@ class VerticalFederatedGrower:
                     for k in node_keys])
             else:
                 fmask_g = level_mask_g[None, :]
+            if cons is not None:
+                # GLOBAL ids (grow._grow col-split semantics)
+                allowed = interaction_allowed_host(
+                    node_path[lo:lo + n_level], cons)         # [N, Fg]
+                if fmask_g.shape[0] == 1:
+                    fmask_g = np.broadcast_to(fmask_g,
+                                              (n_level, fmask_g.shape[1]))
+                fmask_g = fmask_g & allowed
             fmask_loc = jnp.asarray(fmask_g[:, off:off + F_loc])
 
+            mono_kw = {}
+            if mono is not None:
+                mono_kw = dict(
+                    monotone=mono_loc,
+                    node_lower=jnp.asarray(node_lower[lo:lo + n_level]),
+                    node_upper=jnp.asarray(node_upper[lo:lo + n_level]))
             parent_sum = jnp.asarray(node_sum[lo:lo + n_level])
             res = evaluate_splits(hist, parent_sum, n_real_bins, param,
-                                  feature_mask=fmask_loc,
-                                  has_missing=self.has_missing)
+                                  feature_mask=fmask_loc, cat=self.cat,
+                                  has_missing=self.has_missing, **mono_kw)
             loc_feat = np.asarray(res.feature, np.int32)
             loc_bin = np.asarray(res.bin, np.int32)
+            loc_iscat = np.asarray(res.is_cat, bool)
+            loc_words = np.asarray(res.cat_words, np.uint32)
+            if loc_words.shape[1] < n_words:  # pad to the global word width
+                loc_words = np.pad(
+                    loc_words,
+                    ((0, 0), (0, n_words - loc_words.shape[1])))
             payload = {
                 "gain": np.asarray(res.gain, np.float32),
                 "feature": loc_feat + off,
@@ -172,6 +221,8 @@ class VerticalFederatedGrower:
                 "left_sum": np.asarray(res.left_sum, np.float32),
                 "right_sum": np.asarray(res.right_sum, np.float32),
                 "split_value": self.cuts.split_values(loc_feat, loc_bin),
+                "is_cat": loc_iscat,
+                "cat_words": loc_words,
             }
             cands = comm.allgather_objects(payload)
             gains = np.stack([np.asarray(c["gain"]) for c in cands])  # [P,N]
@@ -187,6 +238,9 @@ class VerticalFederatedGrower:
             best_rs = np.stack([c["right_sum"] for c in cands])[winner, sel]
             best_sv = np.stack([c["split_value"] for c in cands])[winner,
                                                                   sel]
+            best_iscat = np.stack([c["is_cat"] for c in cands])[winner, sel]
+            best_words = np.stack([c["cat_words"] for c in cands])[winner,
+                                                                   sel]
 
             can_split = (active[idx] & (best_gain > max(param.gamma, _EPS))
                          & np.isfinite(best_gain))
@@ -197,11 +251,30 @@ class VerticalFederatedGrower:
             default_left[idx] = can_split & best_dl
             is_leaf[idx] = ~can_split
             gain_arr[idx] = np.where(can_split, best_gain, 0.0)
+            is_cat_split[idx] = can_split & best_iscat
+            cat_words[idx] = np.where((can_split & best_iscat)[:, None],
+                                      best_words, np.uint32(0))
             li, ri = 2 * idx + 1, 2 * idx + 2
             active[li] = can_split
             active[ri] = can_split
             node_sum[li] = np.where(can_split[:, None], best_ls, 0.0)
             node_sum[ri] = np.where(can_split[:, None], best_rs, 0.0)
+            if mono is not None:
+                (l_lo, l_hi), (r_lo, r_hi) = monotone_child_bounds_host(
+                    best_ls, best_rs, best_feat,
+                    node_lower[lo:lo + n_level],
+                    node_upper[lo:lo + n_level], mono, param)
+                node_lower[li] = np.where(can_split, l_lo, 0.0)
+                node_upper[li] = np.where(can_split, l_hi, 0.0)
+                node_lower[ri] = np.where(can_split, r_lo, 0.0)
+                node_upper[ri] = np.where(can_split, r_hi, 0.0)
+            if cons is not None:
+                fsel = ((np.arange(cons.shape[1])[None, :]
+                         == np.maximum(best_feat, 0)[:, None])
+                        & can_split[:, None])
+                child_path = node_path[lo:lo + n_level] | fsel
+                node_path[li] = child_path
+                node_path[ri] = child_path
 
             # decision-bit sync: only the winning rank can route rows at a
             # node (it owns the split feature); everyone else contributes 0
@@ -212,6 +285,13 @@ class VerticalFederatedGrower:
             feat_per_row = np.maximum(loc_feat[rel_c], 0)
             b = bins_np[np.arange(n), feat_per_row].astype(np.int32)
             go_right = b > loc_bin[rel_c]
+            if self.cat is not None:
+                # owner-local cat routing: bin id == category code; right
+                # unless the code is in the node's left bitmask
+                widx = np.clip(b // 32, 0, n_words - 1)
+                word = loc_words[rel_c][np.arange(n), widx]
+                bit = (word >> (b % 32).astype(np.uint32)) & np.uint32(1)
+                go_right = np.where(loc_iscat[rel_c], bit == 0, go_right)
             dl_per_row = np.asarray(res.default_left, bool)[rel_c]
             go_right = np.where(b == missing_bin, ~dl_per_row, go_right)
             contrib = (row_mine & go_right).astype(np.uint8)
@@ -223,6 +303,8 @@ class VerticalFederatedGrower:
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
                                    jnp.asarray(node_sum[:, 1]), param))
+        if mono is not None:
+            w = np.clip(w, node_lower, node_upper)
         w = (w * param.eta).astype(np.float32)
         leaf_value = np.where(active & is_leaf, w, 0.0).astype(np.float32)
         base_weight = np.where(active, w, 0.0).astype(np.float32)
@@ -232,8 +314,7 @@ class VerticalFederatedGrower:
             default_left=default_left, is_leaf=is_leaf, active=active,
             leaf_value=leaf_value, node_sum=node_sum, gain=gain_arr,
             positions=positions, delta=jnp.asarray(delta),
-            is_cat_split=np.zeros(max_nodes, bool),
-            cat_words=np.zeros((max_nodes, 1), np.uint32),
+            is_cat_split=is_cat_split, cat_words=cat_words,
             base_weight=base_weight, split_value=split_value)
 
     # kept by the Booster predict path so eval DMatrixes can be walked
@@ -282,10 +363,7 @@ def federated_vertical_margin(trees, tree_info, n_groups: int,
     forest = stack_forest(list(trees))
     if forest is None:
         return out
-    if "is_cat_split" in forest:
-        raise NotImplementedError(
-            "vertical federated prediction does not support categorical "
-            "splits yet")
+    has_cat = "is_cat_split" in forest
     T, M = forest["split_feature"].shape
     depth = int(forest["depth"])
     info = np.asarray(tree_info, np.int32)
@@ -303,6 +381,20 @@ def federated_vertical_margin(trees, tree_info, n_groups: int,
         owned = ~leaf & (sf >= f_offset) & (sf < f_offset + F_loc)
         x = X_local[:, np.clip(sf - f_offset, 0, F_loc - 1)]  # [n, Tc, M]
         go_right = x > sv[None, :, :]
+        if has_cat:
+            # owned cat nodes route by left-set membership of the raw
+            # category code (reference CategoricalSplitMatrix decision)
+            ics = forest["is_cat_split"][t0:t1]          # [Tc, M]
+            cw = forest["cat_words"][t0:t1]              # [Tc, M, W]
+            W = cw.shape[2]
+            code = np.maximum(np.nan_to_num(x, nan=0.0), 0.0).astype(
+                np.int64)
+            widx = np.clip(code // 32, 0, W - 1)         # [n, Tc, M]
+            word = np.zeros(code.shape, np.uint32)
+            for wi in range(W):                          # W is tiny
+                word = np.where(widx == wi, cw[None, :, :, wi], word)
+            bit = (word >> (code % 32).astype(np.uint32)) & np.uint32(1)
+            go_right = np.where(ics[None, :, :], bit == 0, go_right)
         go_right = np.where(np.isnan(x), ~dl[None, :, :], go_right)
         bits = (go_right & owned[None, :, :]).astype(np.uint8)
         bits = np.asarray(comm.allreduce(bits.reshape(n, -1), op="sum"),
