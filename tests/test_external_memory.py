@@ -97,9 +97,20 @@ def test_paged_eval_and_continuation(paged_qdm):
 def test_paged_unsupported_configs_raise(paged_qdm):
     X, y, qdm = paged_qdm
     with pytest.raises(NotImplementedError):
-        xgb.train({"objective": "binary:logistic",
-                   "grow_policy": "lossguide", "max_leaves": 8,
+        xgb.train({"objective": "multi:softprob", "num_class": 3,
+                   "multi_strategy": "multi_output_tree",
                    "max_bin": 64}, qdm, 1, verbose_eval=False)
+
+
+def test_paged_lossguide_matches_resident(tmp_path, monkeypatch):
+    X, y = _data(seed=13)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 12, "max_depth": 0}
+    bst_p, bst_m = _paged_vs_resident(
+        tmp_path, monkeypatch, lambda: BatchIter(X, y, n_batches=4), params)
+    _assert_same_forest(bst_p, bst_m)
+    for tree in bst_p.gbm.trees:
+        assert int(tree.is_leaf.sum()) <= 12
 
 
 @pytest.mark.slow
@@ -344,3 +355,65 @@ def test_iterator_cat_types_announced_late(tmp_path):
     cuts = qdm.binned(16).cuts
     assert cuts.is_cat()[0]
     assert cuts.n_real_bins()[0] == 9  # codes 0..8
+
+
+@pytest.mark.slow
+def test_paged_lossguide_under_communicator(tmp_path, monkeypatch):
+    """Lossguide over multi-host external memory: the per-split two-child
+    histogram crosses hosts through the communicator."""
+    import threading
+
+    from xgboost_tpu.parallel.collective import (
+        InMemoryCommunicator, set_thread_local_communicator)
+
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "400")
+    X, y = _data(n=2000, seed=17)
+    n_half = X.shape[0] // 2
+    shards = [(X[:n_half], y[:n_half]), (X[n_half:], y[n_half:])]
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 8, "max_depth": 0}
+
+    it = BatchIter(X, y, n_batches=2)
+    it.cache_prefix = str(tmp_path / "pooled")
+    bst_ref = xgb.train(params, xgb.QuantileDMatrix(it, max_bin=64), 3,
+                        verbose_eval=False)
+
+    comms = InMemoryCommunicator.make_world(2)
+    results = [None] * 2
+    errors = []
+
+    def worker(rank):
+        set_thread_local_communicator(comms[rank])
+        try:
+            Xr, yr = shards[rank]
+            itr = BatchIter(Xr, yr, n_batches=1)
+            itr.cache_prefix = str(tmp_path / f"lg{rank}")
+            bst = xgb.train(params, xgb.QuantileDMatrix(itr, max_bin=64),
+                            3, verbose_eval=False)
+            results[rank] = bst.gbm.trees
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            errors.append(e)
+        finally:
+            set_thread_local_communicator(None)
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(240)
+    if errors:
+        raise errors[0]
+    assert not any(t.is_alive() for t in threads)
+
+    for trees in results:
+        assert len(trees) == len(bst_ref.gbm.trees) == 3
+        for td, tr in zip(trees, bst_ref.gbm.trees):
+            np.testing.assert_array_equal(td.split_feature,
+                                          tr.split_feature)
+            np.testing.assert_array_equal(td.split_bin, tr.split_bin)
+            np.testing.assert_allclose(td.leaf_value, tr.leaf_value,
+                                       rtol=2e-3, atol=1e-5)

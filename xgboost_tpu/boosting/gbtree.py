@@ -206,12 +206,13 @@ class GBTree:
             kw = {"split_mode": self.split_mode}
             if param.grow_policy == "lossguide":
                 if paged:
-                    raise NotImplementedError(
-                        "external-memory training supports "
-                        "grow_policy=depthwise only")
-                from ..tree.lossguide import LossguideGrower
+                    from ..tree.paged import PagedLossguideGrower
 
-                cls = LossguideGrower
+                    cls = PagedLossguideGrower
+                else:
+                    from ..tree.lossguide import LossguideGrower
+
+                    cls = LossguideGrower
                 kw = {}
             elif paged:
                 from ..tree.paged import PagedGrower

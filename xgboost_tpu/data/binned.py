@@ -310,6 +310,10 @@ class PagedBinnedMatrix:
         return self.bins_host.shape[1]
 
     @property
+    def shape(self):
+        return self.bins_host.shape
+
+    @property
     def missing_bin(self) -> int:
         return self.max_nbins - 1 if self.has_missing else self.max_nbins
 

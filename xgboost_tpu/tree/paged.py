@@ -10,11 +10,12 @@ page histograms accumulate on device, split evaluation reuses the resident
 ``evaluate_splits`` kernel, and positions advance page-by-page with the
 gather walk. Device memory stays O(2 pages + per-row vectors).
 
-Scope: depthwise growth (the hist hot path), single-target, row split.
-Categorical splits, monotone/interaction constraints and ``max_leaves``
-all work (same kernels as the resident path; constraint bookkeeping lives
-on the host beside the tree arrays). Column split, lossguide and device
-meshes raise ``NotImplementedError`` — train those on resident matrices.
+Scope: single-target, row split. Depthwise (``PagedGrower``) and
+loss-guided (``PagedLossguideGrower``) growth both stream; categorical
+splits, monotone/interaction constraints and ``max_leaves`` all work
+(same kernels as the resident path; constraint bookkeeping lives on the
+host beside the tree arrays). Column split and device meshes raise
+``NotImplementedError`` — train those on resident matrices.
 Multi-HOST external memory works: one process per host, each streaming its
 own row shard, with the per-level histogram and root sum crossing hosts
 through the communicator (reference: SparsePageDMatrix under rabit row
@@ -34,9 +35,48 @@ from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits
 from .grow import (GrownTree, TreeGrower, _sample_features,
                    interaction_allowed_host, monotone_child_bounds_host)
+from .lossguide import LossguideGrower
 from .param import calc_weight
 
 _EPS = 1e-6
+
+
+def _strip_hist_suffix(method: str) -> str:
+    for suffix in ("+sub", "+nosub"):
+        if method.endswith(suffix):
+            return method[: -len(suffix)]
+    return method
+
+
+def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
+    """Sum across hosts through the CURRENT thread-local communicator —
+    re-read on every call, never cached: growers persist on the booster
+    across training continuations, and a communicator captured at
+    construction would go stale (silently skipping the allreduce, or
+    calling a dead one)."""
+    from ..parallel import collective
+
+    comm = collective.get_communicator()
+    if not comm.is_distributed():
+        return arr
+    return jnp.asarray(comm.allreduce(np.asarray(arr, np.float32), op="sum"))
+
+
+def _streamed_hist(paged, gpair: jnp.ndarray, rel_of, n_nodes: int,
+                   max_nbins: int, method: str) -> jnp.ndarray:
+    """One histogram pass over the pages + cross-host reduce. ``rel_of(s, e)``
+    maps a page's row span to its [e-s] node-slot vector. An empty local
+    shard contributes zeros so the collective stays symmetric (a rank with
+    no rows must still meet its peers in the allreduce)."""
+    hist = None
+    for s, e, page in paged.pages():
+        h = build_hist(page, gpair[s:e], rel_of(s, e), n_nodes, max_nbins,
+                       method=method)
+        hist = h if hist is None else hist + h
+    if hist is None:
+        hist = jnp.zeros((n_nodes, paged.n_features, max_nbins, 2),
+                         jnp.float32)
+    return _host_allreduce(hist)
 
 
 class PagedGrower(TreeGrower):
@@ -72,10 +112,7 @@ class PagedGrower(TreeGrower):
                    else np.asarray(self.monotone))
         cons = (None if self.constraint_sets is None
                 else np.asarray(self.constraint_sets))
-        hist_kernel = self.hist_method
-        for suffix in ("+sub", "+nosub"):
-            if hist_kernel.endswith(suffix):
-                hist_kernel = hist_kernel[: -len(suffix)]
+        hist_kernel = _strip_hist_suffix(self.hist_method)
 
         n_real = np.asarray(n_real_bins)
         base_mask = jnp.asarray(n_real) > 0
@@ -108,19 +145,8 @@ class PagedGrower(TreeGrower):
         # streams only ITS row shard's pages; the per-level histogram and
         # the root gradient sum cross hosts through the communicator —
         # the same two allreduces the mesh path does with lax.psum.
-        from ..parallel import collective
-
-        comm = collective.get_communicator()
-        distributed = comm.is_distributed() and self.split_mode == "row"
-
-        def allreduce(arr):
-            if not distributed:
-                return arr
-            return jnp.asarray(comm.allreduce(
-                np.asarray(arr, np.float32), op="sum"))
-
         positions = jnp.zeros((n,), jnp.int32)  # device-resident [n]
-        node_sum[0] = np.asarray(allreduce(jnp.sum(gpair, axis=0)))
+        node_sum[0] = np.asarray(_host_allreduce(jnp.sum(gpair, axis=0)))
 
         # One static node width (2^(max_depth-1), the widest level) for
         # EVERY per-page program: per-width jits would compile
@@ -138,15 +164,13 @@ class PagedGrower(TreeGrower):
             n_level = 2 ** depth
 
             # --- histogram: one streamed pass over the pages -------------
-            hist_full = None
-            for s, e, page in paged.pages():
-                rel = jnp.where(
+            def rel_of(s, e, lo=lo, n_level=n_level):
+                return jnp.where(
                     (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
                     positions[s:e] - lo, n_static).astype(jnp.int32)
-                h = build_hist(page, gpair[s:e], rel, n_static, max_nbins,
-                               method=hist_kernel)
-                hist_full = h if hist_full is None else hist_full + h
-            hist_full = allreduce(hist_full)
+
+            hist_full = _streamed_hist(paged, gpair, rel_of, n_static,
+                                       max_nbins, hist_kernel)
 
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
@@ -315,3 +339,67 @@ class PagedGrower(TreeGrower):
             # the same host-side truncation the resident path applies
             g = self._truncate_max_leaves(g)
         return g
+
+
+class PagedLossguideGrower(LossguideGrower):
+    """Loss-guided growth over a ``PagedBinnedMatrix``: the greedy pop loop
+    is unchanged (LossguideGrower.grow), but each split's two device
+    kernels — the two-child histogram and the one-node position advance —
+    stream over the host-resident pages instead of touching a resident bin
+    tensor (reference: the lossguide hist updater drives the same page
+    loop as depthwise, ``src/tree/updater_quantile_hist.cc`` +
+    ``src/tree/driver.h`` LossGuide ordering). Multi-host: each process
+    streams its own row shard; the per-split child histogram crosses hosts
+    through the communicator, exactly like ``PagedGrower``."""
+
+    def __init__(self, param, max_nbins, cuts, hist_method="auto",
+                 mesh=None, monotone=None, constraint_sets=None,
+                 has_missing=True) -> None:
+        if mesh is not None:
+            raise NotImplementedError(
+                "external-memory training does not support device meshes; "
+                "multi-host external memory runs one process per host "
+                "with a communicator")
+        super().__init__(param, max_nbins, cuts, hist_method=hist_method,
+                         mesh=None, monotone=monotone,
+                         constraint_sets=constraint_sets,
+                         has_missing=has_missing)
+
+    def _functions(self):
+        if self._fns is not None:
+            return self._fns
+        from .lossguide import _apply1
+
+        hist_kernel = _strip_hist_suffix(self.hist_method)
+        apply1_jit = jax.jit(_apply1)
+
+        def eval2(paged, gpair, positions, i0, i1, psums, fmask,
+                  node_lower, node_upper, n_real_bins):
+            def rel_of(s, e):
+                return jnp.where(
+                    positions[s:e] == i0, 0,
+                    jnp.where(positions[s:e] == i1, 1, 2)).astype(jnp.int32)
+
+            hist = _streamed_hist(paged, gpair, rel_of, 2, self.max_nbins,
+                                  hist_kernel)
+            return evaluate_splits(hist, psums, n_real_bins, self.param,
+                                   feature_mask=fmask,
+                                   monotone=self.monotone,
+                                   node_lower=node_lower,
+                                   node_upper=node_upper, cat=self.cat,
+                                   has_missing=self.has_missing)
+
+        def apply1(paged, positions, nid, feat, sbin, dleft, is_cat,
+                   words, left_id, right_id, missing_bin):
+            new_pos = [apply1_jit(page, positions[s:e], nid, feat, sbin,
+                                  dleft, is_cat, words, left_id, right_id,
+                                  missing_bin)
+                       for s, e, page in paged.pages()]
+            return jnp.concatenate(new_pos)
+
+        def root_sum(gpair):
+            return _host_allreduce(jnp.sum(gpair, axis=0))
+
+        gather = jax.jit(lambda lv, pos: lv[pos])
+        self._fns = (eval2, apply1, root_sum, gather)
+        return self._fns
