@@ -211,3 +211,31 @@ def test_round_batching_matches_sequential():
         np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value)
     dm = xgb.DMatrix(X)
     np.testing.assert_array_equal(b_batched.predict(dm), b_seq.predict(dm))
+
+
+def test_fused_multiclass_matches_general_path():
+    """Multiclass rounds fuse the per-class grow loop into one dispatch
+    (lax.scan over the class axis); the model must be bit-identical to the
+    general path's sequential per-class boosting."""
+    rng = np.random.RandomState(13)
+    X = rng.randn(2500, 8).astype(np.float32)
+    y = (X @ rng.randn(8, 3)).argmax(axis=1).astype(np.float32)
+    params = {"objective": "multi:softprob", "num_class": 3,
+              "max_depth": 4, "subsample": 0.8, "colsample_bytree": 0.9,
+              "seed": 7}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    assert b1._fused_round is not None  # multiclass takes the fast path now
+    assert len(b1.gbm.trees) == 12      # 4 rounds x 3 class trees
+    assert b1.gbm.tree_info == [0, 1, 2] * 4
+    b2 = xgb.Booster(params=params)
+    b2._fused_blocked = True            # force the general path
+    dm2 = xgb.DMatrix(X, label=y)
+    for i in range(4):
+        b2.update(dm2, i)
+    assert bytes(b1.save_raw("json")) == bytes(b2.save_raw("json"))
+    # round-batched multiclass (no callbacks) == per-round fused
+    from xgboost_tpu.callback import TrainingCallback
+
+    b3 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False,
+                   callbacks=[TrainingCallback()])
+    assert bytes(b1.save_raw("json")) == bytes(b3.save_raw("json"))

@@ -1,0 +1,48 @@
+"""A/B: multiclass fused round (one dispatch, lax.scan over classes) vs the
+general per-class-dispatch path. Usage: python tools/bench_multiclass.py
+[rows] [features] [classes]. On CPU the two paths are bit-identical
+(tests/test_basic.py::test_fused_multiclass_matches_general_path); on TPU
+the softmax reductions may fuse differently across the two program shapes,
+so structure can diverge at near-ties — report drift, don't assert."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import xgboost_tpu as xgb
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+F = int(sys.argv[2]) if len(sys.argv) > 2 else 54
+K = int(sys.argv[3]) if len(sys.argv) > 3 else 7
+rng = np.random.RandomState(0)
+X = rng.randn(n, F).astype(np.float32)
+y = (X @ rng.randn(F, K)).argmax(axis=1).astype(np.float32)
+params = {"objective": "multi:softprob", "num_class": K, "max_depth": 6,
+          "eta": 0.3, "max_bin": 256}
+
+
+def run(tag, blocked, rounds=20):
+    dm = xgb.DMatrix(X, label=y)
+    b = xgb.Booster(params=params, cache=[dm])
+    b._fused_blocked = blocked
+    t0 = time.perf_counter()
+    b.update(dm, 0)
+    _ = b.gbm.trees
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(1, rounds + 1):
+        b.update(dm, i)
+    _ = b.gbm.trees
+    dt = (time.perf_counter() - t0) / rounds
+    print(f"{tag}: {1/dt:.3f} rounds/s ({dt*1e3:.0f} ms/round, "
+          f"first-round {t_compile:.1f}s)")
+    return b
+
+
+b_gen = run("general (per-class dispatches)", True)
+b_fus = run("fused   (one dispatch/round)  ", False)
+p1 = np.asarray(b_gen.predict(xgb.DMatrix(X[:5000])))
+p2 = np.asarray(b_fus.predict(xgb.DMatrix(X[:5000])))
+print(f"max prob drift between paths: {np.abs(p1 - p2).max():.2e}")

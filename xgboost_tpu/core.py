@@ -61,13 +61,20 @@ def _fused_round_body(margin, seed, iteration, bins, labels, weights,
                       has_missing):
     """The ONE fused round: gradient -> sample -> colsample -> grow ->
     margin update. Shared verbatim by the single-round and round-batched
-    jits — the fold_in constants (0, 0xC0, 0x5EED) define the PRNG stream
-    that keeps fused, batched, and general paths model-identical."""
+    jits — the fold_in constants (k, 0xC0, 0x5EED) define the PRNG stream
+    that keeps fused, batched, and general paths model-identical.
+
+    Multiclass (K > 1, one_output_per_tree): the K class trees all grow
+    from the same margin snapshot (exactly the general path's per-round
+    gradient), so a ``lax.scan`` over the class axis folds the whole round
+    into this one program — K grow dispatches become zero extra dispatches.
+    Returns the grown tree (K == 1) or a dict of per-node arrays stacked on
+    a leading [K] class axis."""
     import types
 
     from .tree.grow import _grow, _sample_features
 
-    from .boosting.gbtree import sample_gradients
+    from .boosting.gbtree import _GROWN_FIELDS, sample_gradients
 
     # identical stream to the general path: fold_in(make_key(it), it)
     key = jax.random.fold_in(jax.random.key(seed), iteration)
@@ -75,17 +82,37 @@ def _fused_round_body(margin, seed, iteration, bins, labels, weights,
     obj = obj_cls(dict(obj_params))
     sinfo = types.SimpleNamespace(labels=labels, weights=weights)
     gpair = obj.get_gradient(margin, sinfo, 0)
-    gp = gpair[:, 0, :]
-    tkey = jax.random.fold_in(key, 0)
-    gp = sample_gradients(gp, tkey, param)
-    tree_mask = _sample_features(jax.random.fold_in(tkey, 0xC0),
-                                 n_real > 0, param.colsample_bytree)
-    gkey = jax.random.fold_in(tkey, 0x5EED)
-    grown = _grow(bins, gp, n_real, tree_mask, gkey, monotone,
-                  constraint_sets, cat, param=param, max_nbins=max_nbins,
-                  hist_method=hist_method, axis_name=None,
-                  has_missing=has_missing)
-    return margin + grown.delta[:, None], grown
+    K = gpair.shape[1]
+
+    def grow_class(k, gp_k):
+        # general path key discipline: tkey = fold_in(key, k * npt + p),
+        # npt == 1 and p == 0 on this path
+        tkey = jax.random.fold_in(key, k)
+        gp = sample_gradients(gp_k, tkey, param)
+        tree_mask = _sample_features(jax.random.fold_in(tkey, 0xC0),
+                                     n_real > 0, param.colsample_bytree)
+        gkey = jax.random.fold_in(tkey, 0x5EED)
+        return _grow(bins, gp, n_real, tree_mask, gkey, monotone,
+                     constraint_sets, cat, param=param, max_nbins=max_nbins,
+                     hist_method=hist_method, axis_name=None,
+                     has_missing=has_missing)
+
+    if K == 1:
+        grown = grow_class(0, gpair[:, 0, :])
+        return margin + grown.delta[:, None], grown
+
+    def body(_, xs):
+        k, gp_k = xs
+        grown = grow_class(k, gp_k)
+        out = {f: getattr(grown, f) for f in _GROWN_FIELDS}
+        out["__delta"] = grown.delta
+        return None, out
+
+    _, stacked = jax.lax.scan(
+        body, None, (jnp.arange(K, dtype=jnp.uint32),
+                     jnp.moveaxis(gpair, 1, 0)))
+    delta = jnp.moveaxis(stacked.pop("__delta"), 0, 1)     # [n, K]
+    return margin + delta, stacked
 
 
 @_functools.partial(
@@ -138,10 +165,17 @@ def _fused_multi_round_fn(bins, margin, labels, weights, n_real, seeds,
             constraint_sets, cat, obj_cls=obj_cls, obj_params=obj_params,
             param=param, max_nbins=max_nbins, hist_method=hist_method,
             has_missing=has_missing)
+        if isinstance(grown, dict):     # multiclass: already stacked [Kc]
+            return new_margin, grown
         node_arrays = {f: getattr(grown, f) for f in _GROWN_FIELDS}
         return new_margin, node_arrays
 
-    return jax.lax.scan(body, margin, (seeds, iterations))
+    new_margin, stacked = jax.lax.scan(body, margin, (seeds, iterations))
+    if margin.shape[1] > 1:
+        # [R, Kc, ...] -> [R * Kc, ...]: _flush slices trees by flat index
+        stacked = {f: v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:])
+                   for f, v in stacked.items()}
+    return new_margin, stacked
 
 
 class Booster:
@@ -666,8 +700,14 @@ class Booster:
             self._fused_round = None
             self._recover_donated_margin(state)
             return False
-        gbm._trees.append(_PendingTree(grown, grower))
-        gbm.tree_info.append(0)
+        if isinstance(grown, dict):     # multiclass: stacked [K] class axis
+            for k in range(gbm.n_groups):
+                gbm._trees.append(
+                    _PendingTree(None, grower, arrays=grown, index=k))
+                gbm.tree_info.append(k)
+        else:
+            gbm._trees.append(_PendingTree(grown, grower))
+            gbm.tree_info.append(0)
         gbm.iteration_indptr.append(len(gbm._trees))
         state["margin"] = new_margin
         state["n_trees"] = gbm.version()
@@ -701,7 +741,9 @@ class Booster:
         if (self._fused_blocked or type(gbm) is not GBTree
                 or not gbm.supports_margin_cache
                 or gbm.tree_method in ("approx", "exact")
-                or gbm.num_parallel_tree != 1 or gbm.n_groups != 1
+                or gbm.num_parallel_tree != 1
+                or getattr(gbm, "multi_strategy",
+                           "one_output_per_tree") != "one_output_per_tree"
                 or gbm.split_mode != "row"
                 or self.tree_param.grow_policy != "depthwise"
                 or self.tree_param.max_leaves > 0
@@ -785,13 +827,17 @@ class Booster:
             self._batch_blocked = True  # single-round fused path stays live
             self._recover_donated_margin(state)
             return False
-        # all K trees share ONE stacked-array dict; _flush fetches it once
-        # and slices host-side
+        # all R x Kc trees share ONE stacked-array dict; _flush fetches it
+        # once and slices host-side (multiclass axes arrive pre-flattened
+        # to [R * Kc] by _fused_multi_round_fn)
         stacked = growns
-        for k in range(len(iters)):
-            gbm._trees.append(
-                _PendingTree(None, grower, arrays=stacked, index=k))
-            gbm.tree_info.append(0)
+        Kc = gbm.n_groups
+        for r in range(len(iters)):
+            for k in range(Kc):
+                gbm._trees.append(
+                    _PendingTree(None, grower, arrays=stacked,
+                                 index=r * Kc + k))
+                gbm.tree_info.append(k)
             gbm.iteration_indptr.append(len(gbm._trees))
         state["margin"] = new_margin
         state["n_trees"] = gbm.version()

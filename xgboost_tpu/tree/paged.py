@@ -316,7 +316,10 @@ class PagedGrower(TreeGrower):
                         new_pos.append(update_positions(
                             page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
                             missing_bin, **cat_kw))
-                positions = jnp.concatenate(new_pos)
+                # empty local shard: no pages -> positions stay [] (the
+                # histogram side already contributed zeros symmetrically)
+                if new_pos:
+                    positions = jnp.concatenate(new_pos)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
                                    jnp.asarray(node_sum[:, 1]), param))
@@ -395,7 +398,8 @@ class PagedLossguideGrower(LossguideGrower):
                                   dleft, is_cat, words, left_id, right_id,
                                   missing_bin)
                        for s, e, page in paged.pages()]
-            return jnp.concatenate(new_pos)
+            # empty local shard: keep the [0] positions array as-is
+            return jnp.concatenate(new_pos) if new_pos else positions
 
         def root_sum(gpair):
             return _host_allreduce(jnp.sum(gpair, axis=0))
