@@ -239,3 +239,37 @@ def test_fused_multiclass_matches_general_path():
     b3 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False,
                    callbacks=[TrainingCallback()])
     assert bytes(b1.save_raw("json")) == bytes(b3.save_raw("json"))
+
+
+def test_scanned_class_grow_matches_sequential(monkeypatch):
+    """The general path's scanned per-class grow (which dart also uses)
+    must be bit-identical to the truly sequential per-class loop
+    (XTPU_SCAN_CLASSES=0)."""
+    rng = np.random.RandomState(21)
+    X = rng.randn(2000, 7).astype(np.float32)
+    y = (X @ rng.randn(7, 3)).argmax(axis=1).astype(np.float32)
+    params = {"objective": "multi:softprob", "num_class": 3,
+              "booster": "dart", "rate_drop": 0.3, "max_depth": 3,
+              "seed": 9}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    monkeypatch.setenv("XTPU_SCAN_CLASSES", "0")
+    b2 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    assert bytes(b1.save_raw("json")) == bytes(b2.save_raw("json"))
+    assert b1.gbm.tree_info == [0, 1, 2] * 4
+
+
+def test_scanned_class_grow_respects_max_leaves(monkeypatch):
+    """max_leaves truncation is host-side (TreeGrower._truncate_max_leaves)
+    so the scanned class grow must stand down; the model must equal the
+    sequential path and honour the cap."""
+    rng = np.random.RandomState(22)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = (X @ rng.randn(6, 3)).argmax(axis=1).astype(np.float32)
+    params = {"objective": "multi:softprob", "num_class": 3,
+              "max_depth": 5, "max_leaves": 4, "seed": 3}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    for t in b1.gbm.trees:
+        assert int(t.is_leaf.sum()) <= 4
+    monkeypatch.setenv("XTPU_SCAN_CLASSES", "0")
+    b2 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert bytes(b1.save_raw("json")) == bytes(b2.save_raw("json"))

@@ -24,6 +24,9 @@ params = {"objective": "multi:softprob", "num_class": K, "max_depth": 6,
 
 
 def run(tag, blocked, rounds=20):
+    # the true per-class-dispatch baseline needs BOTH the fused path off
+    # and the scanned general path off (XTPU_SCAN_CLASSES=0)
+    os.environ["XTPU_SCAN_CLASSES"] = "0" if blocked else "1"
     dm = xgb.DMatrix(X, label=y)
     b = xgb.Booster(params=params, cache=[dm])
     b._fused_blocked = blocked

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +51,46 @@ def sample_gradients(gp: jnp.ndarray, tkey: jax.Array,
                               0.0)[:, None]
     mask = jax.random.bernoulli(skey, param.subsample, (n,))
     return gp * mask[:, None].astype(gp.dtype)
+
+
+def _grow_classes_scan(bins, gpair, n_real, key, monotone, constraint_sets,
+                       cat, *, param, max_nbins, hist_method, has_missing):
+    """Grow all K class trees of one round as a single traced program —
+    ``lax.scan`` over the class axis. Every class tree shares the round's
+    margin snapshot (the reference's per-round gradient), and the per-class
+    PRNG stream matches the sequential loop exactly
+    (tkey = fold_in(key, k), num_parallel_tree == 1 path). Returns
+    (stacked per-node arrays with leading [K], margin delta [n, K]).
+    Shared by the fused round body and the general/dart boost loop."""
+    from ..tree.grow import _grow, _sample_features
+
+    K = gpair.shape[1]
+
+    def body(_, xs):
+        k, gp_k = xs
+        tkey = jax.random.fold_in(key, k)
+        gp = sample_gradients(gp_k, tkey, param)
+        tree_mask = _sample_features(jax.random.fold_in(tkey, 0xC0),
+                                     n_real > 0, param.colsample_bytree)
+        gkey = jax.random.fold_in(tkey, 0x5EED)
+        grown = _grow(bins, gp, n_real, tree_mask, gkey, monotone,
+                      constraint_sets, cat, param=param, max_nbins=max_nbins,
+                      hist_method=hist_method, axis_name=None,
+                      has_missing=has_missing)
+        out = {f: getattr(grown, f) for f in _GROWN_FIELDS}
+        out["__delta"] = grown.delta
+        return None, out
+
+    _, stacked = jax.lax.scan(
+        body, None, (jnp.arange(K, dtype=jnp.uint32),
+                     jnp.moveaxis(gpair, 1, 0)))
+    delta = jnp.moveaxis(stacked.pop("__delta"), 0, 1)      # [n, K]
+    return stacked, delta
+
+
+_grow_classes_fn = jax.jit(
+    _grow_classes_scan,
+    static_argnames=("param", "max_nbins", "hist_method", "has_missing"))
 
 
 @jax.jit
@@ -267,6 +309,26 @@ class GBTree:
         elif self.tree_method != "approx":
             grower = self._grower_for(binned)
             n_real = binned.n_real_bins()
+            if (K > 1 and not adaptive and self.num_parallel_tree == 1
+                    and type(grower) is TreeGrower and grower.mesh is None
+                    and grower.param.max_leaves <= 0  # host-side truncation
+                    and os.environ.get("XTPU_SCAN_CLASSES", "1") != "0"):
+                # all K class grows as ONE dispatch (lax.scan over classes)
+                # — same PRNG stream and numerics as the sequential loop
+                # below; this is what makes dart multiclass rounds one
+                # dispatch even though dart can't use the fused margin path
+                stacked, delta = _grow_classes_fn(
+                    binned.bins, gpair, n_real, key, grower.monotone,
+                    grower.constraint_sets, grower.cat,
+                    param=grower.param, max_nbins=grower.max_nbins,
+                    hist_method=grower.hist_method,
+                    has_missing=grower.has_missing)
+                for k in range(K):
+                    self._trees.append(
+                        _PendingTree(None, grower, arrays=stacked, index=k))
+                    self.tree_info.append(k)
+                self.iteration_indptr.append(len(self._trees))
+                return delta
         deltas = []
         for k in range(K):
             if self.tree_method == "approx":

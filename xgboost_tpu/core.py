@@ -74,7 +74,7 @@ def _fused_round_body(margin, seed, iteration, bins, labels, weights,
 
     from .tree.grow import _grow, _sample_features
 
-    from .boosting.gbtree import _GROWN_FIELDS, sample_gradients
+    from .boosting.gbtree import _grow_classes_scan, sample_gradients
 
     # identical stream to the general path: fold_in(make_key(it), it)
     key = jax.random.fold_in(jax.random.key(seed), iteration)
@@ -84,34 +84,24 @@ def _fused_round_body(margin, seed, iteration, bins, labels, weights,
     gpair = obj.get_gradient(margin, sinfo, 0)
     K = gpair.shape[1]
 
-    def grow_class(k, gp_k):
+    if K == 1:
         # general path key discipline: tkey = fold_in(key, k * npt + p),
-        # npt == 1 and p == 0 on this path
-        tkey = jax.random.fold_in(key, k)
-        gp = sample_gradients(gp_k, tkey, param)
+        # npt == 1, p == 0, k == 0 on this path
+        tkey = jax.random.fold_in(key, 0)
+        gp = sample_gradients(gpair[:, 0, :], tkey, param)
         tree_mask = _sample_features(jax.random.fold_in(tkey, 0xC0),
                                      n_real > 0, param.colsample_bytree)
         gkey = jax.random.fold_in(tkey, 0x5EED)
-        return _grow(bins, gp, n_real, tree_mask, gkey, monotone,
-                     constraint_sets, cat, param=param, max_nbins=max_nbins,
-                     hist_method=hist_method, axis_name=None,
-                     has_missing=has_missing)
-
-    if K == 1:
-        grown = grow_class(0, gpair[:, 0, :])
+        grown = _grow(bins, gp, n_real, tree_mask, gkey, monotone,
+                      constraint_sets, cat, param=param, max_nbins=max_nbins,
+                      hist_method=hist_method, axis_name=None,
+                      has_missing=has_missing)
         return margin + grown.delta[:, None], grown
 
-    def body(_, xs):
-        k, gp_k = xs
-        grown = grow_class(k, gp_k)
-        out = {f: getattr(grown, f) for f in _GROWN_FIELDS}
-        out["__delta"] = grown.delta
-        return None, out
-
-    _, stacked = jax.lax.scan(
-        body, None, (jnp.arange(K, dtype=jnp.uint32),
-                     jnp.moveaxis(gpair, 1, 0)))
-    delta = jnp.moveaxis(stacked.pop("__delta"), 0, 1)     # [n, K]
+    stacked, delta = _grow_classes_scan(
+        bins, gpair, n_real, key, monotone, constraint_sets, cat,
+        param=param, max_nbins=max_nbins, hist_method=hist_method,
+        has_missing=has_missing)
     return margin + delta, stacked
 
 
@@ -751,7 +741,12 @@ class Booster:
                 or state.get("binned") is None
                 or getattr(state.get("binned"), "is_paged", False)
                 or self.ctx.mesh is not None
-                or observer.enabled()):
+                or observer.enabled()
+                # XTPU_SCAN_CLASSES=0 opts out of the class-scanned grow
+                # everywhere — multiclass must then take the sequential
+                # general path, not the (also scanned) fused branch
+                or (gbm.n_groups > 1 and os.environ.get(
+                    "XTPU_SCAN_CLASSES", "1") == "0")):
             return None
         from .objective.base import Objective
 
