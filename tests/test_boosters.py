@@ -106,3 +106,45 @@ def test_gblinear_missing_as_zero():
     bst = xgb.train({"booster": "gblinear", "objective": "reg:squarederror"},
                     dm, 5, verbose_eval=False)
     assert np.isfinite(bst.predict(dm)).all()
+
+
+def test_dart_incremental_margin_matches_recompute(monkeypatch):
+    """Dart's closed-form margin roll-forward (rescale dropped + add new)
+    must match the full-forest recompute path to float tolerance."""
+    import numpy as np
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 6).astype(np.float32)
+    y = (X @ rng.randn(6) > 0).astype(np.float32)
+    # skip_drop > 0 interleaves no-drop rounds after dropped rounds — the
+    # regime where a poisoned/missed cache roll-forward would surface;
+    # evals on the TRAINING matrix read the cached margin path itself
+    params = {"objective": "binary:logistic", "booster": "dart",
+              "rate_drop": 0.4, "one_drop": True, "skip_drop": 0.3,
+              "max_depth": 3, "eta": 0.5, "seed": 1,
+              "eval_metric": "logloss"}
+
+    def train(res):
+        dm = xgb.DMatrix(X, label=y)
+        return xgb.train(params, dm, 12, evals=[(dm, "train")],
+                         evals_result=res, verbose_eval=False)
+
+    monkeypatch.setenv("XTPU_DART_INC", "1")
+    r1 = {}
+    b1 = train(r1)
+    monkeypatch.setenv("XTPU_DART_INC", "0")
+    r2 = {}
+    b2 = train(r2)
+    np.testing.assert_allclose(r1["train"]["logloss"],
+                               r2["train"]["logloss"], rtol=1e-4)
+    assert b1.gbm.weight_drop == b2.gbm.weight_drop
+    # identical structure; the rolled-forward margin differs from a fresh
+    # full walk in f32 low-order bits, so leaves carry that drift
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=2e-3, atol=1e-5)
+    p1 = np.asarray(b1.predict(xgb.DMatrix(X)))
+    p2 = np.asarray(b2.predict(xgb.DMatrix(X)))
+    np.testing.assert_allclose(p1, p2, rtol=1e-3, atol=1e-5)

@@ -35,6 +35,13 @@ class Dart(GBTree):
         self.weight_drop: List[float] = []
         self._dropped: List[int] = []
         self._rng = np.random.RandomState(0)
+        # incremental full-forest training margin (dart has no margin
+        # cache, but the full margin changes by a CLOSED FORM per round —
+        # rescale dropped, add new — so only the |D| dropped trees ever
+        # need re-walking, not the whole growing forest). Stored INSIDE
+        # the training state dict (state["dart_margin"]) so its lifetime
+        # tracks the cache entry, not a recyclable id().
+        self._drop_sum = None
 
     def configure(self, params: dict) -> None:
         for k in ("rate_drop", "skip_drop"):
@@ -74,37 +81,107 @@ class Dart(GBTree):
         return [int(i) for i in idx]
 
     def training_margin(self, state: dict) -> jnp.ndarray:
+        import os
+
         self._dropped = self._select_drop()
+        self._drop_sum = None
+        if os.environ.get("XTPU_DART_INC", "1") == "0":
+            # reference-shaped fallback: zero the dropped weights and
+            # re-walk the whole forest. super() on purpose — this margin
+            # EXCLUDES the dropped trees and must never enter the cache
+            if not self._dropped:
+                return state["margin"]
+            saved = list(self.weight_drop)
+            for t in self._dropped:
+                self.weight_drop[t] = 0.0
+            margin = super().compute_margin(state)
+            self.weight_drop = saved
+            return margin
+        full = self.compute_margin(state)  # cached full-forest margin
         if not self._dropped:
-            return state["margin"]
-        # margin without dropped trees = base + Σ_{t∉D} w_t tree_t
-        saved = list(self.weight_drop)
-        for t in self._dropped:
-            self.weight_drop[t] = 0.0
-        margin = self.compute_margin(state)
-        self.weight_drop = saved
-        return margin
+            return full
+        # margin without dropped = full - Σ_{t∈D} w_t tree_t: walk ONLY the
+        # dropped trees (|D| ≈ rate_drop * T, not T)
+        self._drop_sum = self._subset_delta(state, self._dropped)
+        return full - self._drop_sum
+
+    def _cached(self, state: dict):
+        c = state.get("dart_margin")
+        if (c is not None and c["n"] == len(self._trees)
+                and np.array_equal(c["w"], np.asarray(self.weight_drop))):
+            return c["m"]
+        return None
+
+    def _store(self, state: dict, m) -> None:
+        state["dart_margin"] = {
+            "n": len(self._trees),
+            "w": np.asarray(self.weight_drop, np.float64).copy(), "m": m}
+
+    def _subset_delta(self, state: dict, idx: List[int]):
+        """Σ_{t∈idx} w_t * tree_t margin on the training matrix [n, K]."""
+        from ..tree.tree import stack_forest
+        from .predict import ForestPredictor
+
+        trees = self.trees  # flushes pending
+        pred = ForestPredictor(
+            stack_forest([trees[i] for i in idx]),
+            np.asarray(self.tree_info)[idx], self.n_groups,
+            tree_weights=np.asarray(self.weight_drop, np.float32)[idx])
+        zero = np.zeros(self.n_groups, np.float32)
+        binned = state.get("binned")
+        if binned is not None:
+            if getattr(binned, "is_paged", False):
+                return self._margin_binned_paged(pred, binned, zero)
+            m, _ = pred.margin_binned(binned.bins, binned.missing_bin, zero)
+            return m
+        m, _ = pred.margin(np.asarray(state["dm"].values()), zero)
+        return jnp.asarray(m)
+
+    def compute_margin(self, state: dict) -> jnp.ndarray:
+        m = self._cached(state)
+        if m is not None:
+            return m
+        m = super().compute_margin(state)
+        self._store(state, m)
+        return m
 
     def do_boost(self, state, gpair, iteration, key, obj=None, margin=None):
-        start = len(self.trees)
+        start = len(self._trees)
+        w_pre = np.asarray(self.weight_drop, np.float64).copy()
         delta = super().do_boost(state, gpair, iteration, key, obj=obj,
                                  margin=margin)
-        n_new = len(self.trees) - start
+        n_new = len(self._trees) - start
         k = len(self._dropped)
         lr = self.tree_param.eta
         if k == 0:
-            new_w = 1.0
+            new_w, factor = 1.0, 1.0
         elif self.normalize_type == "forest":
-            new_w = 1.0 / (1.0 + lr)
+            new_w = factor = 1.0 / (1.0 + lr)
             for t in self._dropped:
-                self.weight_drop[t] *= 1.0 / (1.0 + lr)
+                self.weight_drop[t] *= factor
         else:  # tree
             new_w = 1.0 / (k + lr)
+            factor = k / (k + lr)
             for t in self._dropped:
-                self.weight_drop[t] *= k / (k + lr)
+                self.weight_drop[t] *= factor
         self.weight_drop.extend([new_w] * n_new)
+        # closed-form cache roll-forward: rescaled dropped + the new trees.
+        # Guards: the cached entry must be the PRE-commit full margin (tree
+        # count AND weights from before this round's rescale), and a
+        # dropped round must have its drop_sum (the XTPU_DART_INC=0
+        # fallback never sets one — its margins must not roll forward).
+        c = state.get("dart_margin")
+        if (c is not None and c["n"] == start
+                and np.array_equal(c["w"], w_pre)
+                and (k == 0 or self._drop_sum is not None)):
+            m = c["m"]
+            if k:
+                m = m + (factor - 1.0) * self._drop_sum
+            m = m + new_w * delta
+            self._store(state, m)
         self._dropped = []
-        return delta  # caller recomputes margin (supports_margin_cache=False)
+        self._drop_sum = None
+        return delta  # caller reads compute_margin (cache-fresh -> no walk)
 
     # -- serialization --------------------------------------------------------
     def to_json(self) -> dict:
