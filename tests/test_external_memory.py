@@ -94,12 +94,37 @@ def test_paged_eval_and_continuation(paged_qdm):
     assert len(bst2.gbm.trees) == 6
 
 
-def test_paged_unsupported_configs_raise(paged_qdm):
-    X, y, qdm = paged_qdm
+def test_paged_unsupported_configs_raise():
+    # device meshes stay resident-only (multi-host paging covers scale-out)
+    from xgboost_tpu.tree.paged import PagedGrower
+    from xgboost_tpu.tree.param import TrainParam
+
+    class FakeMesh:
+        pass
+
     with pytest.raises(NotImplementedError):
-        xgb.train({"objective": "multi:softprob", "num_class": 3,
-                   "multi_strategy": "multi_output_tree",
-                   "max_bin": 64}, qdm, 1, verbose_eval=False)
+        PagedGrower(TrainParam(), 64, None, mesh=FakeMesh())
+
+
+def test_paged_multi_output_tree_matches_resident(tmp_path, monkeypatch):
+    rng = np.random.RandomState(14)
+    n = 4000
+    X = rng.randn(n, 6).astype(np.float32)
+    Y = np.stack([X @ rng.randn(6), np.sin(X[:, 0]) + X[:, 1]],
+                 axis=1).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64, "multi_strategy": "multi_output_tree"}
+    bst_p, bst_m = _paged_vs_resident(
+        tmp_path, monkeypatch, lambda: BatchIter(X, Y, n_batches=4), params)
+    assert len(bst_p.gbm.trees) == len(bst_m.gbm.trees) == 6
+    for tp, tm in zip(bst_p.gbm.trees, bst_m.gbm.trees):
+        np.testing.assert_array_equal(tp.split_feature, tm.split_feature)
+        np.testing.assert_array_equal(tp.split_bin, tm.split_bin)
+        np.testing.assert_allclose(tp.leaf_value, tm.leaf_value,
+                                   rtol=2e-3, atol=1e-5)
+    dmx = xgb.DMatrix(X)
+    np.testing.assert_allclose(bst_p.predict(dmx), bst_m.predict(dmx),
+                               rtol=2e-3, atol=1e-5)
 
 
 def test_paged_lossguide_matches_resident(tmp_path, monkeypatch):

@@ -433,17 +433,20 @@ class GBTree:
         from ..tree.multi import MultiTargetGrower
 
         binned = state["binned"]
-        if getattr(binned, "is_paged", False):
-            raise NotImplementedError(
-                "multi_output_tree does not support external-memory (paged) "
-                "matrices yet; use one_output_per_tree or a resident matrix")
+        paged = getattr(binned, "is_paged", False)
         n = gpair.shape[0]
         if self._grower is None:
             param = self.tree_param
             if self.num_parallel_tree > 1:
                 param = param.clone()
                 param.eta = param.eta / self.num_parallel_tree
-            self._grower = MultiTargetGrower(
+            if paged:
+                from ..tree.paged import PagedMultiTargetGrower
+
+                cls = PagedMultiTargetGrower
+            else:
+                cls = MultiTargetGrower
+            self._grower = cls(
                 param, binned.max_nbins, binned.cuts,
                 hist_method=self.hist_method, mesh=self.mesh,
                 has_missing=binned.has_missing)
@@ -460,7 +463,10 @@ class GBTree:
                 gp = gp * mask[:, None, None].astype(gp.dtype)
             grown = grower.grow(binned.bins, gp, n_real, tkey)
             delta = delta + grown.delta
-            self._trees.append(_PendingTree(grown, grower))
+            if isinstance(grown.split_feature, jnp.ndarray):
+                self._trees.append(_PendingTree(grown, grower))
+            else:  # paged grower returns host arrays — materialise now
+                self._trees.append(grower.to_tree_model(grown))
             self.tree_info.append(0)
         self.iteration_indptr.append(len(self._trees))
         return delta

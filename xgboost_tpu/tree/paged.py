@@ -10,12 +10,13 @@ page histograms accumulate on device, split evaluation reuses the resident
 ``evaluate_splits`` kernel, and positions advance page-by-page with the
 gather walk. Device memory stays O(2 pages + per-row vectors).
 
-Scope: single-target, row split. Depthwise (``PagedGrower``) and
-loss-guided (``PagedLossguideGrower``) growth both stream; categorical
-splits, monotone/interaction constraints and ``max_leaves`` all work
-(same kernels as the resident path; constraint bookkeeping lives on the
-host beside the tree arrays). Column split and device meshes raise
-``NotImplementedError`` — train those on resident matrices.
+Scope: row split. Depthwise (``PagedGrower``), loss-guided
+(``PagedLossguideGrower``) and vector-leaf (``PagedMultiTargetGrower``)
+growth all stream; categorical splits, monotone/interaction constraints
+and ``max_leaves`` work on the scalar growers (same kernels as the
+resident path; constraint bookkeeping lives on the host beside the tree
+arrays). Column split and device meshes raise ``NotImplementedError`` —
+train those on resident matrices.
 Multi-HOST external memory works: one process per host, each streaming its
 own row shard, with the per-level histogram and root sum crossing hosts
 through the communicator (reference: SparsePageDMatrix under rabit row
@@ -36,6 +37,7 @@ from ..ops.split import evaluate_splits
 from .grow import (GrownTree, TreeGrower, _sample_features,
                    interaction_allowed_host, monotone_child_bounds_host)
 from .lossguide import LossguideGrower
+from .multi import MultiTargetGrower
 from .param import calc_weight
 
 _EPS = 1e-6
@@ -63,20 +65,82 @@ def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
 
 
 def _streamed_hist(paged, gpair: jnp.ndarray, rel_of, n_nodes: int,
-                   max_nbins: int, method: str) -> jnp.ndarray:
+                   max_nbins: int, method: str,
+                   multi: bool = False) -> jnp.ndarray:
     """One histogram pass over the pages + cross-host reduce. ``rel_of(s, e)``
     maps a page's row span to its [e-s] node-slot vector. An empty local
     shard contributes zeros so the collective stays symmetric (a rank with
-    no rows must still meet its peers in the allreduce)."""
+    no rows must still meet its peers in the allreduce). With ``multi`` the
+    gradient is [n, K, 2] and the histogram grows a K channel axis."""
+    from ..ops.histogram import build_hist_multi
+
+    builder = build_hist_multi if multi else build_hist
     hist = None
     for s, e, page in paged.pages():
-        h = build_hist(page, gpair[s:e], rel_of(s, e), n_nodes, max_nbins,
-                       method=method)
+        h = builder(page, gpair[s:e], rel_of(s, e), n_nodes, max_nbins,
+                    method=method)
         hist = h if hist is None else hist + h
     if hist is None:
-        hist = jnp.zeros((n_nodes, paged.n_features, max_nbins, 2),
-                         jnp.float32)
+        shape = ((n_nodes, paged.n_features, max_nbins, gpair.shape[1], 2)
+                 if multi else (n_nodes, paged.n_features, max_nbins, 2))
+        hist = jnp.zeros(shape, jnp.float32)
     return _host_allreduce(hist)
+
+
+def _streamed_advance(paged, positions, rel_of, idx, can_split, n_static,
+                      n_level, split_feature, split_bin, default_left,
+                      max_nodes, missing_bin, cat_state=None):
+    """Advance positions one level with a pass over the pages — the shared
+    level-advance of the paged growers. ``n_static <= 64`` uses the dense
+    matmul advance with static-width padded split vectors (one program per
+    page shape); deeper levels use the per-row gather walk. ``cat_state``
+    is an optional ``(is_cat_split, cat_words)`` pair of full host arrays.
+    An empty local shard leaves positions unchanged (the histogram side
+    already contributed zeros symmetrically)."""
+    new_pos = []
+    if n_static <= 64:
+        feat_pad = np.full(n_static, -1, np.int32)
+        bin_pad = np.zeros(n_static, np.int32)
+        dl_pad = np.zeros(n_static, bool)
+        cs_pad = np.zeros(n_static, bool)
+        feat_pad[:n_level] = split_feature[idx]
+        bin_pad[:n_level] = split_bin[idx]
+        dl_pad[:n_level] = default_left[idx]
+        cs_pad[:n_level] = can_split
+        feat_d = jnp.asarray(feat_pad)
+        bin_d = jnp.asarray(bin_pad)
+        dl_d = jnp.asarray(dl_pad)
+        cs_d = jnp.asarray(cs_pad)
+        cat_kw = {}
+        if cat_state is not None:
+            is_cat_split, cat_words = cat_state
+            ic_pad = np.zeros(n_static, bool)
+            cw_pad = np.zeros((n_static, cat_words.shape[1]), np.uint32)
+            ic_pad[:n_level] = is_cat_split[idx]
+            cw_pad[:n_level] = cat_words[idx]
+            cat_kw = dict(is_cat=jnp.asarray(ic_pad),
+                          cat_words=jnp.asarray(cw_pad))
+        for s, e, page in paged.pages():
+            new_pos.append(advance_positions_level(
+                page.astype(jnp.float32), positions[s:e], rel_of(s, e),
+                feat_d, bin_d, dl_d, cs_d, missing_bin, **cat_kw))
+    else:  # deep levels: per-row gather walk, O(page) memory
+        sf_d = jnp.asarray(split_feature)
+        sb_d = jnp.asarray(split_bin)
+        dl_d = jnp.asarray(default_left)
+        is_split_full = np.zeros(max_nodes, bool)
+        is_split_full[idx] = can_split
+        isf_d = jnp.asarray(is_split_full)
+        cat_kw = {}
+        if cat_state is not None:
+            is_cat_split, cat_words = cat_state
+            cat_kw = dict(is_cat_split=jnp.asarray(is_cat_split),
+                          cat_words=jnp.asarray(cat_words))
+        for s, e, page in paged.pages():
+            new_pos.append(update_positions(
+                page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
+                missing_bin, **cat_kw))
+    return jnp.concatenate(new_pos) if new_pos else positions
 
 
 class PagedGrower(TreeGrower):
@@ -265,61 +329,12 @@ class PagedGrower(TreeGrower):
                 break
 
             # --- position advance: second streamed pass ------------------
-            if depth + 1 <= max_depth:
-                new_pos = []
-                if n_static <= 64:
-                    # static-width [N] split vectors -> one matmul-based
-                    # (gather-free) advance program per page shape; its
-                    # [page, N] intermediates cap the width at 64
-                    feat_pad = np.full(n_static, -1, np.int32)
-                    bin_pad = np.zeros(n_static, np.int32)
-                    dl_pad = np.zeros(n_static, bool)
-                    cs_pad = np.zeros(n_static, bool)
-                    feat_pad[:n_level] = split_feature[idx]
-                    bin_pad[:n_level] = split_bin[idx]
-                    dl_pad[:n_level] = default_left[idx]
-                    cs_pad[:n_level] = can_split
-                    feat_d = jnp.asarray(feat_pad)
-                    bin_d = jnp.asarray(bin_pad)
-                    dl_d = jnp.asarray(dl_pad)
-                    cs_d = jnp.asarray(cs_pad)
-                    cat_kw = {}
-                    if cat is not None:
-                        ic_pad = np.zeros(n_static, bool)
-                        cw_pad = np.zeros((n_static, n_words), np.uint32)
-                        ic_pad[:n_level] = is_cat_split[idx]
-                        cw_pad[:n_level] = cat_words[idx]
-                        cat_kw = dict(is_cat=jnp.asarray(ic_pad),
-                                      cat_words=jnp.asarray(cw_pad))
-                    for s, e, page in paged.pages():
-                        rel = jnp.where(
-                            (positions[s:e] >= lo)
-                            & (positions[s:e] < lo + n_level),
-                            positions[s:e] - lo,
-                            n_static).astype(jnp.int32)
-                        new_pos.append(advance_positions_level(
-                            page.astype(jnp.float32), positions[s:e], rel,
-                            feat_d, bin_d, dl_d, cs_d, missing_bin,
-                            **cat_kw))
-                else:  # deep levels: per-row gather walk, O(page) memory
-                    sf_d = jnp.asarray(split_feature)
-                    sb_d = jnp.asarray(split_bin)
-                    dl_d = jnp.asarray(default_left)
-                    is_split_full = np.zeros(max_nodes, bool)
-                    is_split_full[idx] = can_split
-                    isf_d = jnp.asarray(is_split_full)
-                    cat_kw = {}
-                    if cat is not None:
-                        cat_kw = dict(is_cat_split=jnp.asarray(is_cat_split),
-                                      cat_words=jnp.asarray(cat_words))
-                    for s, e, page in paged.pages():
-                        new_pos.append(update_positions(
-                            page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
-                            missing_bin, **cat_kw))
-                # empty local shard: no pages -> positions stay [] (the
-                # histogram side already contributed zeros symmetrically)
-                if new_pos:
-                    positions = jnp.concatenate(new_pos)
+            positions = _streamed_advance(
+                paged, positions, rel_of, idx, can_split, n_static, n_level,
+                split_feature, split_bin, default_left, max_nodes,
+                missing_bin,
+                cat_state=(is_cat_split, cat_words) if cat is not None
+                else None)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
                                    jnp.asarray(node_sum[:, 1]), param))
@@ -407,3 +422,132 @@ class PagedLossguideGrower(LossguideGrower):
         gather = jax.jit(lambda lv, pos: lv[pos])
         self._fns = (eval2, apply1, root_sum, gather)
         return self._fns
+
+
+class PagedMultiTargetGrower(MultiTargetGrower):
+    """Vector-leaf (``multi_strategy=multi_output_tree``) growth over a
+    ``PagedBinnedMatrix``: the depthwise level loop of ``PagedGrower`` with
+    a K-channel gradient — per depth, one streamed K-target histogram pass
+    and one streamed advance pass (reference: ``MultiTargetHistBuilder``
+    iterates ``GetBatches<GHistIndexMatrix>`` exactly like the scalar
+    builder, ``src/tree/updater_quantile_hist.cc:117-263``). Multi-host
+    works the same way as ``PagedGrower``: per-level histogram and root
+    sum cross hosts through the communicator."""
+
+    def __init__(self, param, max_nbins, cuts, hist_method="auto",
+                 mesh=None, has_missing=True) -> None:
+        if mesh is not None:
+            raise NotImplementedError(
+                "external-memory training does not support device meshes; "
+                "multi-host external memory runs one process per host "
+                "with a communicator")
+        super().__init__(param, max_nbins, cuts, hist_method=hist_method,
+                         mesh=None, has_missing=has_missing)
+
+    def grow(self, paged, gpair: jnp.ndarray, n_real_bins, key: jax.Array):
+        from .multi import GrownMulti, evaluate_splits_multi
+
+        param = self.param
+        n, K = gpair.shape[0], gpair.shape[1]
+        max_depth = param.max_depth
+        max_nodes = 2 ** (max_depth + 1) - 1
+        max_nbins = self.max_nbins
+        missing_bin = paged.missing_bin
+        hist_kernel = _strip_hist_suffix(self.hist_method)
+        n_real = np.asarray(n_real_bins)
+        F = paged.n_features
+        tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
+                                     jnp.ones((F,), bool),
+                                     param.colsample_bytree)
+        key = jax.random.fold_in(key, 0x5EED)
+
+        split_feature = np.full(max_nodes, -1, np.int32)
+        split_bin = np.zeros(max_nodes, np.int32)
+        default_left = np.zeros(max_nodes, bool)
+        is_leaf = np.ones(max_nodes, bool)
+        active = np.zeros(max_nodes, bool)
+        active[0] = True
+        gain = np.zeros(max_nodes, np.float32)
+        node_sum = np.zeros((max_nodes, K, 2), np.float32)
+        node_sum[0] = np.asarray(_host_allreduce(jnp.sum(gpair, axis=0)))
+        positions = jnp.zeros((n,), jnp.int32)
+        n_static = 2 ** (max_depth - 1) if max_depth > 0 else 1
+
+        for depth in range(max_depth):
+            lo = 2 ** depth - 1
+            n_level = 2 ** depth
+
+            def rel_of(s, e, lo=lo, n_level=n_level):
+                return jnp.where(
+                    (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
+                    positions[s:e] - lo, n_static).astype(jnp.int32)
+
+            hist = _streamed_hist(paged, gpair, rel_of, n_static, max_nbins,
+                                  hist_kernel, multi=True)
+
+            level_key = jax.random.fold_in(key, depth)
+            fmask_level = _sample_features(level_key, tree_mask,
+                                           param.colsample_bylevel)
+            if param.colsample_bynode < 1.0:
+                node_keys = jax.random.split(
+                    jax.random.fold_in(level_key, 1), n_level)
+                fmask = jax.vmap(
+                    lambda k: _sample_features(k, fmask_level,
+                                               param.colsample_bynode)
+                )(node_keys)
+                if n_level < n_static:
+                    fmask = jnp.concatenate(
+                        [fmask, jnp.zeros((n_static - n_level,
+                                           fmask.shape[1]), bool)])
+            else:
+                fmask = fmask_level[None, :]
+
+            parent_pad = np.zeros((n_static, K, 2), np.float32)
+            parent_pad[:n_level] = node_sum[lo:lo + n_level]
+            res = evaluate_splits_multi(hist, jnp.asarray(parent_pad),
+                                        jnp.asarray(n_real), param,
+                                        feature_mask=fmask,
+                                        has_missing=self.has_missing)
+
+            res_gain = np.asarray(res.gain)[:n_level]
+            can_split = (active[lo:lo + n_level]
+                         & (res_gain > max(param.gamma, _EPS))
+                         & np.isfinite(res_gain))
+            idx = lo + np.arange(n_level)
+            split_feature[idx] = np.where(
+                can_split, np.asarray(res.feature)[:n_level], -1)
+            split_bin[idx] = np.where(
+                can_split, np.asarray(res.bin)[:n_level], 0)
+            default_left[idx] = can_split \
+                & np.asarray(res.default_left)[:n_level]
+            is_leaf[idx] = ~can_split
+            gain[idx] = np.where(can_split, res_gain, 0.0)
+            li, ri = 2 * idx + 1, 2 * idx + 2
+            active[li] = can_split
+            active[ri] = can_split
+            ls = np.asarray(res.left_sum)[:n_level]      # [N, K, 2]
+            rs = np.asarray(res.right_sum)[:n_level]
+            node_sum[li] = np.where(can_split[:, None, None], ls, 0.0)
+            node_sum[ri] = np.where(can_split[:, None, None], rs, 0.0)
+
+            if not can_split.any():
+                break
+
+            positions = _streamed_advance(
+                paged, positions, rel_of, idx, can_split, n_static, n_level,
+                split_feature, split_bin, default_left, max_nodes,
+                missing_bin)
+
+        w = np.asarray(calc_weight(jnp.asarray(node_sum[..., 0]),
+                                   jnp.asarray(node_sum[..., 1]),
+                                   param)) * param.eta      # [max_nodes, K]
+        leaf_value = np.where((active & is_leaf)[:, None], w,
+                              0.0).astype(np.float32)
+        base_weight = np.where(active[:, None], w, 0.0).astype(np.float32)
+        delta = jnp.asarray(leaf_value)[positions]          # [n, K]
+
+        return GrownMulti(
+            split_feature=split_feature, split_bin=split_bin,
+            default_left=default_left, is_leaf=is_leaf, active=active,
+            leaf_value=leaf_value, node_sum=node_sum, gain=gain,
+            positions=positions, delta=delta, base_weight=base_weight)
