@@ -109,3 +109,48 @@ def test_multi_output_tree_eval_metric_and_dump():
     dump = bst.get_dump()
     assert len(dump) == 5 and "leaf=[" in dump[0]
     assert len(bst.trees_to_dataframe()) > 0
+
+
+def test_multi_output_tree_max_leaves():
+    """Depthwise max_leaves over vector leaves (reference Driver cap,
+    src/tree/driver.h:63)."""
+    X, Y = _data(n=3000)
+    params = {"objective": "reg:squarederror",
+              "multi_strategy": "multi_output_tree", "max_depth": 5,
+              "max_leaves": 6}
+    res = {}
+    dm = xgb.DMatrix(X, label=Y)
+    bst = xgb.train(params, dm, 5, evals=[(dm, "train")],
+                    evals_result=res, verbose_eval=False)
+    for t in bst.gbm.trees:
+        assert int(t.is_leaf.sum()) <= 6
+    assert res["train"]["rmse"][-1] < res["train"]["rmse"][0]
+    p = bst.predict(xgb.DMatrix(X))
+    assert p.shape == Y.shape
+
+
+def test_multi_output_tree_lossguide():
+    """Best-first vector-leaf growth (reference: the same Driver template
+    schedules MultiTargetHistBuilder under LossGuide ordering,
+    src/tree/updater_quantile_hist.cc:54-115 + driver.h:70-78)."""
+    X, Y = _data(n=3000)
+    params = {"objective": "reg:squarederror",
+              "multi_strategy": "multi_output_tree",
+              "grow_policy": "lossguide", "max_leaves": 8, "max_depth": 0}
+    res = {}
+    dm = xgb.DMatrix(X, label=Y)
+    bst = xgb.train(params, dm, 5, evals=[(dm, "train")],
+                    evals_result=res, verbose_eval=False)
+    for t in bst.gbm.trees:
+        assert int(t.is_leaf.sum()) <= 8
+    assert res["train"]["rmse"][-1] < res["train"]["rmse"][0]
+    # save/load round-trips the vector-leaf lossguide tree
+    raw = bst.save_raw("json")
+    b2 = xgb.Booster()
+    b2.load_model(bytes(raw))
+    np.testing.assert_allclose(b2.predict(xgb.DMatrix(X)),
+                               bst.predict(xgb.DMatrix(X)), rtol=1e-6)
+    # lossguide with a depth bound only
+    b3 = xgb.train({**params, "max_leaves": 0, "max_depth": 3},
+                   xgb.DMatrix(X, label=Y), 3, verbose_eval=False)
+    assert all(int(t.is_leaf.sum()) <= 8 for t in b3.gbm.trees)

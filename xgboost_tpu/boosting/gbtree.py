@@ -441,9 +441,17 @@ class GBTree:
                 param = param.clone()
                 param.eta = param.eta / self.num_parallel_tree
             if paged:
+                if param.grow_policy == "lossguide":
+                    raise NotImplementedError(
+                        "multi_output_tree lossguide does not support "
+                        "external-memory (paged) matrices")
                 from ..tree.paged import PagedMultiTargetGrower
 
                 cls = PagedMultiTargetGrower
+            elif param.grow_policy == "lossguide":
+                from ..tree.multi import MultiLossguideGrower
+
+                cls = MultiLossguideGrower
             else:
                 cls = MultiTargetGrower
             self._grower = cls(
@@ -463,9 +471,10 @@ class GBTree:
                 gp = gp * mask[:, None, None].astype(gp.dtype)
             grown = grower.grow(binned.bins, gp, n_real, tkey)
             delta = delta + grown.delta
-            if isinstance(grown.split_feature, jnp.ndarray):
+            if getattr(grown, "split_feature", None) is not None \
+                    and isinstance(grown.split_feature, jnp.ndarray):
                 self._trees.append(_PendingTree(grown, grower))
-            else:  # paged grower returns host arrays — materialise now
+            else:  # host arrays (paged / lossguide) — materialise now
                 self._trees.append(grower.to_tree_model(grown))
             self.tree_info.append(0)
         self.iteration_indptr.append(len(self._trees))

@@ -466,6 +466,32 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                      base_weight=base_weight)
 
 
+def select_max_leaves(active: np.ndarray, is_leaf: np.ndarray,
+                      max_leaves: int):
+    """Simulate the reference Driver's depth-wise schedule under a
+    ``max_leaves`` cap over a fully grown level tree (``CPUExpandEntry::
+    IsValid``): pop same-depth nodes in insertion (heap BFS) order, stop
+    splitting once the leaf count hits the cap. Splits are
+    order-independent, so this reproduces it exactly. Returns
+    ``(exists, selected, changed)`` — heap masks of surviving nodes and
+    retained splits; ``changed`` False means the cap never bound."""
+    cap = len(is_leaf)
+    exists = np.zeros(cap, bool)
+    exists[0] = True
+    selected = np.zeros(cap, bool)
+    n_leaves = 1
+    for nid in range(cap):
+        if not exists[nid] or is_leaf[nid] or not active[nid]:
+            continue
+        if n_leaves >= max_leaves:
+            continue
+        selected[nid] = True
+        n_leaves += 1
+        exists[2 * nid + 1] = exists[2 * nid + 2] = True
+    was_split = active & ~is_leaf
+    return exists, selected, not (selected == was_split).all()
+
+
 def interaction_allowed_host(path_level: np.ndarray,
                              cons: np.ndarray) -> np.ndarray:
     """allowed(n) = union of constraint sets containing path(n) — the numpy
@@ -587,24 +613,11 @@ class TreeGrower:
         order-independent, so simulating that schedule over the fully grown
         level tree reproduces it exactly; rows in truncated subtrees are
         re-parked on their deepest surviving ancestor."""
-        max_leaves = self.param.max_leaves
         active = np.asarray(g.active)
         is_leaf = np.asarray(g.is_leaf)
-        cap = len(is_leaf)
-        exists = np.zeros(cap, bool)
-        exists[0] = True
-        selected = np.zeros(cap, bool)
-        n_leaves = 1
-        for nid in range(cap):      # heap BFS order == insertion order
-            if not exists[nid] or is_leaf[nid] or not active[nid]:
-                continue
-            if n_leaves >= max_leaves:
-                continue
-            selected[nid] = True
-            n_leaves += 1
-            exists[2 * nid + 1] = exists[2 * nid + 2] = True
-        was_split = active & ~is_leaf
-        if (selected == was_split).all():
+        exists, selected, changed = select_max_leaves(
+            active, is_leaf, self.param.max_leaves)
+        if not changed:
             return g
         base_weight = np.asarray(g.base_weight)
         new_is_leaf = exists & ~selected

@@ -348,10 +348,8 @@ class MultiTargetGrower:
                  has_missing: bool = True) -> None:
         if param.grow_policy == "lossguide":
             raise NotImplementedError(
-                "multi_output_tree supports grow_policy=depthwise only")
-        if param.max_leaves > 0:
-            raise NotImplementedError(
-                "multi_output_tree does not support max_leaves")
+                "multi_output_tree supports grow_policy=depthwise only; "
+                "use MultiLossguideGrower via grow_policy=lossguide")
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
@@ -370,11 +368,51 @@ class MultiTargetGrower:
                                      self.param.colsample_bytree)
         key = jax.random.fold_in(key, 0x5EED)
         if self.mesh is None:
-            return _grow_multi(bins, gpair, n_real_bins, tree_mask, key,
-                               param=self.param, max_nbins=self.max_nbins,
-                               hist_method=self.hist_method, axis_name=None,
-                               has_missing=self.has_missing)
-        return self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+            g = _grow_multi(bins, gpair, n_real_bins, tree_mask, key,
+                            param=self.param, max_nbins=self.max_nbins,
+                            hist_method=self.hist_method, axis_name=None,
+                            has_missing=self.has_missing)
+        else:
+            g = self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+        if self.param.max_leaves > 0:
+            g = self._truncate_max_leaves(g)
+        return g
+
+    def _truncate_max_leaves(self, g: GrownMulti) -> GrownMulti:
+        """Depth-wise ``max_leaves`` over vector leaves — the K-channel
+        mirror of ``TreeGrower._truncate_max_leaves`` (same reference
+        Driver schedule, shared via ``grow.select_max_leaves``)."""
+        from .grow import select_max_leaves
+
+        active = np.asarray(g.active)
+        is_leaf = np.asarray(g.is_leaf)
+        exists, selected, changed = select_max_leaves(
+            active, is_leaf, self.param.max_leaves)
+        if not changed:
+            return g
+        base_weight = np.asarray(g.base_weight)           # [cap, K]
+        new_is_leaf = exists & ~selected
+        leaf_value = np.where(new_is_leaf[:, None], base_weight,
+                              0.0).astype(np.float32)
+        pos = np.asarray(g.positions)
+        for _ in range(self.param.max_depth):
+            # re-park rows of truncated subtrees on the surviving ancestor
+            pos = np.where(exists[pos], pos, (pos - 1) // 2)
+        return GrownMulti(
+            split_feature=np.where(selected, np.asarray(g.split_feature),
+                                   -1).astype(np.int32),
+            split_bin=np.where(selected, np.asarray(g.split_bin),
+                               0).astype(np.int32),
+            default_left=np.asarray(g.default_left) & selected,
+            is_leaf=new_is_leaf, active=exists,
+            leaf_value=leaf_value,
+            node_sum=np.asarray(g.node_sum),
+            gain=np.where(selected, np.asarray(g.gain),
+                          0.0).astype(np.float32),
+            positions=pos.astype(np.int32),
+            delta=jnp.asarray(leaf_value[pos]),
+            base_weight=np.where(exists[:, None], base_weight,
+                                 0.0).astype(np.float32))
 
     def _sharded(self, bins, gpair, n_real_bins, tree_mask, key):
         from ..context import DATA_AXIS
@@ -415,3 +453,182 @@ class MultiTargetGrower:
             sum_hess=node_sum[:, :, 1].sum(axis=1),
             gain=np.asarray(g.gain),
             base_weight=np.asarray(g.base_weight))
+
+
+def _eval2_multi(bins, gpair, positions, id0, id1, parent_sums, fmask,
+                 n_real_bins, *, param: TrainParam, max_nbins: int,
+                 hist_method: str, has_missing: bool = True):
+    """Histogram + shared-split enumeration for (up to) two sibling nodes
+    over the K-channel gradient — the vector-leaf mirror of
+    ``lossguide._eval2``."""
+    rel = jnp.where(positions == id0, 0,
+                    jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
+    hist = build_hist_multi(bins, gpair, rel, 2, max_nbins,
+                            method=hist_method)
+    return evaluate_splits_multi(hist, parent_sums, n_real_bins, param,
+                                 feature_mask=fmask,
+                                 has_missing=has_missing)
+
+
+class MultiLossguideGrower:
+    """Loss-guided vector-leaf growth — ``multi_strategy=multi_output_tree``
+    with ``grow_policy=lossguide``. Reference: the SAME ``Driver`` template
+    schedules both builders (``src/tree/driver.h:70-78`` pops one best
+    candidate under LossGuide; ``MultiTargetHistBuilder`` plugs into it at
+    ``src/tree/updater_quantile_hist.cc:54-115``), so the greedy pop loop
+    of ``LossguideGrower`` carries over verbatim — only the two device
+    kernels change to their K-channel forms. Compact host arrays, capacity
+    ``2 * max_leaves - 1``."""
+
+    def __init__(self, param: TrainParam, max_nbins: int, cuts,
+                 hist_method: str = "auto",
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 has_missing: bool = True) -> None:
+        if mesh is not None:
+            raise NotImplementedError(
+                "multi_output_tree lossguide does not support device "
+                "meshes yet; use depthwise or a single chip")
+        if param.max_leaves <= 0 and param.max_depth <= 0:
+            raise ValueError(
+                "grow_policy=lossguide needs max_leaves > 0 or max_depth > 0")
+        self.param = param
+        self.max_nbins = max_nbins
+        self.cuts = cuts
+        self.hist_method = hist_method
+        self.mesh = None
+        self.has_missing = has_missing
+        self._fns = None
+
+    def _functions(self):
+        if self._fns is None:
+            from .lossguide import _apply1
+
+            ev = functools.partial(
+                _eval2_multi, param=self.param, max_nbins=self.max_nbins,
+                hist_method=self.hist_method, has_missing=self.has_missing)
+            self._fns = (jax.jit(ev), jax.jit(_apply1),
+                         jax.jit(lambda g: jnp.sum(g, axis=0)),
+                         jax.jit(lambda lv, pos: lv[pos]))
+        return self._fns
+
+    def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
+             n_real_bins: jnp.ndarray, key: jax.Array):
+        import heapq
+
+        from .lossguide import LossguideGrower, LossguideGrown
+
+        param = self.param
+        n, F = bins.shape
+        K = gpair.shape[1]
+        max_leaves = param.max_leaves if param.max_leaves > 0 else (
+            2 ** max(param.max_depth, 1))
+        cap = 2 * max_leaves - 1
+        eval2, apply1, root_sum_fn, gather = self._functions()
+        try:
+            seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1])
+        except (TypeError, ValueError):
+            seed = int(np.asarray(key).ravel()[-1])
+        node_mask = LossguideGrower._col_masks(self, seed, F)
+
+        sf = np.full(cap, -1, np.int32)
+        sb = np.zeros(cap, np.int32)
+        dl = np.zeros(cap, bool)
+        lc = np.full(cap, -1, np.int32)
+        rc = np.full(cap, -1, np.int32)
+        pa = np.full(cap, -1, np.int32)
+        gn = np.zeros(cap, np.float32)
+        gh = np.zeros((cap, K, 2), np.float64)
+        depth_of = np.zeros(cap, np.int32)
+        _EPS = 1e-6
+
+        positions = jnp.zeros((n,), jnp.int32)
+        gh[0] = np.asarray(root_sum_fn(gpair), np.float64)
+        n_nodes = 1
+        n_leaves = 1
+        counter = 0
+        pq: list = []
+
+        def eval_nodes(id0: int, id1: int) -> None:
+            nonlocal counter
+            ids = [i for i in (id0, id1) if i >= 0]
+            if param.max_depth > 0:
+                ids = [i for i in ids if depth_of[i] < param.max_depth]
+            if not ids:
+                return
+            i0 = ids[0]
+            i1 = ids[1] if len(ids) > 1 else -1
+            fm = np.stack([node_mask(int(depth_of[i])) if i >= 0
+                           else np.zeros(F, bool) for i in (i0, i1)])
+            psums = np.stack([gh[i0], gh[i1] if i1 >= 0
+                              else np.zeros((K, 2))]).astype(np.float32)
+            res = eval2(bins, gpair, positions, np.int32(i0), np.int32(i1),
+                        jnp.asarray(psums), jnp.asarray(fm), n_real_bins)
+            gain = np.asarray(res.gain)
+            feat = np.asarray(res.feature)
+            rbin = np.asarray(res.bin)
+            rdl = np.asarray(res.default_left)
+            lsum = np.asarray(res.left_sum, np.float64)   # [2, K, 2]
+            rsum = np.asarray(res.right_sum, np.float64)
+            for slot, nid in ((0, i0), (1, i1)):
+                if nid < 0:
+                    continue
+                g = float(gain[slot])
+                if not np.isfinite(g) or g <= max(param.gamma, _EPS):
+                    continue
+                heapq.heappush(pq, (-g, counter, nid,
+                                    (int(feat[slot]), int(rbin[slot]),
+                                     bool(rdl[slot]), lsum[slot].copy(),
+                                     rsum[slot].copy())))
+                counter += 1
+
+        eval_nodes(0, -1)
+        missing_bin = np.int32(self.max_nbins - 1 if self.has_missing
+                               else self.max_nbins)
+        empty_words = jnp.zeros((1,), jnp.uint32)
+        while pq and n_leaves < max_leaves:
+            neg_gain, _, nid, payload = heapq.heappop(pq)
+            feat, rbin, rdl, lsum, rsum = payload
+            li, ri = n_nodes, n_nodes + 1
+            n_nodes += 2
+            n_leaves += 1
+            sf[nid] = feat
+            sb[nid] = rbin
+            dl[nid] = rdl
+            gn[nid] = -neg_gain
+            lc[nid], rc[nid] = li, ri
+            pa[li] = pa[ri] = nid
+            gh[li], gh[ri] = lsum, rsum
+            depth_of[li] = depth_of[ri] = depth_of[nid] + 1
+            positions = apply1(
+                bins, positions, np.int32(nid), np.int32(feat),
+                np.int32(rbin), np.bool_(rdl), np.bool_(False),
+                empty_words, np.int32(li), np.int32(ri), missing_bin)
+            eval_nodes(li, ri)
+
+        w = np.asarray(calc_weight(
+            jnp.asarray(gh[:n_nodes, :, 0], jnp.float32),
+            jnp.asarray(gh[:n_nodes, :, 1], jnp.float32),
+            param)) * param.eta                            # [n_nodes, K]
+        is_leaf = lc[:n_nodes] < 0
+        leaf_value = np.where(is_leaf[:, None], w, 0.0).astype(np.float32)
+        split_value = self.cuts.split_values(sf[:n_nodes], sb[:n_nodes])
+        tree = MultiTargetTreeModel(
+            left_child=lc[:n_nodes].copy(), right_child=rc[:n_nodes].copy(),
+            parent=pa[:n_nodes].copy(),
+            split_feature=sf[:n_nodes].copy(), split_bin=sb[:n_nodes].copy(),
+            split_value=split_value, default_left=dl[:n_nodes].copy(),
+            is_leaf=is_leaf, leaf_value=leaf_value,
+            sum_hess=gh[:n_nodes, :, 1].sum(axis=1).astype(np.float32),
+            gain=np.where(is_leaf, 0.0, gn[:n_nodes]).astype(np.float32),
+            is_cat_split=np.zeros(n_nodes, bool),
+            cat_words=np.zeros((n_nodes, 1), np.uint32),
+            base_weight=w.astype(np.float32))
+        tree.heap_map = np.arange(n_nodes, dtype=np.int32)
+        leaf_pad = np.zeros((max(cap, n_nodes), K), np.float32)
+        leaf_pad[:n_nodes] = leaf_value
+        delta = gather(jnp.asarray(leaf_pad), positions)
+
+        return LossguideGrown(positions=positions, delta=delta, tree=tree)
+
+    def to_tree_model(self, g) -> MultiTargetTreeModel:
+        return g.tree

@@ -546,8 +546,11 @@ class PagedMultiTargetGrower(MultiTargetGrower):
         base_weight = np.where(active[:, None], w, 0.0).astype(np.float32)
         delta = jnp.asarray(leaf_value)[positions]          # [n, K]
 
-        return GrownMulti(
+        g = GrownMulti(
             split_feature=split_feature, split_bin=split_bin,
             default_left=default_left, is_leaf=is_leaf, active=active,
             leaf_value=leaf_value, node_sum=node_sum, gain=gain,
             positions=positions, delta=delta, base_weight=base_weight)
+        if param.max_leaves > 0:
+            g = self._truncate_max_leaves(g)
+        return g
