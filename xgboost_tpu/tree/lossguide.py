@@ -50,13 +50,16 @@ class LossguideGrown(NamedTuple):
 
 
 def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
-           node_lower, node_upper, n_real_bins, monotone, cat, *,
+           node_lower, node_upper, n_real_bins, bins_t, monotone, cat, *,
            param: TrainParam, max_nbins: int, hist_method: str,
            axis_name: Optional[str], has_missing: bool = True):
-    """Histogram + split enumeration for (up to) two sibling nodes."""
+    """Histogram + split enumeration for (up to) two sibling nodes.
+    ``bins_t`` is the loop-invariant [F, n] transpose, computed once per
+    tree so every per-split program skips the relayout."""
     rel = jnp.where(positions == id0, 0,
                     jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
-    hist = build_hist(bins, gpair, rel, 2, max_nbins, method=hist_method)
+    hist = build_hist(bins, gpair, rel, 2, max_nbins, method=hist_method,
+                      bins_t=bins_t)
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return evaluate_splits(hist, parent_sums, n_real_bins, param,
@@ -87,6 +90,34 @@ def _apply1(bins, positions, nid, feat, sbin, dleft, is_cat, words,
 def _root_sum(gpair, axis_name: Optional[str]):
     s = jnp.sum(gpair, axis=0)
     return jax.lax.psum(s, axis_name) if axis_name is not None else s
+
+
+def col_masks(param: TrainParam, seed: int, F: int):
+    """bytree mask + per-depth / per-node draw helpers (reference
+    ColumnSampler nesting, src/common/random.h:123; same seed on every
+    rank like the broadcast at updater_gpu_hist.cu:786-789). Shared by the
+    scalar and vector-leaf lossguide growers."""
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+
+    def draw(base: np.ndarray, frac: float) -> np.ndarray:
+        if frac >= 1.0:
+            return base
+        idx = np.nonzero(base)[0]
+        k = max(1, int(math.ceil(frac * len(idx))))
+        keep = rng.choice(idx, size=min(k, len(idx)), replace=False)
+        out = np.zeros(F, bool)
+        out[keep] = True
+        return out
+
+    tree_mask = draw(np.ones(F, bool), param.colsample_bytree)
+    level_cache = {}
+
+    def node_mask(depth: int) -> np.ndarray:
+        if depth not in level_cache:
+            level_cache[depth] = draw(tree_mask, param.colsample_bylevel)
+        return draw(level_cache[depth], param.colsample_bynode)
+
+    return node_mask
 
 
 class LossguideGrower:
@@ -151,7 +182,8 @@ class LossguideGrower:
             sharded_eval = jax.jit(jax.shard_map(
                 ev, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
-                          P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P()),
+                          P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(),
+                          P(None, DATA_AXIS)),
                 out_specs=P()))
             sharded_apply = jax.jit(jax.shard_map(
                 _apply1, mesh=self.mesh,
@@ -171,31 +203,7 @@ class LossguideGrower:
 
     # ------------------------------------------------------------- sampling
     def _col_masks(self, seed: int, F: int):
-        """bytree mask + per-depth / per-node draw helpers (reference
-        ColumnSampler nesting, src/common/random.h:123; same seed on every
-        rank like the broadcast at updater_gpu_hist.cu:786-789)."""
-        rng = np.random.RandomState(seed & 0x7FFFFFFF)
-
-        def draw(base: np.ndarray, frac: float) -> np.ndarray:
-            if frac >= 1.0:
-                return base
-            idx = np.nonzero(base)[0]
-            k = max(1, int(math.ceil(frac * len(idx))))
-            keep = rng.choice(idx, size=min(k, len(idx)), replace=False)
-            out = np.zeros(F, bool)
-            out[keep] = True
-            return out
-
-        tree_mask = draw(np.ones(F, bool), self.param.colsample_bytree)
-        level_cache = {}
-
-        def node_mask(depth: int) -> np.ndarray:
-            if depth not in level_cache:
-                level_cache[depth] = draw(tree_mask,
-                                          self.param.colsample_bylevel)
-            return draw(level_cache[depth], self.param.colsample_bynode)
-
-        return node_mask
+        return col_masks(self.param, seed, F)
 
     def _allowed(self, path: np.ndarray) -> np.ndarray:
         """Interaction-constraint feature mask for a node with feature-path
@@ -241,6 +249,8 @@ class LossguideGrower:
             else None
 
         positions = jnp.zeros((n,), jnp.int32)
+        bins_t = (None if getattr(bins, "is_paged", False)
+                  else bins.T)  # loop-invariant relayout, once per tree
         gh[0] = np.asarray(root_sum_fn(gpair), np.float64)
         n_nodes = 1
         n_leaves = 1
@@ -276,7 +286,7 @@ class LossguideGrower:
                         jnp.asarray(np.asarray([upper[i0],
                                                 upper[i1 if i1 >= 0 else 0]],
                                                np.float32)),
-                        n_real_bins)
+                        n_real_bins, bins_t)
             gain = np.asarray(res.gain)
             feat = np.asarray(res.feature)
             rbin = np.asarray(res.bin)

@@ -350,6 +350,14 @@ class MultiTargetGrower:
             raise NotImplementedError(
                 "multi_output_tree supports grow_policy=depthwise only; "
                 "use MultiLossguideGrower via grow_policy=lossguide")
+        if param.max_leaves > 0 and mesh is not None and any(
+                d.process_index != jax.process_index()
+                for d in mesh.devices.flat):
+            # the truncation schedule runs host-side over [n] positions; a
+            # multi-process mesh's positions span non-addressable devices
+            raise NotImplementedError(
+                "multi_output_tree max_leaves is not supported on "
+                "multi-process meshes yet")
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
@@ -456,15 +464,16 @@ class MultiTargetGrower:
 
 
 def _eval2_multi(bins, gpair, positions, id0, id1, parent_sums, fmask,
-                 n_real_bins, *, param: TrainParam, max_nbins: int,
+                 n_real_bins, bins_t, *, param: TrainParam, max_nbins: int,
                  hist_method: str, has_missing: bool = True):
     """Histogram + shared-split enumeration for (up to) two sibling nodes
     over the K-channel gradient — the vector-leaf mirror of
-    ``lossguide._eval2``."""
+    ``lossguide._eval2`` (``bins_t``: loop-invariant transpose, once per
+    tree)."""
     rel = jnp.where(positions == id0, 0,
                     jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
     hist = build_hist_multi(bins, gpair, rel, 2, max_nbins,
-                            method=hist_method)
+                            method=hist_method, bins_t=bins_t)
     return evaluate_splits_multi(hist, parent_sums, n_real_bins, param,
                                  feature_mask=fmask,
                                  has_missing=has_missing)
@@ -515,7 +524,7 @@ class MultiLossguideGrower:
              n_real_bins: jnp.ndarray, key: jax.Array):
         import heapq
 
-        from .lossguide import LossguideGrower, LossguideGrown
+        from .lossguide import LossguideGrown, col_masks
 
         param = self.param
         n, F = bins.shape
@@ -528,7 +537,7 @@ class MultiLossguideGrower:
             seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1])
         except (TypeError, ValueError):
             seed = int(np.asarray(key).ravel()[-1])
-        node_mask = LossguideGrower._col_masks(self, seed, F)
+        node_mask = col_masks(param, seed, F)
 
         sf = np.full(cap, -1, np.int32)
         sb = np.zeros(cap, np.int32)
@@ -542,6 +551,7 @@ class MultiLossguideGrower:
         _EPS = 1e-6
 
         positions = jnp.zeros((n,), jnp.int32)
+        bins_t = bins.T  # loop-invariant relayout, once per tree
         gh[0] = np.asarray(root_sum_fn(gpair), np.float64)
         n_nodes = 1
         n_leaves = 1
@@ -562,7 +572,8 @@ class MultiLossguideGrower:
             psums = np.stack([gh[i0], gh[i1] if i1 >= 0
                               else np.zeros((K, 2))]).astype(np.float32)
             res = eval2(bins, gpair, positions, np.int32(i0), np.int32(i1),
-                        jnp.asarray(psums), jnp.asarray(fm), n_real_bins)
+                        jnp.asarray(psums), jnp.asarray(fm), n_real_bins,
+                        bins_t)
             gain = np.asarray(res.gain)
             feat = np.asarray(res.feature)
             rbin = np.asarray(res.bin)

@@ -392,7 +392,8 @@ class PagedLossguideGrower(LossguideGrower):
         apply1_jit = jax.jit(_apply1)
 
         def eval2(paged, gpair, positions, i0, i1, psums, fmask,
-                  node_lower, node_upper, n_real_bins):
+                  node_lower, node_upper, n_real_bins, bins_t=None):
+            del bins_t  # pages transpose per-page inside build_hist
             def rel_of(s, e):
                 return jnp.where(
                     positions[s:e] == i0, 0,
