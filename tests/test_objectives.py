@@ -230,7 +230,8 @@ def test_lambdarank_device_matches_host_loop(obj, exp_gain, monkeypatch):
     ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
     w = rng.rand(len(sizes)).astype(np.float32) + 0.5
     info = _Info(y, group_ptr=ptr, weights=w)
-    params = {"ndcg_exp_gain": str(exp_gain).lower()}
+    params = {"ndcg_exp_gain": str(exp_gain).lower(),
+              "lambdarank_pair_method": "topk"}
 
     monkeypatch.delenv("XTPU_RANK_HOST", raising=False)
     o_dev = get_objective(obj, dict(params))
@@ -251,7 +252,8 @@ def test_lambdarank_device_respects_num_pair_cap(monkeypatch):
     s = rng.randn(40).astype(np.float32)
     ptr = np.asarray([0, 18, 40], np.int64)
     info = _Info(y, group_ptr=ptr)
-    params = {"lambdarank_num_pair_per_sample": 4}
+    params = {"lambdarank_num_pair_per_sample": 4,
+              "lambdarank_pair_method": "topk"}
     monkeypatch.delenv("XTPU_RANK_HOST", raising=False)
     g_dev = np.asarray(get_objective("rank:ndcg", dict(params))
                        .get_gradient(s, info))
@@ -259,3 +261,72 @@ def test_lambdarank_device_respects_num_pair_cap(monkeypatch):
     g_host = np.asarray(get_objective("rank:ndcg", dict(params))
                         .get_gradient(s, info))
     np.testing.assert_allclose(g_dev, g_host, rtol=2e-4, atol=1e-6)
+
+
+def test_lambdarank_mean_device_gradient_properties(monkeypatch):
+    """The sampled-pair (mean, the reference default) device gradient:
+    per-group gradients sum to zero (pair antisymmetry), hessians are
+    positive where pairs exist, and the estimator's EXPECTATION matches
+    the host sampler's (same out-of-bucket uniform distribution; averaged
+    over many iterations the two means converge)."""
+    from xgboost_tpu.objective import get_objective
+
+    rng = np.random.RandomState(11)
+    sizes = [5, 12, 3, 20]
+    y = np.concatenate([rng.randint(0, 4, s) for s in sizes]).astype(
+        np.float32)
+    s = rng.randn(len(y)).astype(np.float32)
+    ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    info = _Info(y, group_ptr=ptr)
+    params = {"lambdarank_pair_method": "mean",
+              "lambdarank_num_pair_per_sample": 2, "seed": 3}
+
+    monkeypatch.delenv("XTPU_RANK_HOST", raising=False)
+    o_dev = get_objective("rank:ndcg", dict(params))
+    g0 = np.asarray(o_dev.get_gradient(s, info, 0))
+    for a, b in zip(ptr[:-1], ptr[1:]):
+        np.testing.assert_allclose(g0[a:b, 0, 0].sum(), 0.0, atol=1e-4)
+        assert (g0[a:b, 0, 1] >= 0).all()
+
+    n_iters = 300
+    acc_dev = np.zeros((len(y), 2))
+    for it in range(n_iters):
+        acc_dev += np.asarray(o_dev.get_gradient(s, info, it))[:, 0, :]
+    monkeypatch.setenv("XTPU_RANK_HOST", "1")
+    o_host = get_objective("rank:ndcg", dict(params))
+    acc_host = np.zeros((len(y), 2))
+    for it in range(n_iters):
+        acc_host += np.asarray(o_host.get_gradient(s, info, it))[:, 0, :]
+    scale = np.abs(acc_host).max()
+    np.testing.assert_allclose(acc_dev / n_iters, acc_host / n_iters,
+                               atol=0.15 * scale / n_iters)
+
+
+def test_lambdarank_default_method_is_mean():
+    """Reference parity: lambdarank_pair_method defaults to 'mean'
+    (doc/parameter.rst:489). Pinned BEHAVIOURALLY: mean resamples rivals
+    per iteration, so the default gradient must vary with the iteration
+    number while an explicit topk gradient must not."""
+    from xgboost_tpu.objective import get_objective
+
+    rng = np.random.RandomState(15)
+    y = rng.randint(0, 4, 30).astype(np.float32)
+    s = rng.randn(30).astype(np.float32)
+    info = _Info(y, group_ptr=np.asarray([0, 30], np.int64))
+    o_def = get_objective("rank:ndcg", {})
+    g0 = np.asarray(o_def.get_gradient(s, info, 0))
+    g1 = np.asarray(o_def.get_gradient(s, info, 1))
+    assert not np.array_equal(g0, g1)  # stochastic -> mean sampling
+    o_topk = get_objective("rank:ndcg", {"lambdarank_pair_method": "topk"})
+    t0 = np.asarray(o_topk.get_gradient(s, info, 0))
+    t1 = np.asarray(o_topk.get_gradient(s, info, 1))
+    np.testing.assert_array_equal(t0, t1)  # deterministic -> topk
+    # and the default config still trains (device mean path)
+    X, y, qid = _make_ltr(seed=12)
+    dm = xgb.DMatrix(X, label=y, qid=qid)
+    res = {}
+    xgb.train({"objective": "rank:ndcg", "max_depth": 3, "eta": 0.3,
+               "eval_metric": ["ndcg@5"]}, dm, 25,
+              evals=[(dm, "train")], evals_result=res, verbose_eval=False)
+    hist = res["train"]["ndcg@5"]
+    assert hist[-1] > hist[0]

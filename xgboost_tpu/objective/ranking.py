@@ -8,15 +8,17 @@ label_i > label_j get the RankNet lambda scaled by the metric delta
 ``mean`` (k random pairs per doc) and ``topk`` (pairs anchored at the current
 top-k).
 
-The default ``topk`` mode is RNG-free (anchors × all docs, deterministic),
-so for rank:ndcg / rank:pairwise the gradient runs ON DEVICE: groups pad
+Both pair modes run ON DEVICE for rank:ndcg / rank:pairwise: groups pad
 into a ``[G, L]`` matrix (L = longest group), per-group ranks come from two
-stable argsorts, and the full pair interaction is a ``[G, L, L]`` VPU
-tensor, chunked over groups by ``lax.map`` to bound memory — the TPU
-answer to the reference's per-pair CUDA kernels. At 200k x 136 with 800
-groups this is ~100x the per-group numpy loop, which remains the fallback
-for ``mean`` sampling and rank:map (MAP's prefix statistics are cheap host
-work) and can be forced with XTPU_RANK_HOST=1.
+stable argsorts, and the pair interaction is a ``[G, L, L]`` VPU tensor
+for ``topk`` (anchors × all docs, deterministic) or a sampled ``[G, L, k]``
+tensor for ``mean`` (the default, matching the reference: k uniform
+out-of-label-bucket rivals per doc, ``lambdarank_obj.h:231-275``), chunked
+over groups by ``lax.map`` to bound memory — the TPU answer to the
+reference's per-pair CUDA kernels. At 200k x 136 with 800 groups the topk
+kernel is ~100x the per-group numpy loop, which remains the fallback for
+rank:map (MAP's prefix statistics are cheap host work) and can be forced
+with XTPU_RANK_HOST=1.
 """
 
 from __future__ import annotations
@@ -39,6 +41,21 @@ def _dcg_discount(ranks: np.ndarray) -> np.ndarray:
 
 def _gains(labels: np.ndarray, exp_gain: bool) -> np.ndarray:
     return (np.power(2.0, labels) - 1.0) if exp_gain else labels
+
+
+def _bucket_stats(y: np.ndarray):
+    """Label-bucket statistics for mean pair sampling — the ONE encoding of
+    the reference's rival mapping (``lambdarank_obj.h`` MakePairs): returns
+    (order, n_lefts, n_geq) where ``order`` lists doc indices in stable
+    label-descending order, ``n_lefts[i]`` counts docs with a strictly
+    higher label than doc i, and ``n_geq[i]`` counts at-least-as-high.
+    Shared by the host sampler and the device layout so the two stay
+    bitwise-consistent."""
+    order = np.argsort(-y, kind="stable")
+    ys = y[order]
+    n_lefts = np.searchsorted(-ys, -y, side="left")
+    n_geq = np.searchsorted(-ys, -y, side="right")
+    return order, n_lefts, n_geq
 
 
 @functools.partial(
@@ -104,6 +121,96 @@ def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
     return jnp.stack([g, h], axis=-1)[:, None, :]    # [n, 1, 2] f32
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "exp_gain", "pairwise", "chunk", "n_groups"))
+def _lambda_grad_device_mean(s, y, qidx, slot, sizes, w_row, key,
+                             y_order_g, n_lefts_g, n_geq_g, *,
+                             k, L, exp_gain, pairwise, chunk, n_groups):
+    """Sampled-pair (``mean``) LambdaRank lambdas over padded [G, L] groups.
+
+    The reference's distribution (``lambdarank_obj.h:231-275``): each doc
+    draws ``k`` rivals uniformly from outside its label bucket (different
+    label, same group), so every pair is valid by construction. The pair
+    tensor is [C, L, k] — with the default k=1 this is L times lighter
+    than the all-pairs kernel, letting much larger group chunks ride one
+    ``lax.map`` step. RNG stream: fold_in(key, chunk_index); the reference
+    seeds per (iter, group), so distributional — not bitwise — parity."""
+    Gp = -(-n_groups // chunk) * chunk
+    s_pad = jnp.full((Gp, L), -jnp.inf, jnp.float32).at[qidx, slot].set(s)
+    y_pad = jnp.zeros((Gp, L), jnp.float32).at[qidx, slot].set(y)
+    valid = jnp.zeros((Gp, L), bool).at[qidx, slot].set(True)
+    sz = jnp.zeros((Gp,), jnp.int32).at[:n_groups].set(
+        sizes.astype(jnp.int32))
+    disc = 1.0 / jnp.log2(jnp.arange(L, dtype=jnp.float32) + 2.0)
+
+    def gains_j(v):
+        return (jnp.exp2(v) - 1.0) if exp_gain else v
+
+    # pad the precomputed per-group bucket statistics to [Gp, L]
+    op = jnp.zeros((Gp, L), jnp.int32).at[:n_groups].set(y_order_g)
+    nl_p = jnp.zeros((Gp, L), jnp.int32).at[:n_groups].set(n_lefts_g)
+    ng_p = jnp.zeros((Gp, L), jnp.int32).at[:n_groups].set(n_geq_g)
+    C = chunk
+    iota_c = jnp.arange(C, dtype=jnp.int32)
+
+    def one_chunk(args):
+        sp, yp, vp, szc, y_order, n_lefts, n_geq, ck = args
+        order = jnp.argsort(-sp, axis=1, stable=True)
+        rank_of = jnp.argsort(order, axis=1, stable=True)
+        y_desc = -jnp.sort(-yp, axis=1)
+        idcg = jnp.sum(gains_j(y_desc) * disc[None, :], axis=1)
+        inv_idcg = jnp.where(idcg > 0, 1.0 / idcg, 0.0)
+        gv = gains_j(yp)
+        dv = disc[rank_of]                          # [C, L]
+        yi = yp[:, :, None]
+        n_riv = n_lefts + (szc[:, None] - n_geq)
+        u = (jax.random.uniform(ck, (C, L, k))
+             * n_riv[:, :, None].astype(jnp.float32)).astype(jnp.int32)
+        u = jnp.clip(u, 0, jnp.maximum(n_riv[:, :, None] - 1, 0))
+        ridx = jnp.where(u < n_lefts[:, :, None], u,
+                         u - n_lefts[:, :, None] + n_geq[:, :, None])
+        rival = jnp.take_along_axis(
+            y_order, ridx.reshape(C, L * k), axis=1).reshape(C, L, k)
+        pair_ok = vp[:, :, None] & (n_riv[:, :, None] > 0)
+
+        take = lambda a: jnp.take_along_axis(
+            a, rival.reshape(C, L * k), axis=1).reshape(C, L, k)
+        yj = take(yp)
+        sj = take(sp)
+        gj2 = take(gv)
+        dj2 = take(dv)
+        a_is_i = yi > yj
+        if pairwise:
+            delta = jnp.float32(1.0)
+        else:
+            delta = jnp.abs((gv[:, :, None] - gj2)
+                            * (dv[:, :, None] - dj2)) * inv_idcg[:, None,
+                                                                 None]
+        sij = jnp.where(a_is_i, sp[:, :, None] - sj, sj - sp[:, :, None])
+        p = 1.0 / (1.0 + jnp.exp(jnp.clip(sij, -50.0, 50.0)))
+        lam = jnp.where(pair_ok, -p * delta, 0.0)
+        hes = jnp.where(pair_ok,
+                        jnp.maximum(p * (1.0 - p) * delta, 1e-16), 0.0)
+        g = jnp.where(a_is_i, lam, -lam).sum(axis=2)
+        h = hes.sum(axis=2)
+        g_r = jnp.where(a_is_i, -lam, lam).reshape(C, L * k)
+        h_r = hes.reshape(C, L * k)
+        riv_flat = rival.reshape(C, L * k)
+        g = g.at[iota_c[:, None], riv_flat].add(g_r)
+        h = h.at[iota_c[:, None], riv_flat].add(h_r)
+        return g, h
+
+    cs = lambda a: a.reshape(Gp // chunk, chunk, *a.shape[1:])
+    keys = jax.random.split(key, Gp // chunk)
+    g_pad, h_pad = jax.lax.map(
+        one_chunk, (cs(s_pad), cs(y_pad), cs(valid), cs(sz), cs(op),
+                    cs(nl_p), cs(ng_p), keys))
+    g = g_pad.reshape(Gp, L)[qidx, slot] * w_row
+    h = h_pad.reshape(Gp, L)[qidx, slot] * w_row
+    return jnp.stack([g, h], axis=-1)[:, None, :]    # [n, 1, 2] f32
+
+
 class _LambdaRankBase(Objective):
     info = ObjInfo("ranking")
     default_metric = "ndcg"
@@ -112,16 +219,26 @@ class _LambdaRankBase(Objective):
                rank_of: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Candidate (i, j) index arrays within one group."""
         n = len(y)
-        method = str(self.params.get("lambdarank_pair_method", "topk"))
+        method = str(self.params.get("lambdarank_pair_method", "mean"))
         k = int(self.params.get("lambdarank_num_pair_per_sample",
                                 n if method == "topk" else 1))
         if method == "mean":
-            i = np.repeat(np.arange(n), k)
-            j = rng.randint(0, n, size=n * k)
-        else:  # topk: anchor docs currently ranked < k against everything
-            anchors = np.nonzero(rank_of < min(k, n))[0]
-            i = np.repeat(anchors, n)
-            j = np.tile(np.arange(n), len(anchors))
+            # reference MakePairs mean branch (lambdarank_obj.h:231-275):
+            # each doc draws k rivals uniformly from OUTSIDE its label
+            # bucket — every sampled pair is label-distinct by construction
+            order_y, n_lefts, n_geq = _bucket_stats(y)
+            n_riv = n_lefts + (n - n_geq)
+            u = (rng.random_sample((n, k)) * n_riv[:, None]).astype(np.int64)
+            ridx = np.where(u < n_lefts[:, None], u,
+                            u - n_lefts[:, None] + n_geq[:, None])
+            keep = np.repeat(n_riv > 0, k)
+            i = np.repeat(np.arange(n), k)[keep]
+            j = order_y[np.clip(ridx, 0, n - 1)].ravel()[keep]
+            return i, j
+        # topk: anchor docs currently ranked < k against everything
+        anchors = np.nonzero(rank_of < min(k, n))[0]
+        i = np.repeat(anchors, n)
+        j = np.tile(np.arange(n), len(anchors))
         keep = y[i] != y[j]
         return i[keep], j[keep]
 
@@ -154,7 +271,7 @@ class _LambdaRankBase(Objective):
         else:
             w_row = np.ones(int(ptr[-1]), np.float32)
         layout = dict(
-            G=G, L=L,
+            G=G, L=L, _ptr=ptr, _y_np=y_np,
             qidx=jnp.asarray(qidx), slot=jnp.asarray(slot),
             sizes=jnp.asarray(sizes, jnp.int32),
             w_row=jnp.asarray(w_row),
@@ -164,18 +281,59 @@ class _LambdaRankBase(Objective):
         self._dev_layout = (key, layout)
         return layout
 
+    @staticmethod
+    def _mean_stats(layout):
+        """Lazily attach the mean-sampling bucket statistics to a cached
+        layout (static per dataset, only the mean path ever reads them;
+        topk / rank:map callers skip the O(G) build and the 3 [G, L]
+        device arrays entirely)."""
+        if "y_order" not in layout:
+            ptr, y_np = layout["_ptr"], layout["_y_np"]
+            G, L = layout["G"], layout["L"]
+            y_order = np.zeros((G, L), np.int32)
+            n_lefts = np.zeros((G, L), np.int32)
+            n_geq = np.zeros((G, L), np.int32)
+            for g in range(G):
+                a, b = int(ptr[g]), int(ptr[g + 1])
+                og, nl, ng = _bucket_stats(y_np[a:b])
+                y_order[g, : b - a] = og
+                n_lefts[g, : b - a] = nl
+                n_geq[g, : b - a] = ng
+            layout["y_order"] = jnp.asarray(y_order)
+            layout["n_lefts"] = jnp.asarray(n_lefts)
+            layout["n_geq"] = jnp.asarray(n_geq)
+        return layout
+
     def get_gradient(self, preds, info, iteration=0):
         if info.group_ptr is None:
             raise ValueError(f"{self.name} requires query group information "
                              "(set group= or qid= on the DMatrix)")
-        method = str(self.params.get("lambdarank_pair_method", "topk"))
+        method = str(self.params.get("lambdarank_pair_method", "mean"))
         exp_gain = str(self.params.get("ndcg_exp_gain", "true")).lower() \
             not in ("false", "0")
-        if (method == "topk" and self.name in ("rank:ndcg", "rank:pairwise")
+        if (self.name in ("rank:ndcg", "rank:pairwise")
+                and method in ("topk", "mean")
                 and os.environ.get("XTPU_RANK_HOST") != "1"):
             lay = self._device_layout(info)
             n = lay["y"].shape[0]
             s = jnp.asarray(preds, jnp.float32).reshape(-1)[:n]
+            if method == "mean":
+                lay = self._mean_stats(lay)
+                k = int(self.params.get(
+                    "lambdarank_num_pair_per_sample", 1))
+                key = jax.random.fold_in(
+                    jax.random.key(int(self.params.get("seed", 0))),
+                    iteration)
+                # the sampled-pair tensor is [C, L, k] — rechunk by its
+                # own footprint, not the all-pairs [C, L, L] budget
+                chunk = max(1, min(lay["G"],
+                                   (1 << 24) // max(lay["L"] * k, 1)))
+                return _lambda_grad_device_mean(
+                    s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
+                    lay["w_row"], key, lay["y_order"], lay["n_lefts"],
+                    lay["n_geq"], k=k, L=lay["L"], exp_gain=exp_gain,
+                    pairwise=self.name == "rank:pairwise", chunk=chunk,
+                    n_groups=lay["G"])
             kcap = int(self.params.get("lambdarank_num_pair_per_sample", 0))
             return _lambda_grad_device(
                 s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
