@@ -214,7 +214,7 @@ def test_weighted_training():
     assert hi < lo  # heavily weighted rows fit better
 
 
-@pytest.mark.parametrize("obj", ["rank:ndcg", "rank:pairwise"])
+@pytest.mark.parametrize("obj", ["rank:ndcg", "rank:pairwise", "rank:map"])
 @pytest.mark.parametrize("exp_gain", [True, False])
 def test_lambdarank_device_matches_host_loop(obj, exp_gain, monkeypatch):
     # the padded [G, L, L] device gradient must reproduce the per-group
@@ -224,7 +224,8 @@ def test_lambdarank_device_matches_host_loop(obj, exp_gain, monkeypatch):
 
     rng = np.random.RandomState(3)
     sizes = [1, 7, 30, 2, 13]
-    y = np.concatenate([rng.randint(0, 4, s) for s in sizes]).astype(
+    hi = 2 if obj == "rank:map" else 4   # map requires binary relevance
+    y = np.concatenate([rng.randint(0, hi, s) for s in sizes]).astype(
         np.float32)
     s = rng.randn(len(y)).astype(np.float32)
     ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
@@ -330,3 +331,12 @@ def test_lambdarank_default_method_is_mean():
               evals=[(dm, "train")], evals_result=res, verbose_eval=False)
     hist = res["train"]["ndcg@5"]
     assert hist[-1] > hist[0]
+
+
+def test_rank_map_rejects_graded_labels():
+    """Reference IsBinaryRel (ranking_utils.h:362): |dAP| needs 0/1."""
+    y = np.asarray([0.0, 2.0, 1.0, 3.0], np.float32)
+    info = _Info(y, group_ptr=np.asarray([0, 4], np.int64))
+    with pytest.raises(ValueError, match="binary"):
+        get_objective("rank:map", {}).get_gradient(
+            np.zeros(4, np.float32), info)

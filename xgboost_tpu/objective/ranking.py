@@ -8,17 +8,17 @@ label_i > label_j get the RankNet lambda scaled by the metric delta
 ``mean`` (k random pairs per doc) and ``topk`` (pairs anchored at the current
 top-k).
 
-Both pair modes run ON DEVICE for rank:ndcg / rank:pairwise: groups pad
-into a ``[G, L]`` matrix (L = longest group), per-group ranks come from two
+All three objectives run ON DEVICE in both pair modes: groups pad into a
+``[G, L]`` matrix (L = longest group), per-group ranks come from two
 stable argsorts, and the pair interaction is a ``[G, L, L]`` VPU tensor
 for ``topk`` (anchors × all docs, deterministic) or a sampled ``[G, L, k]``
 tensor for ``mean`` (the default, matching the reference: k uniform
 out-of-label-bucket rivals per doc, ``lambdarank_obj.h:231-275``), chunked
 over groups by ``lax.map`` to bound memory — the TPU answer to the
-reference's per-pair CUDA kernels. At 200k x 136 with 800 groups the topk
-kernel is ~100x the per-group numpy loop, which remains the fallback for
-rank:map (MAP's prefix statistics are cheap host work) and can be forced
-with XTPU_RANK_HOST=1.
+reference's per-pair CUDA kernels. MAP's |ΔAP| rides the same kernels via
+rank-ordered prefix statistics (``_map_prefix``/``_map_delta_dev``). The
+per-group numpy loop remains as the oracle/fallback, forced with
+XTPU_RANK_HOST=1.
 """
 
 from __future__ import annotations
@@ -58,12 +58,81 @@ def _bucket_stats(y: np.ndarray):
     return order, n_lefts, n_geq
 
 
+def _map_prefix(yp, vp, order, L):
+    """Per-group MAP prefix statistics in current rank order: C_k (relevant
+    count in top k+1), T0 (shifted cumsum of rel/(rank+1); T0[k] == T[k-1],
+    T0[0] == 0) and R (total relevant, floored at 1) — the device mirror of
+    the host ``LambdaRankMAP._delta`` precomputation."""
+    yb = ((yp > 0) & vp).astype(jnp.float32)
+    rel_rank = jnp.take_along_axis(yb, order, axis=1)          # [C, L]
+    Ck = jnp.cumsum(rel_rank, axis=1)
+    T = jnp.cumsum(rel_rank / (jnp.arange(L, dtype=jnp.float32) + 1.0),
+                   axis=1)
+    T0 = jnp.concatenate([jnp.zeros((T.shape[0], 1), T.dtype), T], axis=1)
+    R = jnp.maximum(Ck[:, -1], 1.0)
+    return Ck, T0, R
+
+
+def _ranknet_dev(s_i, s_j, a_is_i, delta, mask):
+    """RankNet lambda/hessian from oriented score differences — the ONE
+    device encoding of the clip bound (50) and hessian floor (1e-16) the
+    host loop uses, shared by the topk and mean kernels."""
+    sij = jnp.where(a_is_i, s_i - s_j, s_j - s_i)
+    p = 1.0 / (1.0 + jnp.exp(jnp.clip(sij, -50.0, 50.0)))
+    lam = jnp.where(mask, -p * delta, 0.0)
+    hes = jnp.where(mask, jnp.maximum(p * (1.0 - p) * delta, 1e-16), 0.0)
+    return lam, hes
+
+
+def _delta_dev(objective, *, yp, vp, order, L, gv, dv, inv_idcg,
+               gj, dj, rank_i, rank_j, a_is_i):
+    """Metric delta for a gathered pair tensor — shared 3-way dispatch
+    (|ΔNDCG| / |ΔMAP| / 1) for both device kernels; ``gj``/``dj``/
+    ``rank_j`` arrive already gathered/broadcast to the pair shape."""
+    if objective == "pairwise":
+        return jnp.float32(1.0)
+    if objective == "map":
+        Ck, T0, R = _map_prefix(yp, vp, order, L)
+        return _map_delta_dev(rank_i, rank_j, a_is_i, Ck, T0, R)
+    return jnp.abs((gv[:, :, None] - gj) * (dv[:, :, None] - dj)) \
+        * inv_idcg[:, None, None]
+
+
+def _map_delta_dev(rank_i, rank_j, a_is_i, Ck, T0, R):
+    """|ΔAP| for swapping the (oriented-relevant) doc i with doc j — the
+    device mirror of the host formula (binary relevance)."""
+    r_rel = jnp.where(a_is_i, rank_i, rank_j)
+    r_irr = jnp.where(a_is_i, rank_j, rank_i)
+    u = jnp.minimum(r_rel, r_irr)
+    v = jnp.maximum(r_rel, r_irr)
+    shape = u.shape
+    Cc = shape[0]
+
+    def g2(A, idx):
+        return jnp.take_along_axis(A, idx.reshape(Cc, -1),
+                                   axis=1).reshape(shape)
+
+    Cu = g2(Ck, u)
+    Cv = g2(Ck, v)
+    Tv1 = g2(T0, v)        # T[v-1]
+    Tu = g2(T0, u + 1)     # T[u]
+    Tu1 = g2(T0, u)        # T[u-1]
+    uf = u.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    d_down = Cv / (vf + 1.0) - Cu / (uf + 1.0) - (Tv1 - Tu)
+    d_up = (Cu + 1.0) / (uf + 1.0) - Cv / (vf + 1.0) + (Tv1 - Tu1)
+    rel_above = r_rel < r_irr
+    extra = (1,) * (len(shape) - 1)
+    return jnp.abs(jnp.where(rel_above, d_down, d_up)) \
+        / R.reshape((Cc,) + extra)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("kcap", "L", "exp_gain", "pairwise", "chunk",
+    static_argnames=("kcap", "L", "exp_gain", "objective", "chunk",
                      "n_groups"))
 def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
-                        kcap, L, exp_gain, pairwise, chunk, n_groups):
+                        kcap, L, exp_gain, objective, chunk, n_groups):
     """All-pairs LambdaRank lambdas over padded [G, L] groups.
 
     Exactly the host loop's math (orientation, RankNet clip, 1e-16 hessian
@@ -96,18 +165,15 @@ def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
         mask = (vp[:, :, None] & vp[:, None, :] & (yi != yj)
                 & (rank_of < kcc[:, None])[:, :, None])
         a_is_i = yi > yj
-        if pairwise:
-            delta = jnp.float32(1.0)
-        else:
-            delta = jnp.abs((gv[:, :, None] - gv[:, None, :])
-                            * (dv[:, :, None] - dv[:, None, :])
-                            ) * inv_idcg[:, None, None]
-        sij = jnp.where(a_is_i, sp[:, :, None] - sp[:, None, :],
-                        sp[:, None, :] - sp[:, :, None])
-        p = 1.0 / (1.0 + jnp.exp(jnp.clip(sij, -50.0, 50.0)))
-        lam = jnp.where(mask, -p * delta, 0.0)
-        hes = jnp.where(mask, jnp.maximum(p * (1.0 - p) * delta, 1e-16),
-                        0.0)
+        Cn = rank_of.shape[0]
+        delta = _delta_dev(
+            objective, yp=yp, vp=vp, order=order, L=L, gv=gv, dv=dv,
+            inv_idcg=inv_idcg, gj=gv[:, None, :], dj=dv[:, None, :],
+            rank_i=jnp.broadcast_to(rank_of[:, :, None], (Cn, L, L)),
+            rank_j=jnp.broadcast_to(rank_of[:, None, :], (Cn, L, L)),
+            a_is_i=a_is_i)
+        lam, hes = _ranknet_dev(sp[:, :, None], sp[:, None, :], a_is_i,
+                                delta, mask)
         g = (jnp.where(a_is_i, lam, -lam).sum(axis=2)
              + jnp.where(a_is_i, -lam, lam).sum(axis=1))
         h = hes.sum(axis=2) + hes.sum(axis=1)
@@ -123,10 +189,11 @@ def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "L", "exp_gain", "pairwise", "chunk", "n_groups"))
+    static_argnames=("k", "L", "exp_gain", "objective", "chunk",
+                     "n_groups"))
 def _lambda_grad_device_mean(s, y, qidx, slot, sizes, w_row, key,
                              y_order_g, n_lefts_g, n_geq_g, *,
-                             k, L, exp_gain, pairwise, chunk, n_groups):
+                             k, L, exp_gain, objective, chunk, n_groups):
     """Sampled-pair (``mean``) LambdaRank lambdas over padded [G, L] groups.
 
     The reference's distribution (``lambdarank_obj.h:231-275``): each doc
@@ -134,8 +201,9 @@ def _lambda_grad_device_mean(s, y, qidx, slot, sizes, w_row, key,
     label, same group), so every pair is valid by construction. The pair
     tensor is [C, L, k] — with the default k=1 this is L times lighter
     than the all-pairs kernel, letting much larger group chunks ride one
-    ``lax.map`` step. RNG stream: fold_in(key, chunk_index); the reference
-    seeds per (iter, group), so distributional — not bitwise — parity."""
+    ``lax.map`` step. RNG stream: jax.random.split(key, n_chunks)
+    (chunk-size-dependent); the reference seeds per (iter, group), so
+    distributional — not bitwise — parity."""
     Gp = -(-n_groups // chunk) * chunk
     s_pad = jnp.full((Gp, L), -jnp.inf, jnp.float32).at[qidx, slot].set(s)
     y_pad = jnp.zeros((Gp, L), jnp.float32).at[qidx, slot].set(y)
@@ -181,17 +249,13 @@ def _lambda_grad_device_mean(s, y, qidx, slot, sizes, w_row, key,
         gj2 = take(gv)
         dj2 = take(dv)
         a_is_i = yi > yj
-        if pairwise:
-            delta = jnp.float32(1.0)
-        else:
-            delta = jnp.abs((gv[:, :, None] - gj2)
-                            * (dv[:, :, None] - dj2)) * inv_idcg[:, None,
-                                                                 None]
-        sij = jnp.where(a_is_i, sp[:, :, None] - sj, sj - sp[:, :, None])
-        p = 1.0 / (1.0 + jnp.exp(jnp.clip(sij, -50.0, 50.0)))
-        lam = jnp.where(pair_ok, -p * delta, 0.0)
-        hes = jnp.where(pair_ok,
-                        jnp.maximum(p * (1.0 - p) * delta, 1e-16), 0.0)
+        delta = _delta_dev(
+            objective, yp=yp, vp=vp, order=order, L=L, gv=gv, dv=dv,
+            inv_idcg=inv_idcg, gj=gj2, dj=dj2,
+            rank_i=jnp.broadcast_to(rank_of[:, :, None],
+                                    rank_of.shape + (rival.shape[2],)),
+            rank_j=take(rank_of), a_is_i=a_is_i)
+        lam, hes = _ranknet_dev(sp[:, :, None], sj, a_is_i, delta, pair_ok)
         g = jnp.where(a_is_i, lam, -lam).sum(axis=2)
         h = hes.sum(axis=2)
         g_r = jnp.where(a_is_i, -lam, lam).reshape(C, L * k)
@@ -290,15 +354,28 @@ class _LambdaRankBase(Objective):
         if "y_order" not in layout:
             ptr, y_np = layout["_ptr"], layout["_y_np"]
             G, L = layout["G"], layout["L"]
-            y_order = np.zeros((G, L), np.int32)
+            # padded [G, L] label matrix; pads sort last / count nowhere
+            sizes = np.diff(ptr)
+            qidx = np.repeat(np.arange(G), sizes)
+            slot = np.arange(int(ptr[-1])) - np.repeat(ptr[:-1], sizes)
+            y_pad = np.zeros((G, L), np.float32)
+            vpad = np.zeros((G, L), bool)
+            y_pad[qidx, slot] = y_np
+            vpad[qidx, slot] = True
+            y_order = np.argsort(
+                np.where(vpad, -y_pad, np.inf), axis=1,
+                kind="stable").astype(np.int32)
+            # vectorized bucket counts, chunked so [c, L, L] stays bounded
             n_lefts = np.zeros((G, L), np.int32)
             n_geq = np.zeros((G, L), np.int32)
-            for g in range(G):
-                a, b = int(ptr[g]), int(ptr[g + 1])
-                og, nl, ng = _bucket_stats(y_np[a:b])
-                y_order[g, : b - a] = og
-                n_lefts[g, : b - a] = nl
-                n_geq[g, : b - a] = ng
+            c = max(1, (1 << 24) // max(L * L, 1))
+            for a in range(0, G, c):
+                b = min(G, a + c)
+                yq = y_pad[a:b, None, :]
+                vq = vpad[a:b, None, :]
+                yi = y_pad[a:b, :, None]
+                n_lefts[a:b] = (vq & (yq > yi)).sum(axis=2)
+                n_geq[a:b] = (vq & (yq >= yi)).sum(axis=2)
             layout["y_order"] = jnp.asarray(y_order)
             layout["n_lefts"] = jnp.asarray(n_lefts)
             layout["n_geq"] = jnp.asarray(n_geq)
@@ -308,10 +385,19 @@ class _LambdaRankBase(Objective):
         if info.group_ptr is None:
             raise ValueError(f"{self.name} requires query group information "
                              "(set group= or qid= on the DMatrix)")
+        if self.name == "rank:map":
+            # reference IsBinaryRel (ranking_utils.h:362-377): |dAP| is
+            # only defined for binary relevance — graded labels would
+            # silently optimise a distorted objective
+            lab = np.asarray(info.labels).reshape(-1)
+            if not np.all((lab == 0) | (lab == 1)):
+                raise ValueError(
+                    "rank:map requires binary relevance labels (0/1); "
+                    "got graded labels — use rank:ndcg instead")
         method = str(self.params.get("lambdarank_pair_method", "mean"))
         exp_gain = str(self.params.get("ndcg_exp_gain", "true")).lower() \
             not in ("false", "0")
-        if (self.name in ("rank:ndcg", "rank:pairwise")
+        if (self.name in ("rank:ndcg", "rank:pairwise", "rank:map")
                 and method in ("topk", "mean")
                 and os.environ.get("XTPU_RANK_HOST") != "1"):
             lay = self._device_layout(info)
@@ -332,13 +418,13 @@ class _LambdaRankBase(Objective):
                     s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
                     lay["w_row"], key, lay["y_order"], lay["n_lefts"],
                     lay["n_geq"], k=k, L=lay["L"], exp_gain=exp_gain,
-                    pairwise=self.name == "rank:pairwise", chunk=chunk,
+                    objective=self.name.split(":")[1], chunk=chunk,
                     n_groups=lay["G"])
             kcap = int(self.params.get("lambdarank_num_pair_per_sample", 0))
             return _lambda_grad_device(
                 s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
                 lay["w_row"], kcap=kcap, L=lay["L"], exp_gain=exp_gain,
-                pairwise=self.name == "rank:pairwise", chunk=lay["chunk"],
+                objective=self.name.split(":")[1], chunk=lay["chunk"],
                 n_groups=lay["G"])
         y_all = np.asarray(info.labels, dtype=np.float64).reshape(-1)
         s_all = np.asarray(preds, dtype=np.float64).reshape(-1)[: len(y_all)]
