@@ -264,7 +264,8 @@ def test_lambdarank_device_respects_num_pair_cap(monkeypatch):
     np.testing.assert_allclose(g_dev, g_host, rtol=2e-4, atol=1e-6)
 
 
-def test_lambdarank_mean_device_gradient_properties(monkeypatch):
+@pytest.mark.parametrize("obj", ["rank:ndcg", "rank:map"])
+def test_lambdarank_mean_device_gradient_properties(obj, monkeypatch):
     """The sampled-pair (mean, the reference default) device gradient:
     per-group gradients sum to zero (pair antisymmetry), hessians are
     positive where pairs exist, and the estimator's EXPECTATION matches
@@ -274,7 +275,8 @@ def test_lambdarank_mean_device_gradient_properties(monkeypatch):
 
     rng = np.random.RandomState(11)
     sizes = [5, 12, 3, 20]
-    y = np.concatenate([rng.randint(0, 4, s) for s in sizes]).astype(
+    hi = 2 if obj == "rank:map" else 4   # map requires binary relevance
+    y = np.concatenate([rng.randint(0, hi, s) for s in sizes]).astype(
         np.float32)
     s = rng.randn(len(y)).astype(np.float32)
     ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
@@ -283,7 +285,7 @@ def test_lambdarank_mean_device_gradient_properties(monkeypatch):
               "lambdarank_num_pair_per_sample": 2, "seed": 3}
 
     monkeypatch.delenv("XTPU_RANK_HOST", raising=False)
-    o_dev = get_objective("rank:ndcg", dict(params))
+    o_dev = get_objective(obj, dict(params))
     g0 = np.asarray(o_dev.get_gradient(s, info, 0))
     for a, b in zip(ptr[:-1], ptr[1:]):
         np.testing.assert_allclose(g0[a:b, 0, 0].sum(), 0.0, atol=1e-4)
@@ -294,7 +296,7 @@ def test_lambdarank_mean_device_gradient_properties(monkeypatch):
     for it in range(n_iters):
         acc_dev += np.asarray(o_dev.get_gradient(s, info, it))[:, 0, :]
     monkeypatch.setenv("XTPU_RANK_HOST", "1")
-    o_host = get_objective("rank:ndcg", dict(params))
+    o_host = get_objective(obj, dict(params))
     acc_host = np.zeros((len(y), 2))
     for it in range(n_iters):
         acc_host += np.asarray(o_host.get_gradient(s, info, it))[:, 0, :]

@@ -44,13 +44,15 @@ def _gains(labels: np.ndarray, exp_gain: bool) -> np.ndarray:
 
 
 def _bucket_stats(y: np.ndarray):
-    """Label-bucket statistics for mean pair sampling — the ONE encoding of
-    the reference's rival mapping (``lambdarank_obj.h`` MakePairs): returns
-    (order, n_lefts, n_geq) where ``order`` lists doc indices in stable
+    """Label-bucket statistics for mean pair sampling (the reference's
+    rival mapping, ``lambdarank_obj.h`` MakePairs): returns (order,
+    n_lefts, n_geq) where ``order`` lists doc indices in stable
     label-descending order, ``n_lefts[i]`` counts docs with a strictly
     higher label than doc i, and ``n_geq[i]`` counts at-least-as-high.
-    Shared by the host sampler and the device layout so the two stay
-    bitwise-consistent."""
+    INVARIANT shared with the vectorized device build (``_mean_stats``):
+    both define the mapping purely by these tie-insensitive counts plus a
+    stable label-descending argsort, so the host and device samplers draw
+    from the same rival distribution."""
     order = np.argsort(-y, kind="stable")
     ys = y[order]
     n_lefts = np.searchsorted(-ys, -y, side="left")
@@ -348,9 +350,10 @@ class _LambdaRankBase(Objective):
     @staticmethod
     def _mean_stats(layout):
         """Lazily attach the mean-sampling bucket statistics to a cached
-        layout (static per dataset, only the mean path ever reads them;
-        topk / rank:map callers skip the O(G) build and the 3 [G, L]
-        device arrays entirely)."""
+        layout (static per dataset; only mean-mode gradients read them —
+        topk callers never pay the build or the 3 [G, L] device arrays).
+        Same count-based encoding as the host ``_bucket_stats`` (see its
+        invariant note), built vectorized over chunked [c, L, L] counts."""
         if "y_order" not in layout:
             ptr, y_np = layout["_ptr"], layout["_y_np"]
             G, L = layout["G"], layout["L"]
@@ -388,12 +391,16 @@ class _LambdaRankBase(Objective):
         if self.name == "rank:map":
             # reference IsBinaryRel (ranking_utils.h:362-377): |dAP| is
             # only defined for binary relevance — graded labels would
-            # silently optimise a distorted objective
+            # silently optimise a distorted objective. Validated once per
+            # label content (labels are static across boosting rounds).
             lab = np.asarray(info.labels).reshape(-1)
-            if not np.all((lab == 0) | (lab == 1)):
-                raise ValueError(
-                    "rank:map requires binary relevance labels (0/1); "
-                    "got graded labels — use rank:ndcg instead")
+            key = (lab.shape[0], hash(lab.tobytes()))
+            if getattr(self, "_map_labels_ok", None) != key:
+                if not np.all((lab == 0) | (lab == 1)):
+                    raise ValueError(
+                        "rank:map requires binary relevance labels (0/1); "
+                        "got graded labels — use rank:ndcg instead")
+                self._map_labels_ok = key
         method = str(self.params.get("lambdarank_pair_method", "mean"))
         exp_gain = str(self.params.get("ndcg_exp_gain", "true")).lower() \
             not in ("false", "0")
