@@ -404,8 +404,10 @@ class _LambdaRankBase(Objective):
         method = str(self.params.get("lambdarank_pair_method", "mean"))
         exp_gain = str(self.params.get("ndcg_exp_gain", "true")).lower() \
             not in ("false", "0")
+        unbiased = str(self.params.get(
+            "lambdarank_unbiased", "false")).lower() in ("1", "true")
         if (self.name in ("rank:ndcg", "rank:pairwise", "rank:map")
-                and method in ("topk", "mean")
+                and method in ("topk", "mean") and not unbiased
                 and os.environ.get("XTPU_RANK_HOST") != "1"):
             lay = self._device_layout(info)
             n = lay["y"].shape[0]
@@ -440,6 +442,28 @@ class _LambdaRankBase(Objective):
                                     + iteration)
         g = np.zeros_like(s_all)
         h = np.zeros_like(s_all)
+        if unbiased:
+            # Unbiased LambdaMART (Hu et al.; reference lambdarank_obj.cc:
+            # 42-89 + lambdarank_obj.h:121-141): position-bias ratios
+            # ti+/tj- indexed by the doc's position in the INPUT list (the
+            # presentation order of the click log), updated per iteration
+            # from the accumulated pair costs. k positions tracked:
+            # truncation level under topk, else min(max group, 32).
+            sizes = np.diff(ptr)
+            max_gs = int(sizes.max(initial=1))
+            if method == "topk":
+                kpos = int(self.params.get(
+                    "lambdarank_num_pair_per_sample", max_gs))
+            else:
+                kpos = min(max_gs, 32)
+            kpos = max(kpos, 1)
+            if (getattr(self, "_ti_plus", None) is None
+                    or len(self._ti_plus) != kpos):
+                self._ti_plus = np.ones(kpos, np.float64)
+                self._tj_minus = np.ones(kpos, np.float64)
+            li_acc = np.zeros(kpos, np.float64)
+            lj_acc = np.zeros(kpos, np.float64)
+            eps64 = np.finfo(np.float64).eps
         for q in range(len(ptr) - 1):
             a, b = int(ptr[q]), int(ptr[q + 1])
             n = b - a
@@ -464,10 +488,38 @@ class _LambdaRankBase(Objective):
             p = 1.0 / (1.0 + np.exp(np.clip(sij, -50, 50)))  # RankNet
             lam = -p * delta
             hes = np.maximum(p * (1.0 - p) * delta, 1e-16)
+            if unbiased:
+                # debias: divide by ti+[pos_high] * tj-[pos_low]; track the
+                # per-position pair costs for the post-iteration update
+                # (eq. 30/31; cost = log(1/(1-sigmoid)) * delta with
+                # sigmoid = 1 - p). A position whose bias estimate hits
+                # exactly 0 stays excluded — faithful to the reference's
+                # Eps64 gate (lambdarank_obj.h:133-140).
+                tpi = self._ti_plus[np.minimum(i, kpos - 1)]
+                tmj = self._tj_minus[np.minimum(j, kpos - 1)]
+                ok = ((i < kpos) & (j < kpos)
+                      & (tpi >= eps64) & (tmj >= eps64))
+                scale = np.where(ok, tpi * tmj, 1.0)
+                lam = lam / scale
+                hes = hes / scale
+                cost = np.log(1.0 / np.maximum(p, 1e-300)) * delta
+                np.add.at(li_acc, i[ok], cost[ok] / tmj[ok])
+                np.add.at(lj_acc, j[ok], cost[ok] / tpi[ok])
             np.add.at(g, a + i, lam)
             np.add.at(g, a + j, -lam)
             np.add.at(h, a + i, hes)
             np.add.at(h, a + j, hes)
+        if unbiased:
+            # reference LambdaRankUpdatePositionBias: normalize to
+            # position 0 and damp by 1 / (1 + lambdarank_bias_norm)
+            reg = 1.0 / (1.0 + float(self.params.get(
+                "lambdarank_bias_norm", 1.0)))
+            if li_acc[0] >= eps64:
+                self._ti_plus = np.power(li_acc / max(li_acc[0], eps64),
+                                         reg)
+            if lj_acc[0] >= eps64:
+                self._tj_minus = np.power(lj_acc / max(lj_acc[0], eps64),
+                                          reg)
         if info.weights is not None:
             # ranking weights are per query
             w = np.asarray(info.weights, dtype=np.float64)
@@ -482,6 +534,34 @@ class _LambdaRankBase(Objective):
 
     def init_estimation(self, info):
         return np.zeros(1, dtype=np.float32)
+
+    # -- serialization: the learned position-bias state must survive
+    # save/load and training continuation (the reference persists ti+/tj-
+    # in the objective config, lambdarank_obj.cc SaveConfig)
+    def to_json(self):
+        out = super().to_json()
+        if getattr(self, "_ti_plus", None) is not None:
+            out["ti_plus"] = [float(v) for v in self._ti_plus]
+            out["tj_minus"] = [float(v) for v in self._tj_minus]
+        return out
+
+    def configure(self, params):
+        params = dict(params)
+        tp = params.pop("ti_plus", None)
+        tm = params.pop("tj_minus", None)
+        super().configure(params)
+
+        def _vec(v):
+            if isinstance(v, str):
+                import json as _json
+
+                v = _json.loads(v)
+            return np.asarray(v, np.float64)
+
+        if tp is not None:
+            self._ti_plus = _vec(tp)
+        if tm is not None:
+            self._tj_minus = _vec(tm)
 
 
 @OBJECTIVES.register("rank:ndcg")
