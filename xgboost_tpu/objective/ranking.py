@@ -19,6 +19,17 @@ reference's per-pair CUDA kernels. MAP's |ΔAP| rides the same kernels via
 rank-ordered prefix statistics (``_map_prefix``/``_map_delta_dev``). The
 per-group numpy loop remains as the oracle/fallback, forced with
 XTPU_RANK_HOST=1.
+
+Deliberate recipe difference from the reference implementation: lambdas
+follow the LambdaMART paper exactly (lam = -sigmoid * |delta|), WITHOUT
+the reference's extra empirical scalings — the per-pair
+``delta /= (|s_i - s_j| + 0.01)`` division, the hessian x2, and the
+per-group ``log2(1+sum_lambda)/sum_lambda`` normalization borrowed from
+LightGBM (``lambdarank_obj.h:112-126``, ``lambdarank_obj.cc:178-231``).
+Measured quality at the MSLR shape matches (BASELINE.md #3); the paper
+recipe keeps the device kernels branch-free. ``lambdarank_unbiased``
+implements the same eq. 30/31 bias estimation the reference does, on the
+host path.
 """
 
 from __future__ import annotations
