@@ -273,3 +273,21 @@ def test_scanned_class_grow_respects_max_leaves(monkeypatch):
     monkeypatch.setenv("XTPU_SCAN_CLASSES", "0")
     b2 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
     assert bytes(b1.save_raw("json")) == bytes(b2.save_raw("json"))
+
+
+def test_predict_returns_mutable_numpy_after_device_stump():
+    """The device-resident base score must materialize to host numpy at
+    predict/serialize time: predictions stay mutable np.ndarray, and the
+    materialized value is cached (no repeated device pulls)."""
+    X, y = make_classification(500, 6)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, dm, 3,
+                    verbose_eval=False)
+    p = bst.predict(dm, output_margin=True)
+    assert isinstance(p, np.ndarray)
+    p[0] = 0.0  # mutable
+    assert isinstance(bst.base_margin_, np.ndarray)  # cached host-side
+    import json
+    bs = json.loads(bytes(bst.save_raw("json")))
+    assert np.isfinite(bs["learner"]["learner_model_param"]
+                       ["base_score"]).all()

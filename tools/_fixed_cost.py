@@ -1,0 +1,28 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax
+import xgboost_tpu as xgb
+
+rng = np.random.RandomState(42)
+X = rng.randn(1_000_000, 28).astype(np.float32)
+w = rng.randn(28).astype(np.float32)
+y = (X @ w + rng.randn(1_000_000).astype(np.float32) > 0).astype(np.float32)
+PARAMS = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1, "max_bin": 256}
+dm = xgb.DMatrix(X, label=y)
+xgb.train(PARAMS, dm, 2, verbose_eval=False)  # warm
+
+def t(rounds, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        bst = xgb.train(PARAMS, dm, rounds, verbose_eval=False)
+        st = list(bst._caches.values())[0]
+        jax.block_until_ready(st["margin"]); float(np.asarray(st["margin"][0, 0]))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+t20, t84 = t(20), t(84)
+slope = (t84 - t20) / 64
+fixed = t20 - 20 * slope
+print(f"t20={t20:.3f}s t84={t84:.3f}s slope={slope*1e3:.1f} ms/round fixed={fixed*1e3:.0f} ms")
+print(f"driver-metric now: {20/t20:.2f} r/s; if fixed were 0: {20/(20*slope):.2f} r/s")

@@ -1,0 +1,21 @@
+import os, sys, time, cProfile, pstats
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax
+import xgboost_tpu as xgb
+
+rng = np.random.RandomState(42)
+X = rng.randn(1_000_000, 28).astype(np.float32)
+w = rng.randn(28).astype(np.float32)
+y = (X @ w + rng.randn(1_000_000).astype(np.float32) > 0).astype(np.float32)
+PARAMS = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1, "max_bin": 256}
+dm = xgb.DMatrix(X, label=y)
+xgb.train(PARAMS, dm, 20, verbose_eval=False)  # warm everything
+
+pr = cProfile.Profile()
+pr.enable()
+bst = xgb.train(PARAMS, dm, 20, verbose_eval=False)
+st = list(bst._caches.values())[0]
+jax.block_until_ready(st["margin"]); float(np.asarray(st["margin"][0, 0]))
+pr.disable()
+stats = pstats.Stats(pr)
+stats.sort_stats("cumulative").print_stats(18)

@@ -185,7 +185,9 @@ class Booster:
         self.feature_types: Optional[List[str]] = None
         self.obj = None
         self.gbm: Optional[GBTree] = None
-        self.base_margin_: Optional[np.ndarray] = None  # [K] margin space
+        # [K] margin space; device-resident (jnp) right after a
+        # single-process stump fit, np after _base_np() materializes it
+        self.base_margin_: Optional[Any] = None
         self._configured = False
         self._monitor = Monitor("Booster")
         # fast-path cache: (state_dict, obj_params, grower, labels, weights,
@@ -317,10 +319,23 @@ class Booster:
 
                     est = np.asarray(apply_with_labels(_est), np.float32)
                 else:
-                    est = _est()
+                    from .objective.base import Objective
+                    from .parallel import collective
+
+                    if (not collective.is_distributed()
+                            and type(self.obj).init_estimation
+                            is Objective.init_estimation):
+                        # device-resident stump: no host pull on the
+                        # train() critical path (the value materializes
+                        # lazily at first predict/serialize)
+                        est = self.obj.init_estimation_device(dtrain.info)
+                    else:
+                        est = _est()
                 if est.shape[0] != n_groups:
-                    est = np.full(n_groups, est[0] if est.size else 0.0,
-                                  np.float32)
+                    est = np.full(
+                        n_groups,
+                        float(np.asarray(est)[0]) if est.size else 0.0,
+                        np.float32)
                 self.base_margin_ = est
             else:
                 self.base_margin_ = np.zeros(n_groups, dtype=np.float32)
@@ -417,6 +432,16 @@ class Booster:
             raise ValueError(f"unknown booster: {name}")
         return GBTree(self.tree_param, n_groups, **kwargs)
 
+    def _base_np(self) -> np.ndarray:
+        """base_margin_ as a HOST array — the device-resident stump
+        estimate materializes here once (first predict/serialize) and is
+        cached back, so later calls pay no device pull."""
+        if self.base_margin_ is None:
+            return np.zeros(self.n_groups, np.float32)
+        if not isinstance(self.base_margin_, np.ndarray):
+            self.base_margin_ = np.asarray(self.base_margin_, np.float32)
+        return self.base_margin_
+
     @property
     def n_groups(self) -> int:
         return self.gbm.n_groups if self.gbm is not None else 1
@@ -456,8 +481,7 @@ class Booster:
                     raise NotImplementedError(
                         "tree_method=exact is not supported with sharded "
                         "multi-process ingestion; use hist or approx")
-                base = (self.base_margin_ if self.base_margin_ is not None
-                        else np.zeros(self.n_groups, np.float32))
+                base = self._base_np()
                 return self._store_cache(
                     key, None if tm == "approx" else dm.global_binned(),
                     dm.make_margin(base, self.n_groups), True, dm,
@@ -501,14 +525,17 @@ class Booster:
                              "n_valid": n_valid}
         return self._caches[key]
 
-    def _broadcast_base_margin(self, dm: DMatrix, n: int) -> np.ndarray:
+    def _broadcast_base_margin(self, dm: DMatrix, n: int):
         """Per-row starting margin [n, n_groups]: the DMatrix's base_margin
-        when set, else the learner's global base score."""
+        when set, else the learner's global base score. The global-score
+        case broadcasts ON DEVICE — a host [n, K] materialization plus its
+        H2D upload cost ~100+ ms of every train() start at 1M rows over
+        the tunnel, for an array that is a constant."""
         if dm.info.base_margin is not None:
             bm = np.asarray(dm.info.base_margin, np.float32).reshape(n, -1)
             return np.broadcast_to(bm, (n, self.n_groups)).copy()
-        return np.broadcast_to(self.base_margin_[None, :],
-                               (n, self.n_groups)).copy()
+        base = jnp.asarray(self.base_margin_, jnp.float32).reshape(-1)
+        return jnp.broadcast_to(base[None, :], (n, self.n_groups))
 
     def _make_sharded_train_state(self, key: int, dm: DMatrix,
                                   binned) -> Dict[str, Any]:
@@ -586,10 +613,10 @@ class Booster:
             label_lower_bound=lb, label_upper_bound=ub,
             feature_names=info.feature_names, feature_types=info.feature_types)
 
-        bm = self._broadcast_base_margin(dm, n)
+        bm = jnp.asarray(self._broadcast_base_margin(dm, n))
         if pad:
-            bm = np.concatenate([bm, np.zeros((pad, self.n_groups),
-                                              np.float32)])
+            bm = jnp.concatenate([bm, jnp.zeros((pad, self.n_groups),
+                                                jnp.float32)])
         margin = jax.device_put(bm, sharding)
         return self._store_cache(key, binned_p, margin, True, dm, info_p, n)
 
@@ -1037,8 +1064,7 @@ class Booster:
                     "column split")
             lo_t, hi_t = self.gbm._tree_range(iteration_range)
             margin = self._vertical_margin_delta(data, lo_t, hi_t)
-            base = (self.base_margin_ if self.base_margin_ is not None
-                    else np.zeros(self.n_groups, np.float32))
+            base = self._base_np()
             if data.info.base_margin is not None:
                 margin = margin + np.asarray(
                     data.info.base_margin, np.float32).reshape(
@@ -1051,8 +1077,7 @@ class Booster:
                 out = out[:, 0]
             return out
         X = data.values()
-        base = self.base_margin_ if self.base_margin_ is not None else \
-            np.zeros(self.n_groups, np.float32)
+        base = self._base_np()
         m, pos, trees = self.gbm.predict_margin(
             X, np.zeros(self.n_groups, np.float32),
             iteration_range=iteration_range)
@@ -1082,8 +1107,7 @@ class Booster:
 
         X = np.asarray(data.values(), np.float32)
         n, F = X.shape
-        base = (self.base_margin_ if self.base_margin_ is not None
-                else np.zeros(self.n_groups, np.float32))
+        base = self._base_np()
         if isinstance(self.gbm, GBLinear):
             if interactions:
                 raise ValueError(
@@ -1311,7 +1335,7 @@ class Booster:
                 "feature_names": self.feature_names or [],
                 "feature_types": self.feature_types or [],
                 "learner_model_param": {
-                    "base_score": (self.base_margin_.tolist()
+                    "base_score": (self._base_np().tolist()
                                    if self.base_margin_ is not None else [0.0]),
                     "num_class": int(self.learner_params.get("num_class", 0)),
                     "num_target": self.n_groups,

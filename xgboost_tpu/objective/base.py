@@ -69,6 +69,15 @@ class Objective:
     def prob_to_margin(self, prob: np.ndarray) -> np.ndarray:
         return prob
 
+    def _stump_sums(self, info):
+        """Zero-margin gradient sums on device -> ([k] g, [k] h). The
+        [n, k, 2] gradient never leaves the device (materialising it
+        host-side costs an n-proportional transfer)."""
+        k = self.n_targets(info)
+        zero = jnp.zeros((len(info.labels), k), dtype=jnp.float32)
+        gpair = jnp.asarray(self.get_gradient(zero, info))
+        return gpair[..., 0].sum(axis=0), gpair[..., 1].sum(axis=0)
+
     def init_estimation(self, info) -> np.ndarray:
         """One Newton step from margin 0 (reference fit_stump,
         ``src/tree/fit_stump.cc:25-58`` — gradient sums cross workers via
@@ -76,17 +85,22 @@ class Objective:
         from its row shard)."""
         from ..parallel.collective import global_sum
 
-        k = self.n_targets(info)
-        zero = jnp.zeros((len(info.labels), k), dtype=jnp.float32)
-        # reduce ON DEVICE and pull only the [2, k] sums: materialising the
-        # [n, k, 2] gradient host-side costs an n-proportional transfer
-        # (~0.9 s of every train() call at 1M rows over the tunnel)
-        gpair = jnp.asarray(self.get_gradient(zero, info))
-        sums = gpair.sum(axis=0).T                       # one pass -> [2, k]
+        g_d, h_d = self._stump_sums(info)
+        sums = np.stack([np.asarray(g_d), np.asarray(h_d)])  # [2, k] pull
         row_split = getattr(info, "data_split_mode", "row") == "row"
-        gh = global_sum(np.asarray(sums), row_split=row_split)
+        gh = global_sum(sums, row_split=row_split)
         g, h = gh[0], gh[1]
         return np.where(h <= 0, 0.0, -g / np.maximum(h, 1e-10)).astype(np.float32)
+
+    def init_estimation_device(self, info) -> jnp.ndarray:
+        """Single-process stump fit that STAYS on device: same sums as
+        ``init_estimation`` (shared ``_stump_sums``) without the host pull
+        — that device_get serializes every ``train()`` start on a ~160 ms
+        tunnel round trip. Only valid when no communicator is active (the
+        distributed path must cross hosts via ``global_sum``)."""
+        g, h = self._stump_sums(info)
+        return jnp.where(h <= 0, 0.0,
+                         -g / jnp.maximum(h, 1e-10)).astype(jnp.float32)
 
     def to_json(self) -> Dict[str, Any]:
         return {"name": self.name, **{k: str(v) for k, v in self.params.items()}}
