@@ -95,15 +95,12 @@ def test_paged_eval_and_continuation(paged_qdm):
 
 
 def test_paged_unsupported_configs_raise():
-    # device meshes stay resident-only (multi-host paging covers scale-out)
+    # column split stays resident-only (meshes work: test_paged_mesh.py)
     from xgboost_tpu.tree.paged import PagedGrower
     from xgboost_tpu.tree.param import TrainParam
 
-    class FakeMesh:
-        pass
-
     with pytest.raises(NotImplementedError):
-        PagedGrower(TrainParam(), 64, None, mesh=FakeMesh())
+        PagedGrower(TrainParam(), 64, None, split_mode="col")
 
 
 def test_paged_multi_output_tree_matches_resident(tmp_path, monkeypatch):

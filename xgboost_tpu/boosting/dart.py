@@ -131,7 +131,11 @@ class Dart(GBTree):
         binned = state.get("binned")
         if binned is not None:
             if getattr(binned, "is_paged", False):
-                return self._margin_binned_paged(pred, binned, zero)
+                from .gbtree import match_rows
+
+                return match_rows(
+                    self._margin_binned_paged(pred, binned, zero),
+                    state["base"].shape[0])
             m, _ = pred.margin_binned(binned.bins, binned.missing_bin, zero)
             return m
         m, _ = pred.margin(np.asarray(state["dm"].values()), zero)

@@ -137,6 +137,19 @@ def _fetch_packed(dicts: list) -> list:
     return out
 
 
+def match_rows(m, n: int):
+    """Fit a per-row margin/delta to ``n`` rows: mesh-padded train states
+    carry more rows than the logical matrix (pad rows have weight 0), so
+    deltas computed at one padding meet caches built at another — trim, or
+    extend with zeros (pad rows' values are never read)."""
+    if not hasattr(m, "shape") or m.shape[0] == n:
+        return m
+    if m.shape[0] > n:
+        return m[:n]
+    return jnp.concatenate(
+        [m, jnp.zeros((n - m.shape[0],) + m.shape[1:], m.dtype)])
+
+
 class _PendingTree:
     """A grown tree whose per-node arrays still live on device.
 
@@ -496,8 +509,10 @@ class GBTree:
     def compute_margin(self, state: dict) -> jnp.ndarray:
         """Full margin recompute for a cache state (non-incremental path)."""
         if state.get("binned") is not None:
-            delta = self.margin_delta_binned(state["binned"], 0,
-                                             len(self.trees))
+            delta = match_rows(
+                self.margin_delta_binned(state["binned"], 0,
+                                         len(self.trees)),
+                state["base"].shape[0])
             return state["base"] + delta
         m, _, _ = self.predict_margin(state["dm"].X,
                                       np.zeros(self.n_groups, np.float32))
@@ -559,6 +574,22 @@ class GBTree:
 
     def _margin_binned_paged(self, pred, binned, base):
         """Streamed prediction over a PagedBinnedMatrix's pages."""
+        if self.mesh is not None:
+            # mesh pages interleave shards: page row d*p_loc+j is shard d's
+            # local row s_loc+j, so restore original (shard-major) row
+            # order by stacking pages along the local axis, then trim the
+            # mesh-layout pad rows — callers against a PADDED train cache
+            # re-extend through match_rows
+            from ..context import DATA_AXIS
+
+            world = self.mesh.shape.get(DATA_AXIS, 1)
+            outs = []
+            for _, page in binned.pages_sharded(self.mesh, DATA_AXIS):
+                m, _ = pred.margin_binned(page, binned.missing_bin, base)
+                outs.append(m.reshape(world, -1, m.shape[-1]))
+            full = jnp.concatenate(outs, axis=1).reshape(
+                -1, outs[0].shape[-1])
+            return full[:binned.n_rows]
         outs = []
         for _, _, page in binned.pages():
             m, _ = pred.margin_binned(page, binned.missing_bin, base)

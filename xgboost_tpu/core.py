@@ -494,10 +494,6 @@ class Booster:
             elif is_train:
                 binned = dm.binned(self.tree_param.max_bin)
                 if self.ctx.mesh is not None:
-                    if getattr(binned, "is_paged", False):
-                        raise NotImplementedError(
-                            "external-memory (paged) training does not "
-                            "support meshes yet")
                     return self._make_sharded_train_state(key, dm, binned)
             else:
                 train_cuts = None
@@ -557,7 +553,12 @@ class Booster:
         mesh = self.ctx.mesh
         world = mesh.shape.get(DATA_AXIS, 1)
         n = dm.num_row()
+        paged = getattr(binned, "is_paged", False)
         if self.learner_params.get("data_split_mode", "row") == "col":
+            if paged:
+                raise NotImplementedError(
+                    "external-memory (paged) training supports "
+                    "data_split_mode=row only")
             bins_np = np.asarray(binned.bins)
             F = bins_np.shape[1]
             f_pad = ((F + world - 1) // world) * world - F
@@ -576,21 +577,29 @@ class Booster:
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
             return self._store_cache(key, binned_p, margin, True, dm,
                                      dm.info, n)
-        n_pad = ((n + world - 1) // world) * world
-        pad = n_pad - n
-        bins_np = np.asarray(binned.bins)
-        if pad:
-            # any in-range bin works: padded rows carry zero gradient, so
-            # they never contribute to histograms or leaf sums
-            fill = np.full((pad, bins_np.shape[1]),
-                           min(binned.missing_bin, binned.max_nbins - 1),
-                           dtype=bins_np.dtype)
-            bins_np = np.concatenate([bins_np, fill], axis=0)
         sharding = jsh.NamedSharding(mesh, jsh.PartitionSpec(DATA_AXIS, None))
-        bins_dev = jax.device_put(bins_np, sharding)
-        binned_p = BinnedMatrix(bins=bins_dev, cuts=binned.cuts,
-                                max_nbins=binned.max_nbins,
-                                has_missing=binned.has_missing)
+        if paged:
+            # mesh x external memory: bins STAY host-resident and stream
+            # per-shard (PagedBinnedMatrix.pages_sharded); only the per-row
+            # vectors pad to the page-aligned mesh layout and shard
+            n_pad = binned.mesh_layout(world)[0]
+            pad = n_pad - n
+            binned_p = binned
+        else:
+            n_pad = ((n + world - 1) // world) * world
+            pad = n_pad - n
+            bins_np = np.asarray(binned.bins)
+            if pad:
+                # any in-range bin works: padded rows carry zero gradient,
+                # so they never contribute to histograms or leaf sums
+                fill = np.full((pad, bins_np.shape[1]),
+                               min(binned.missing_bin, binned.max_nbins - 1),
+                               dtype=bins_np.dtype)
+                bins_np = np.concatenate([bins_np, fill], axis=0)
+            bins_dev = jax.device_put(bins_np, sharding)
+            binned_p = BinnedMatrix(bins=bins_dev, cuts=binned.cuts,
+                                    max_nbins=binned.max_nbins,
+                                    has_missing=binned.has_missing)
 
         info = dm.info
         labels = info.labels if info.labels is not None else np.zeros(n)
@@ -906,8 +915,12 @@ class Booster:
             margin = state["margin"]
         elif (self.gbm.supports_margin_cache and state["binned"] is not None
               and state["n_trees"] < total):
-            margin = state["margin"] + self.gbm.margin_delta_binned(
-                state["binned"], state["n_trees"], total)
+            from .boosting.gbtree import match_rows
+
+            margin = state["margin"] + match_rows(
+                self.gbm.margin_delta_binned(
+                    state["binned"], state["n_trees"], total),
+                state["margin"].shape[0])
         else:
             margin = self.gbm.compute_margin(state)
         state["margin"] = margin
@@ -982,8 +995,12 @@ class Booster:
         elif not self.gbm.supports_margin_cache:
             state["margin"] = self.gbm.compute_margin(state)
         elif state["binned"] is not None:
-            state["margin"] = state["margin"] + self.gbm.margin_delta_binned(
-                state["binned"], state["n_trees"], total)
+            from .boosting.gbtree import match_rows
+
+            state["margin"] = state["margin"] + match_rows(
+                self.gbm.margin_delta_binned(
+                    state["binned"], state["n_trees"], total),
+                state["margin"].shape[0])
         else:
             state["margin"] = state["margin"] + self.gbm.margin_delta_raw(
                 dm.values(), state["n_trees"], total)

@@ -287,6 +287,7 @@ class PagedBinnedMatrix:
 
     def __post_init__(self) -> None:
         self._device_cache: dict = {}
+        self._mesh_cache: dict = {}
         if self.cache_budget_bytes < 0:
             import os
 
@@ -325,38 +326,108 @@ class PagedBinnedMatrix:
 
     def _fetch(self, s: int, device):
         e = min(s + self.page_rows, self.n_rows)
-        page = self._device_cache.get(s)
-        uploaded = page is None
-        if uploaded:
-            page = jax.device_put(
-                np.ascontiguousarray(self.bins_host[s:e]), device)
+        cached = self._device_cache.get(s)  # holds (e, page) ring payloads
+        uploaded = cached is None
+        page = (jax.device_put(
+            np.ascontiguousarray(self.bins_host[s:e]), device)
+            if uploaded else cached[1])
         return s, e, page, uploaded
 
-    def pages(self, device=None):
-        """(start, end, device_page): cached pages are yielded straight
-        from HBM; pages past the cache budget upload per visit with one
-        page of lookahead (the prefetch ring — ``jax.device_put`` blocks
-        over remote-device tunnels, so the upload of page k+1 rides on a
-        worker thread while the consumer computes on page k)."""
+    def _ring(self, starts, fetch, cache, page_bytes):
+        """The shared prefetch ring: cached pages yield straight from HBM;
+        pages past the cache budget upload per visit with one page of
+        lookahead (``jax.device_put`` blocks over remote-device tunnels,
+        so the upload of page k+1 rides on a worker thread while the
+        consumer computes on page k). ``fetch(start)`` returns
+        ``(key, payload, uploaded)``; uploaded pages cache under the HBM
+        budget."""
         from concurrent.futures import ThreadPoolExecutor
 
+        max_cached = (self.cache_budget_bytes // page_bytes
+                      if page_bytes else 0)
+        with ThreadPoolExecutor(1) as ex:
+            fut = ex.submit(fetch, starts[0])
+            for i in range(len(starts)):
+                key, payload, uploaded = fut.result()
+                if i + 1 < len(starts):
+                    fut = ex.submit(fetch, starts[i + 1])
+                if uploaded and len(cache) < max_cached:
+                    cache[key] = payload
+                yield key, payload
+
+    def pages(self, device=None):
+        """(start, end, device_page) triples through the prefetch ring."""
         n = self.n_rows
         if n == 0:
             return
         page_bytes = (self.page_rows * self.n_features
                       * self.bins_host.dtype.itemsize)
-        max_cached = (self.cache_budget_bytes // page_bytes
-                      if page_bytes else 0)
-        starts = list(range(0, n, self.page_rows))
-        with ThreadPoolExecutor(1) as ex:
-            fut = ex.submit(self._fetch, starts[0], device)
-            for i in range(len(starts)):
-                s, e, page, uploaded = fut.result()
-                if i + 1 < len(starts):
-                    fut = ex.submit(self._fetch, starts[i + 1], device)
-                if uploaded and len(self._device_cache) < max_cached:
-                    self._device_cache[s] = page
-                yield s, e, page
+
+        def fetch(s):
+            s, e, page, uploaded = self._fetch(s, device)
+            return s, (e, page), uploaded
+
+        for s, (e, page) in self._ring(list(range(0, n, self.page_rows)),
+                                       fetch, self._device_cache,
+                                       page_bytes):
+            yield s, e, page
+
+    def mesh_layout(self, world: int):
+        """Row layout for mesh-sharded paging -> ``(n_pad, n_loc, p_loc)``.
+
+        Shard ``d`` of the mesh's data axis owns original rows
+        ``[d*n_loc, min((d+1)*n_loc, n))``; every page holds ``p_loc``
+        local rows per shard, and ``n_loc`` is rounded up to a multiple of
+        ``p_loc`` so EVERY page has one static shape (one compiled hist +
+        one advance program for the whole paged-mesh run, instead of a
+        full/tail pair). Per-row arrays (gradients, positions, margins)
+        pad to ``n_pad = world * n_loc``; the pad rows carry zero weight so
+        they can never contribute to a histogram or a leaf sum — the same
+        trick as the resident mesh path (core._make_sharded_train_state).
+        """
+        p_loc = max(1, -(-min(self.page_rows, max(self.n_rows, 1)) // world))
+        n_loc = max(1, -(-self.n_rows // world))
+        n_loc = -(-n_loc // p_loc) * p_loc
+        return world * n_loc, n_loc, p_loc
+
+    def pages_sharded(self, mesh, axis_name: str):
+        """Yield ``(s_loc, page)``: ``page`` is ``[world*p_loc, F]`` sharded
+        over ``axis_name`` so each device's block holds ITS shard's local
+        rows ``[s_loc, s_loc+p_loc)`` — external-memory paging under a
+        data-parallel device mesh (each chip streams its own row shard;
+        the reference feeds any updater from SparsePageDMatrix under rabit
+        row split, ``src/data/sparse_page_dmatrix.cc``, with one process
+        per GPU — here one mesh axis shard per chip). Uploads ride a
+        one-page prefetch ring and cache in HBM under the same budget as
+        the single-chip stream."""
+        import jax.sharding as jsh
+
+        world = mesh.shape[axis_name]
+        n_pad, n_loc, p_loc = self.mesh_layout(world)
+        sharding = jsh.NamedSharding(mesh,
+                                     jsh.PartitionSpec(axis_name, None))
+        F = self.n_features
+        fill = min(self.missing_bin, self.max_nbins - 1)
+        n = self.n_rows
+
+        def fetch(s_loc):
+            page = self._mesh_cache.get(s_loc)
+            uploaded = page is None
+            if uploaded:
+                block = np.full((world, p_loc, F), fill,
+                                self.bins_host.dtype)
+                for d in range(world):
+                    g0 = d * n_loc + s_loc
+                    g1 = min(g0 + p_loc, n)
+                    if g1 > g0:
+                        block[d, : g1 - g0] = self.bins_host[g0:g1]
+                page = jax.device_put(block.reshape(world * p_loc, F),
+                                      sharding)
+            return s_loc, page, uploaded
+
+        yield from self._ring(
+            list(range(0, n_loc, p_loc)), fetch, self._mesh_cache,
+            world * p_loc * F * self.bins_host.dtype.itemsize)
 
     def to_values_host(self) -> np.ndarray:
         """Representative feature values from bin ids, page-wise on host

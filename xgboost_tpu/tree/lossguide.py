@@ -201,6 +201,10 @@ class LossguideGrower:
                          sharded_gather)
         return self._fns
 
+    def _init_positions(self, n: int) -> jnp.ndarray:
+        """Root positions [n] — paged-mesh subclasses shard this."""
+        return jnp.zeros((n,), jnp.int32)
+
     # ------------------------------------------------------------- sampling
     def _col_masks(self, seed: int, F: int):
         return col_masks(self.param, seed, F)
@@ -248,7 +252,7 @@ class LossguideGrower:
         paths = np.zeros((cap, F), bool) if self.constraint_sets is not None \
             else None
 
-        positions = jnp.zeros((n,), jnp.int32)
+        positions = self._init_positions(gpair.shape[0])
         bins_t = (None if getattr(bins, "is_paged", False)
                   else bins.T)  # loop-invariant relayout, once per tree
         gh[0] = np.asarray(root_sum_fn(gpair), np.float64)

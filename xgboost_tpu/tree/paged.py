@@ -15,12 +15,18 @@ Scope: row split. Depthwise (``PagedGrower``), loss-guided
 growth all stream; categorical splits, monotone/interaction constraints
 and ``max_leaves`` work on the scalar growers (same kernels as the
 resident path; constraint bookkeeping lives on the host beside the tree
-arrays). Column split and device meshes raise ``NotImplementedError`` —
-train those on resident matrices.
-Multi-HOST external memory works: one process per host, each streaming its
-own row shard, with the per-level histogram and root sum crossing hosts
-through the communicator (reference: SparsePageDMatrix under rabit row
-split, ``src/data/sparse_page_dmatrix.cc``).
+arrays). Column split raises ``NotImplementedError`` — train that on
+resident matrices.
+Scale-out works on BOTH axes:
+- Multi-HOST: one process per host, each streaming its own row shard, with
+  the per-level histogram and root sum crossing hosts through the
+  communicator (reference: SparsePageDMatrix under rabit row split,
+  ``src/data/sparse_page_dmatrix.cc``).
+- Device MESH: pages shard across the mesh's data axis (each chip streams
+  its own row shard from host memory) and per-page kernels run under
+  ``shard_map`` with the same per-level ``psum`` as resident mesh training
+  — "larger-than-HBM x many chips", the pod-scale configuration
+  (``_MeshPageKernels``).
 """
 
 from __future__ import annotations
@@ -50,6 +56,17 @@ def _strip_hist_suffix(method: str) -> str:
     return method
 
 
+def _make_mesh_kernels(grower) -> "_MeshPageKernels":
+    """One construction path for every paged grower's mesh kernels — the
+    missing-bin sentinel derives from the grower's own (max_nbins,
+    has_missing) pair, the same formula as ``PagedBinnedMatrix.missing_bin``.
+    """
+    missing_bin = (grower.max_nbins - 1 if grower.has_missing
+                   else grower.max_nbins)
+    return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
+                            _strip_hist_suffix(grower.hist_method))
+
+
 def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
     """Sum across hosts through the CURRENT thread-local communicator —
     re-read on every call, never cached: growers persist on the booster
@@ -62,6 +79,234 @@ def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
     if not comm.is_distributed():
         return arr
     return jnp.asarray(comm.allreduce(np.asarray(arr, np.float32), op="sum"))
+
+
+class _MeshPageKernels:
+    """Per-page shard_map kernels for external-memory training under a
+    device mesh (VERDICT r3 #1): pages are ``[world*p_loc, F]`` arrays
+    sharded over the mesh data axis, per-row vectors are ``[n_pad]``
+    sharded, and every kernel slices its shard's page window out of the
+    local per-row block at a DYNAMIC offset — so the whole run compiles
+    ONE program per kernel family regardless of page count. The per-page
+    histogram ends in the same ``lax.psum`` the resident mesh grower
+    issues per level; pages stream per-shard exactly as they stream
+    per-host in the communicator path (reference: SparsePageDMatrix feeds
+    any updater under rabit row split with the async prefetch ring,
+    ``src/data/sparse_page_source.h:180-200``)."""
+
+    def __init__(self, mesh, max_nbins: int, missing_bin: int,
+                 hist_kernel: str) -> None:
+        from ..context import DATA_AXIS
+
+        self.mesh = mesh
+        self.axis = DATA_AXIS
+        self.world = mesh.shape.get(DATA_AXIS, 1)
+        self.max_nbins = max_nbins
+        self.missing_bin = missing_bin
+        self.hist_kernel = hist_kernel
+        self._fns: dict = {}
+
+    def init_positions(self, n_pad: int):
+        import jax.sharding as jsh
+
+        sharding = jsh.NamedSharding(self.mesh,
+                                     jsh.PartitionSpec(self.axis))
+        return jax.device_put(np.zeros(n_pad, np.int32), sharding)
+
+    def _cached(self, key, build):
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = build()
+        return fn
+
+    # -- histograms ----------------------------------------------------------
+    # Shard-LOCAL partial histograms accumulate across pages under a dummy
+    # leading [world] axis sharded over the mesh (each device owns its
+    # [1, ...] slice), and ONE psum per level folds them — not one
+    # collective per page. The accumulator buffer is donated page-to-page.
+    def _acc_zeros(self, shape):
+        import jax.sharding as jsh
+
+        def build():
+            sh = jsh.NamedSharding(
+                self.mesh,
+                jsh.PartitionSpec(self.axis, *([None] * (len(shape) - 1))))
+            return jax.jit(lambda: jnp.zeros(shape, jnp.float32),
+                           out_shardings=sh)
+
+        return self._cached(("zeros", shape), build)()
+
+    def _hist_over_pages(self, paged, gpair, positions, rel_fn, n_nodes,
+                         multi, key, extra):
+        """Shared page loop: ``rel_fn(pos_page, *extra)`` maps positions to
+        node slots; ``extra`` are traced scalars (level bounds / node ids).
+        """
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        K = gpair.shape[1] if multi else None
+
+        def build_acc():
+            from ..ops.histogram import build_hist_multi
+
+            builder = build_hist_multi if multi else build_hist
+            gspec = P(axis, None, None) if multi else P(axis, None)
+
+            def inner(acc, page, gp, pos, s_loc, *extra_d):
+                p = page.shape[0]
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                rel = rel_fn(pos_pg, *extra_d)
+                h = builder(page, gp_pg, rel, n_nodes, self.max_nbins,
+                            method=self.hist_kernel)
+                return acc + h[None]
+
+            acc_spec = P(axis, *([None] * (4 + int(multi))))
+            return jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(acc_spec, P(axis, None), gspec, P(axis))
+                + (P(),) * (1 + len(extra)),
+                out_specs=acc_spec), donate_argnums=0)
+
+        def build_fin():
+            acc_spec = P(axis, *([None] * (4 + int(multi))))
+            return jax.jit(jax.shard_map(
+                lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
+                in_specs=(acc_spec,), out_specs=P()))
+
+        fn = self._cached(key + ("acc", K), build_acc)
+        fin = self._cached(key + ("fin", K), build_fin)
+        shape = ((self.world, n_nodes, paged.n_features, self.max_nbins)
+                 + ((K, 2) if multi else (2,)))
+        acc = self._acc_zeros(shape)
+        for s_loc, page in paged.pages_sharded(self.mesh, axis):
+            acc = fn(acc, page, gpair, positions, jnp.int32(s_loc), *extra)
+        return fin(acc)
+
+    def level_hist(self, paged, gpair, positions, lo: int, n_level: int,
+                   n_static: int, multi: bool = False):
+        """One depthwise level histogram over the pages."""
+        def rel_fn(pos_pg, lo_d, n_level_d):
+            return jnp.where(
+                (pos_pg >= lo_d) & (pos_pg < lo_d + n_level_d),
+                pos_pg - lo_d, n_static).astype(jnp.int32)
+
+        return self._hist_over_pages(
+            paged, gpair, positions, rel_fn, n_static, multi,
+            ("hist", n_static), (jnp.int32(lo), jnp.int32(n_level)))
+
+    def pair_hist(self, paged, gpair, positions, i0, i1):
+        """Two-node (lossguide sibling pair) histogram over the pages."""
+        def rel_fn(pos_pg, i0_d, i1_d):
+            return jnp.where(pos_pg == i0_d, 0,
+                             jnp.where(pos_pg == i1_d, 1, 2)
+                             ).astype(jnp.int32)
+
+        return self._hist_over_pages(
+            paged, gpair, positions, rel_fn, 2, False, ("hist2",),
+            (jnp.int32(i0), jnp.int32(i1)))
+
+    # -- position advances ---------------------------------------------------
+    def level_advance(self, paged, positions, lo, n_level, feat, sbin,
+                      dleft, cs, cat=None):
+        """Dense (matmul) one-level advance; per-node arrays replicated."""
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        n_static = int(feat.shape[0])
+        W = None if cat is None else int(cat[1].shape[1])
+
+        def build():
+            def inner(page, pos, s_loc, lo_d, n_level_d, feat_d, sbin_d,
+                      dl_d, cs_d, *cat_args):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                rel = jnp.where(
+                    (pos_pg >= lo_d) & (pos_pg < lo_d + n_level_d),
+                    pos_pg - lo_d, n_static).astype(jnp.int32)
+                kw = ({} if not cat_args
+                      else dict(is_cat=cat_args[0], cat_words=cat_args[1]))
+                newp = advance_positions_level(
+                    page.astype(jnp.float32), pos_pg, rel, feat_d, sbin_d,
+                    dl_d, cs_d, self.missing_bin, **kw)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    pos, newp, s_loc, 0)
+
+            n_cat = 0 if W is None else 2
+            return jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis), P(), P(), P(), P(), P(),
+                          P(), P()) + (P(),) * n_cat,
+                out_specs=P(axis)))
+
+        fn = self._cached(("adv", n_static, W), build)
+        extra = () if cat is None else tuple(cat)
+        for s_loc, page in paged.pages_sharded(self.mesh, axis):
+            positions = fn(page, positions, jnp.int32(s_loc), jnp.int32(lo),
+                           jnp.int32(n_level), feat, sbin, dleft, cs, *extra)
+        return positions
+
+    def walk_advance(self, paged, positions, sf, sb, dl, isf, cat=None):
+        """Deep-level per-row gather walk; full tree arrays replicated."""
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        W = None if cat is None else int(cat[1].shape[1])
+        max_nodes = int(sf.shape[0])
+
+        def build():
+            def inner(page, pos, s_loc, sf_d, sb_d, dl_d, isf_d, *cat_args):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                kw = ({} if not cat_args
+                      else dict(is_cat_split=cat_args[0],
+                                cat_words=cat_args[1]))
+                newp = update_positions(page, pos_pg, sf_d, sb_d, dl_d,
+                                        isf_d, self.missing_bin, **kw)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    pos, newp, s_loc, 0)
+
+            n_cat = 0 if W is None else 2
+            return jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis), P(), P(), P(), P(), P())
+                + (P(),) * n_cat,
+                out_specs=P(axis)))
+
+        fn = self._cached(("walk", max_nodes, W), build)
+        extra = () if cat is None else tuple(cat)
+        for s_loc, page in paged.pages_sharded(self.mesh, axis):
+            positions = fn(page, positions, jnp.int32(s_loc), sf, sb, dl,
+                           isf, *extra)
+        return positions
+
+    def apply1(self, paged, positions, nid, feat, sbin, dleft, is_cat,
+               words, left_id, right_id, missing_bin):
+        """Lossguide one-node advance over the pages."""
+        from .lossguide import _apply1
+
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        W = int(words.shape[0])
+
+        def build():
+            def inner(page, pos, s_loc, nid_d, feat_d, sbin_d, dl_d, ic_d,
+                      words_d, li_d, ri_d, mb_d):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                newp = _apply1(page, pos_pg, nid_d, feat_d, sbin_d, dl_d,
+                               ic_d, words_d, li_d, ri_d, mb_d)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    pos, newp, s_loc, 0)
+
+            return jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis)) + (P(),) * 10,
+                out_specs=P(axis)))
+
+        fn = self._cached(("apply1", W), build)
+        for s_loc, page in paged.pages_sharded(self.mesh, axis):
+            positions = fn(page, positions, jnp.int32(s_loc), nid, feat,
+                           sbin, dleft, is_cat, jnp.asarray(words), left_id,
+                           right_id, missing_bin)
+        return positions
 
 
 def _streamed_hist(paged, gpair: jnp.ndarray, rel_of, n_nodes: int,
@@ -89,14 +334,17 @@ def _streamed_hist(paged, gpair: jnp.ndarray, rel_of, n_nodes: int,
 
 def _streamed_advance(paged, positions, rel_of, idx, can_split, n_static,
                       n_level, split_feature, split_bin, default_left,
-                      max_nodes, missing_bin, cat_state=None):
+                      max_nodes, missing_bin, cat_state=None, mk=None,
+                      lo=None):
     """Advance positions one level with a pass over the pages — the shared
     level-advance of the paged growers. ``n_static <= 64`` uses the dense
     matmul advance with static-width padded split vectors (one program per
     page shape); deeper levels use the per-row gather walk. ``cat_state``
     is an optional ``(is_cat_split, cat_words)`` pair of full host arrays.
     An empty local shard leaves positions unchanged (the histogram side
-    already contributed zeros symmetrically)."""
+    already contributed zeros symmetrically). With ``mk`` (mesh kernels)
+    the same padded split vectors feed the shard_map'd per-page advance
+    instead of the per-host loop."""
     new_pos = []
     if n_static <= 64:
         feat_pad = np.full(n_static, -1, np.int32)
@@ -120,6 +368,11 @@ def _streamed_advance(paged, positions, rel_of, idx, can_split, n_static,
             cw_pad[:n_level] = cat_words[idx]
             cat_kw = dict(is_cat=jnp.asarray(ic_pad),
                           cat_words=jnp.asarray(cw_pad))
+        if mk is not None:
+            cat = (None if cat_state is None
+                   else (cat_kw["is_cat"], cat_kw["cat_words"]))
+            return mk.level_advance(paged, positions, lo, n_level, feat_d,
+                                    bin_d, dl_d, cs_d, cat=cat)
         for s, e, page in paged.pages():
             new_pos.append(advance_positions_level(
                 page.astype(jnp.float32), positions[s:e], rel_of(s, e),
@@ -136,6 +389,11 @@ def _streamed_advance(paged, positions, rel_of, idx, can_split, n_static,
             is_cat_split, cat_words = cat_state
             cat_kw = dict(is_cat_split=jnp.asarray(is_cat_split),
                           cat_words=jnp.asarray(cat_words))
+        if mk is not None:
+            cat = (None if cat_state is None
+                   else (cat_kw["is_cat_split"], cat_kw["cat_words"]))
+            return mk.walk_advance(paged, positions, sf_d, sb_d, dl_d,
+                                   isf_d, cat=cat)
         for s, e, page in paged.pages():
             new_pos.append(update_positions(
                 page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
@@ -149,24 +407,28 @@ class PagedGrower(TreeGrower):
     def __init__(self, param, max_nbins, cuts, hist_method="auto",
                  mesh=None, monotone=None, constraint_sets=None,
                  has_missing=True, split_mode="row") -> None:
-        if mesh is not None:
-            raise NotImplementedError(
-                "external-memory training does not support device meshes; "
-                "page budgets are per-chip. Multi-host external memory "
-                "runs one process per host with a communicator (each host "
-                "streams its own row shard; histograms allreduce)")
         if split_mode != "row":
             raise NotImplementedError(
                 "external-memory training supports data_split_mode=row only")
+        # parent keeps mesh=None: its resident shard_map path must never
+        # see paged data — the mesh drives _MeshPageKernels instead
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing, split_mode="row")
+        self.mesh = mesh
+        self._mk: Optional[_MeshPageKernels] = None
 
     def grow(self, paged, gpair: jnp.ndarray, n_real_bins,
              key: jax.Array) -> GrownTree:
         param = self.param
         n = paged.n_rows
+        if self.mesh is not None:
+            # mesh-sharded paging: per-row vectors come padded to the mesh
+            # layout (core._make_sharded_train_state), pages stream sharded
+            n = gpair.shape[0]
+            if self._mk is None:
+                self._mk = _make_mesh_kernels(self)
         max_depth = param.max_depth
         max_nodes = 2 ** (max_depth + 1) - 1
         max_nbins = self.max_nbins
@@ -209,7 +471,8 @@ class PagedGrower(TreeGrower):
         # streams only ITS row shard's pages; the per-level histogram and
         # the root gradient sum cross hosts through the communicator —
         # the same two allreduces the mesh path does with lax.psum.
-        positions = jnp.zeros((n,), jnp.int32)  # device-resident [n]
+        positions = (self._mk.init_positions(n) if self._mk is not None
+                     else jnp.zeros((n,), jnp.int32))  # device-resident [n]
         node_sum[0] = np.asarray(_host_allreduce(jnp.sum(gpair, axis=0)))
 
         # One static node width (2^(max_depth-1), the widest level) for
@@ -233,8 +496,12 @@ class PagedGrower(TreeGrower):
                     (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
                     positions[s:e] - lo, n_static).astype(jnp.int32)
 
-            hist_full = _streamed_hist(paged, gpair, rel_of, n_static,
-                                       max_nbins, hist_kernel)
+            if self._mk is not None:
+                hist_full = _host_allreduce(self._mk.level_hist(
+                    paged, gpair, positions, lo, n_level, n_static))
+            else:
+                hist_full = _streamed_hist(paged, gpair, rel_of, n_static,
+                                           max_nbins, hist_kernel)
 
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
@@ -334,7 +601,7 @@ class PagedGrower(TreeGrower):
                 split_feature, split_bin, default_left, max_nodes,
                 missing_bin,
                 cat_state=(is_cat_split, cat_words) if cat is not None
-                else None)
+                else None, mk=self._mk, lo=lo)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
                                    jnp.asarray(node_sum[:, 1]), param))
@@ -373,15 +640,21 @@ class PagedLossguideGrower(LossguideGrower):
     def __init__(self, param, max_nbins, cuts, hist_method="auto",
                  mesh=None, monotone=None, constraint_sets=None,
                  has_missing=True) -> None:
-        if mesh is not None:
-            raise NotImplementedError(
-                "external-memory training does not support device meshes; "
-                "multi-host external memory runs one process per host "
-                "with a communicator")
+        # parent keeps mesh=None: its resident shard_map _functions must
+        # never see paged data — the mesh drives _MeshPageKernels instead
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing)
+        self.mesh = mesh
+        self._mk: Optional[_MeshPageKernels] = None
+
+    def _init_positions(self, n: int) -> jnp.ndarray:
+        if self.mesh is not None:
+            if self._mk is None:
+                self._mk = _make_mesh_kernels(self)
+            return self._mk.init_positions(n)
+        return jnp.zeros((n,), jnp.int32)
 
     def _functions(self):
         if self._fns is not None:
@@ -394,13 +667,18 @@ class PagedLossguideGrower(LossguideGrower):
         def eval2(paged, gpair, positions, i0, i1, psums, fmask,
                   node_lower, node_upper, n_real_bins, bins_t=None):
             del bins_t  # pages transpose per-page inside build_hist
-            def rel_of(s, e):
-                return jnp.where(
-                    positions[s:e] == i0, 0,
-                    jnp.where(positions[s:e] == i1, 1, 2)).astype(jnp.int32)
+            if self._mk is not None:
+                hist = _host_allreduce(self._mk.pair_hist(
+                    paged, gpair, positions, i0, i1))
+            else:
+                def rel_of(s, e):
+                    return jnp.where(
+                        positions[s:e] == i0, 0,
+                        jnp.where(positions[s:e] == i1, 1,
+                                  2)).astype(jnp.int32)
 
-            hist = _streamed_hist(paged, gpair, rel_of, 2, self.max_nbins,
-                                  hist_kernel)
+                hist = _streamed_hist(paged, gpair, rel_of, 2,
+                                      self.max_nbins, hist_kernel)
             return evaluate_splits(hist, psums, n_real_bins, self.param,
                                    feature_mask=fmask,
                                    monotone=self.monotone,
@@ -410,6 +688,10 @@ class PagedLossguideGrower(LossguideGrower):
 
         def apply1(paged, positions, nid, feat, sbin, dleft, is_cat,
                    words, left_id, right_id, missing_bin):
+            if self._mk is not None:
+                return self._mk.apply1(paged, positions, nid, feat, sbin,
+                                       dleft, is_cat, words, left_id,
+                                       right_id, missing_bin)
             new_pos = [apply1_jit(page, positions[s:e], nid, feat, sbin,
                                   dleft, is_cat, words, left_id, right_id,
                                   missing_bin)
@@ -437,19 +719,20 @@ class PagedMultiTargetGrower(MultiTargetGrower):
 
     def __init__(self, param, max_nbins, cuts, hist_method="auto",
                  mesh=None, has_missing=True) -> None:
-        if mesh is not None:
-            raise NotImplementedError(
-                "external-memory training does not support device meshes; "
-                "multi-host external memory runs one process per host "
-                "with a communicator")
+        # parent keeps mesh=None: its resident shard_map path must never
+        # see paged data — the mesh drives _MeshPageKernels instead
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, has_missing=has_missing)
+        self.mesh = mesh
+        self._mk: Optional[_MeshPageKernels] = None
 
     def grow(self, paged, gpair: jnp.ndarray, n_real_bins, key: jax.Array):
         from .multi import GrownMulti, evaluate_splits_multi
 
         param = self.param
         n, K = gpair.shape[0], gpair.shape[1]
+        if self.mesh is not None and self._mk is None:
+            self._mk = _make_mesh_kernels(self)
         max_depth = param.max_depth
         max_nodes = 2 ** (max_depth + 1) - 1
         max_nbins = self.max_nbins
@@ -471,7 +754,8 @@ class PagedMultiTargetGrower(MultiTargetGrower):
         gain = np.zeros(max_nodes, np.float32)
         node_sum = np.zeros((max_nodes, K, 2), np.float32)
         node_sum[0] = np.asarray(_host_allreduce(jnp.sum(gpair, axis=0)))
-        positions = jnp.zeros((n,), jnp.int32)
+        positions = (self._mk.init_positions(n) if self._mk is not None
+                     else jnp.zeros((n,), jnp.int32))
         n_static = 2 ** (max_depth - 1) if max_depth > 0 else 1
 
         for depth in range(max_depth):
@@ -483,8 +767,13 @@ class PagedMultiTargetGrower(MultiTargetGrower):
                     (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
                     positions[s:e] - lo, n_static).astype(jnp.int32)
 
-            hist = _streamed_hist(paged, gpair, rel_of, n_static, max_nbins,
-                                  hist_kernel, multi=True)
+            if self._mk is not None:
+                hist = _host_allreduce(self._mk.level_hist(
+                    paged, gpair, positions, lo, n_level, n_static,
+                    multi=True))
+            else:
+                hist = _streamed_hist(paged, gpair, rel_of, n_static,
+                                      max_nbins, hist_kernel, multi=True)
 
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
@@ -537,7 +826,7 @@ class PagedMultiTargetGrower(MultiTargetGrower):
             positions = _streamed_advance(
                 paged, positions, rel_of, idx, can_split, n_static, n_level,
                 split_feature, split_bin, default_left, max_nodes,
-                missing_bin)
+                missing_bin, mk=self._mk, lo=lo)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[..., 0]),
                                    jnp.asarray(node_sum[..., 1]),
