@@ -99,3 +99,25 @@ def test_interaction_constraints_still_learns():
               dm, 15, evals=[(dm, "train")], evals_result=res,
               verbose_eval=False)
     assert res["train"]["rmse"][-1] < res["train"]["rmse"][0] * 0.5
+
+
+def test_constrained_model_save_load_roundtrip():
+    # regression: loading a model trained with interaction_constraints
+    # rebuilds the booster BEFORE any DMatrix is seen — constraint parsing
+    # must use the deserialized learner_model_param num_feature, not 0
+    rng = np.random.RandomState(5)
+    X = rng.randn(500, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "interaction_constraints": "[[0,1],[2,3]]",
+                     "monotone_constraints": "(1,0,0,0)"},
+                    dm, 3, verbose_eval=False)
+    b2 = xgb.Booster()
+    b2.load_model(bytes(bst.save_raw("json")))
+    np.testing.assert_array_equal(b2.predict(dm), bst.predict(dm))
+    # and training continuation on the loaded model keeps the constraints
+    b3 = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                    "interaction_constraints": "[[0,1],[2,3]]"},
+                   dm, 2, xgb_model=b2, verbose_eval=False)
+    assert len(b3.gbm.trees) == 5

@@ -70,13 +70,104 @@ def test_multi_output_tree_softprob():
     assert acc > 0.8
 
 
-def test_multi_output_tree_rejects_constraints():
+def test_multi_output_tree_rejects_monotone_and_dart():
+    # reference parity: monotone CHECKed empty for vector-leaf trees
+    # (src/tree/updater_quantile_hist.cc:500), dart rejected
+    # (src/gbm/gbtree.cc:745); interaction constraints work (below)
     X, Y = _data(n=500)
     dm = xgb.DMatrix(X, label=Y)
     with pytest.raises(NotImplementedError):
         xgb.train({"objective": "reg:squarederror",
                    "multi_strategy": "multi_output_tree",
                    "monotone_constraints": "(1)"}, dm, 1, verbose_eval=False)
+    with pytest.raises(NotImplementedError):
+        xgb.train({"objective": "reg:squarederror", "booster": "dart",
+                   "multi_strategy": "multi_output_tree"}, dm, 1,
+                  verbose_eval=False)
+
+
+def _assert_paths_obey(bst, groups):
+    """Every root->leaf feature path must fit inside one constraint set."""
+    checked = 0
+    for tree in bst.gbm.trees:
+        lc, rc = tree.left_child, tree.right_child
+        sf = tree.split_feature
+
+        def walk(i, path):
+            nonlocal checked
+            if lc[i] < 0:
+                if path:
+                    assert any(path <= g for g in groups), sorted(path)
+                    checked += 1
+                return
+            walk(lc[i], path | {int(sf[i])})
+            walk(rc[i], path | {int(sf[i])})
+
+        walk(0, set())
+    assert checked > 0
+
+
+def test_multi_output_tree_interaction_constraints():
+    # reference parity: HistMultiEvaluator queries interaction constraints
+    # per candidate feature (src/tree/hist/evaluate_splits.h:666-669)
+    X, Y = _data(n=3000, f=6)
+    dm = xgb.DMatrix(X, label=Y)
+    groups = [{0, 1, 2}, {3, 4, 5}]
+    params = {"objective": "reg:squarederror",
+              "multi_strategy": "multi_output_tree", "max_depth": 4,
+              "interaction_constraints": "[[0,1,2],[3,4,5]]"}
+    for extra in ({}, {"grow_policy": "lossguide", "max_leaves": 10,
+                       "max_depth": 0}):
+        bst = xgb.train({**params, **extra}, dm, 4, verbose_eval=False)
+        _assert_paths_obey(bst, groups)
+
+
+def test_multi_output_tree_constraints_match_scalar_on_identical_targets():
+    # K identical targets => every per-target gain is equal, so the summed
+    # multi gain argmax must pick the SAME splits as the scalar evaluator
+    # under the same interaction constraints
+    rng = np.random.RandomState(9)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 3] + 0.05 * rng.randn(3000)).astype(
+        np.float32)
+    Y = np.stack([y, y], axis=1)
+    params = {"objective": "reg:squarederror", "max_depth": 4,
+              "min_child_weight": 0.0,
+              "interaction_constraints": "[[0,1],[2,3],[4,5]]"}
+    bst_s = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    bst_m = xgb.train({**params, "multi_strategy": "multi_output_tree"},
+                      xgb.DMatrix(X, label=Y), 3, verbose_eval=False)
+    assert len(bst_m.gbm.trees) == len(bst_s.gbm.trees) == 3
+    for tm, ts in zip(bst_m.gbm.trees, bst_s.gbm.trees):
+        np.testing.assert_array_equal(tm.split_feature, ts.split_feature)
+        np.testing.assert_array_equal(tm.split_bin, ts.split_bin)
+        np.testing.assert_allclose(tm.leaf_value,
+                                   np.stack([ts.leaf_value] * 2, axis=1),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_multi_output_tree_paged_interaction_constraints(tmp_path,
+                                                         monkeypatch):
+    from test_data_iterator import BatchIter
+
+    X, Y = _data(n=3000, f=6)
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "400")
+    it = BatchIter(X, Y, n_batches=4)
+    it.cache_prefix = str(tmp_path / "pc")
+    qdm = xgb.QuantileDMatrix(it, max_bin=64)
+    assert qdm.binned(64).n_pages() > 1
+    qdm_m = xgb.QuantileDMatrix(BatchIter(X, Y, n_batches=4), max_bin=64)
+    groups = [{0, 1, 2}, {3, 4, 5}]
+    params = {"objective": "reg:squarederror",
+              "multi_strategy": "multi_output_tree", "max_depth": 4,
+              "max_bin": 64,
+              "interaction_constraints": "[[0,1,2],[3,4,5]]"}
+    bst_p = xgb.train(params, qdm, 3, verbose_eval=False)
+    bst_m = xgb.train(params, qdm_m, 3, verbose_eval=False)
+    _assert_paths_obey(bst_p, groups)
+    for tp, tm in zip(bst_p.gbm.trees, bst_m.gbm.trees):
+        np.testing.assert_array_equal(tp.split_feature, tm.split_feature)
+        np.testing.assert_array_equal(tp.split_bin, tm.split_bin)
 
 
 def test_multi_output_tree_sharded_matches_single():

@@ -344,6 +344,38 @@ def test_rank_map_rejects_graded_labels():
             np.zeros(4, np.float32), info)
 
 
+@pytest.mark.parametrize("objective", ["rank:ndcg", "rank:pairwise"])
+def test_lambdarank_unbiased_device_matches_host_oracle(objective):
+    """The device unbiased path (_debias_dev) must reproduce the host
+    loop's gradients and learned ti+/tj- (topk pairs are deterministic,
+    so the two paths see the identical pair multiset; f32 vs f64 costs a
+    tolerance, not a different answer)."""
+    import os
+
+    rng = np.random.RandomState(3)
+    n_query, docs = 25, 9
+    y = (rng.rand(n_query * docs) < 0.4).astype(np.float32)
+    preds = rng.randn(n_query * docs).astype(np.float32)
+    ptr = np.arange(0, n_query * docs + 1, docs, dtype=np.int64)
+    params = {"lambdarank_pair_method": "topk",
+              "lambdarank_unbiased": True}
+    obj_d = get_objective(objective, dict(params))
+    obj_h = get_objective(objective, dict(params))
+    info = _Info(y, group_ptr=ptr)
+    for it in range(3):
+        gd = np.asarray(obj_d.get_gradient(preds, info, iteration=it))
+        os.environ["XTPU_RANK_HOST"] = "1"
+        try:
+            gh = np.asarray(obj_h.get_gradient(preds, info, iteration=it))
+        finally:
+            os.environ.pop("XTPU_RANK_HOST", None)
+        np.testing.assert_allclose(gd, gh, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(obj_d._ti_plus, obj_h._ti_plus,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(obj_d._tj_minus, obj_h._tj_minus,
+                                   rtol=2e-4, atol=2e-5)
+
+
 @pytest.mark.parametrize("method", ["topk", "mean"])
 def test_lambdarank_unbiased_learns_position_bias(method):
     """Unbiased LambdaMART (reference lambdarank_obj.cc:42-89): with

@@ -1,0 +1,79 @@
+"""Paged (external-memory) tier throughput at the north-star shape.
+
+11M x 28, depth 6, XTPU_PAGE_ROWS=4M (3 pages), HBM page cache on —
+the configuration BASELINE.md's external-memory paragraph records.
+Prints cold and steady (slope) seconds/round. Run on the TPU.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XTPU_PAGE_ROWS", "4000000")
+
+import numpy as np  # noqa: E402
+
+N = int(os.environ.get("BENCH_PAGED_ROWS", 11_000_000))
+F = 28
+
+
+def main():
+    import jax
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    print("devices:", jax.devices(), flush=True)
+    rng = np.random.RandomState(42)
+    X = rng.randn(N, F).astype(np.float32)
+    w = rng.randn(F).astype(np.float32)
+    y = (X @ w + rng.randn(N).astype(np.float32) > 0).astype(np.float32)
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self.parts = np.array_split(np.arange(N), 11)
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(self.parts):
+                return 0
+            idx = self.parts[self.i]
+            input_data(data=X[idx], label=y[idx])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    it = It()
+    it.cache_prefix = os.environ.get("BENCH_PAGED_CACHE", "/tmp/paged_bench")
+    t0 = time.perf_counter()
+    dm = xgb.QuantileDMatrix(it, max_bin=256)
+    print(f"ingest: {time.perf_counter() - t0:.1f} s", flush=True)
+    binned = dm.binned(256)
+    print("pages:", binned.n_pages(), flush=True)
+
+    params = {"objective": "binary:logistic", "max_depth": 6, "eta": 0.1,
+              "max_bin": 256}
+
+    def timed(rounds):
+        t0 = time.perf_counter()
+        bst = xgb.train(params, dm, rounds, verbose_eval=False)
+        for st in bst._caches.values():
+            jax.block_until_ready(st["margin"])
+            float(np.asarray(st["margin"][0, 0]))
+        return time.perf_counter() - t0
+
+    print(f"first 2 rounds (compiles): {timed(2):.1f} s", flush=True)
+    t5 = min(timed(5) for _ in range(2))
+    print(f"t5: {t5:.1f} s", flush=True)
+    t15 = min(timed(15) for _ in range(2))
+    print(f"t15: {t15:.1f} s", flush=True)
+    print(f"steady: {(t15 - t5) / 10:.2f} s/round "
+          f"({10 / (t15 - t5):.2f} rounds/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

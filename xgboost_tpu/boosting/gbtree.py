@@ -21,6 +21,10 @@ from ..registry import BOOSTERS
 from ..tree.grow import GrownTree, TreeGrower
 from ..tree.param import TrainParam
 from ..tree.tree import TreeModel
+# One packed transfer per flush regardless of tree count — a 7-tree dart
+# round used to flush 77 arrays = 2 s of pure tunnel latency per ROUND
+# (54 s/round at 581k x 54, measured). Shared with the paged level loop.
+from ..utils.fetch import fetch_packed as _fetch_packed
 
 
 _GROWN_FIELDS = ("split_feature", "split_bin", "default_left", "is_leaf",
@@ -93,48 +97,6 @@ _grow_classes_fn = jax.jit(
     static_argnames=("param", "max_nbins", "hist_method", "has_missing"))
 
 
-@jax.jit
-def _pack_for_host(arrs):
-    """Coalesce a pytree of mixed-dtype arrays into ONE flat int32 buffer.
-
-    Over the axon tunnel every `device_get` leaf is a separate ~26 ms
-    round trip — a 7-tree dart round flushed 77 arrays = 2 s of pure
-    transfer latency per ROUND (54 s/round at 581k x 54, measured). One
-    packed buffer makes a flush one transfer regardless of tree count.
-    bool/int32 promote losslessly; uint32 and float32 BITCAST to int32 so
-    every value crosses bit-exactly and is re-bitcast host-side."""
-    parts = []
-    for a in jax.tree_util.tree_leaves(arrs):
-        if a.dtype in (jnp.float32, jnp.uint32):
-            a = jax.lax.bitcast_convert_type(a, jnp.int32)
-        else:
-            a = a.astype(jnp.int32)
-        parts.append(a.reshape(-1))
-    return jnp.concatenate(parts)
-
-
-def _fetch_packed(dicts: list) -> list:
-    """list of device dicts -> list of host numpy dicts via ONE packed
-    transfer for the whole flush (a dart round can have 7+ per-class tree
-    dicts pending at once)."""
-    buf = np.asarray(_pack_for_host(dicts))
-    out, off = [], 0
-    for arrays in dicts:
-        host_d = {}
-        for k in sorted(arrays):  # tree_leaves of a dict is key-sorted
-            a = arrays[k]
-            n = int(np.prod(a.shape)) if a.ndim else 1
-            flat = buf[off:off + n]
-            off += n
-            if a.dtype in (jnp.float32, jnp.uint32):
-                host = flat.view(np.dtype(a.dtype.name))
-            elif a.dtype == jnp.bool_:
-                host = flat.astype(bool)
-            else:
-                host = flat.astype(np.dtype(a.dtype.name))
-            host_d[k] = host.reshape(a.shape)
-        out.append(host_d)
-    return out
 
 
 def match_rows(m, n: int):
@@ -470,7 +432,8 @@ class GBTree:
             self._grower = cls(
                 param, binned.max_nbins, binned.cuts,
                 hist_method=self.hist_method, mesh=self.mesh,
-                has_missing=binned.has_missing)
+                has_missing=binned.has_missing,
+                constraint_sets=self.constraint_sets)
         grower = self._grower
         n_real = binned.n_real_bins()
         delta = jnp.zeros(gpair.shape[:2], jnp.float32)

@@ -364,8 +364,11 @@ class Booster:
         from .tree.param import (parse_interaction_constraints,
                                  parse_monotone_constraints)
 
-        nf = n_features or (len(self.feature_names)
-                            if self.feature_names else 0)
+        # model-load path: the booster is rebuilt before any DMatrix is
+        # seen, so the deserialized learner_model_param num_feature is the
+        # only feature count available for constraint parsing
+        nf = (n_features or getattr(self, "_num_features", 0)
+              or (len(self.feature_names) if self.feature_names else 0))
         if self._is_vertical_federated():
             # constraints index GLOBAL features, but nf counts only this
             # party's block — parse against the summed per-party width
@@ -390,11 +393,16 @@ class Booster:
         ms = self.learner_params.get("multi_strategy", "one_output_per_tree")
         if ms not in ("one_output_per_tree", "multi_output_tree"):
             raise ValueError(f"unknown multi_strategy: {ms}")
-        if ms == "multi_output_tree" and (mono is not None or ics is not None
-                                          or name == "dart"):
+        if ms == "multi_output_tree" and (mono is not None or name == "dart"):
+            # reference parity: the reference itself CHECKs monotone empty
+            # for vector-leaf trees (src/tree/updater_quantile_hist.cc:500)
+            # and rejects dart (src/gbm/gbtree.cc:745); interaction
+            # constraints ARE supported (HistMultiEvaluator queries them,
+            # src/tree/hist/evaluate_splits.h:666-669)
             raise NotImplementedError(
-                "multi_output_tree does not support monotone/interaction "
-                "constraints or the dart booster")
+                "multi_output_tree does not support monotone constraints "
+                "or the dart booster (the reference rejects both for "
+                "vector-leaf trees)")
         dsm = self.learner_params.get("data_split_mode", "row")
         if dsm not in ("row", "col"):
             raise ValueError(f"unknown data_split_mode: {dsm}")

@@ -28,8 +28,10 @@ per-group ``log2(1+sum_lambda)/sum_lambda`` normalization borrowed from
 LightGBM (``lambdarank_obj.h:112-126``, ``lambdarank_obj.cc:178-231``).
 Measured quality at the MSLR shape matches (BASELINE.md #3); the paper
 recipe keeps the device kernels branch-free. ``lambdarank_unbiased``
-implements the same eq. 30/31 bias estimation the reference does, on the
-host path.
+implements the same eq. 30/31 bias estimation the reference does, ON
+DEVICE for both pair methods (``_debias_dev``; the ti+/tj- vectors live
+on the host in f64 for the normalize/damp update and serialization, as
+the reference keeps them in its objective config).
 """
 
 from __future__ import annotations
@@ -89,12 +91,38 @@ def _map_prefix(yp, vp, order, L):
 def _ranknet_dev(s_i, s_j, a_is_i, delta, mask):
     """RankNet lambda/hessian from oriented score differences — the ONE
     device encoding of the clip bound (50) and hessian floor (1e-16) the
-    host loop uses, shared by the topk and mean kernels."""
+    host loop uses, shared by the topk and mean kernels. Also returns the
+    oriented sigmoid ``p`` (the unbiased path's pair-cost input)."""
     sij = jnp.where(a_is_i, s_i - s_j, s_j - s_i)
     p = 1.0 / (1.0 + jnp.exp(jnp.clip(sij, -50.0, 50.0)))
     lam = jnp.where(mask, -p * delta, 0.0)
     hes = jnp.where(mask, jnp.maximum(p * (1.0 - p) * delta, 1e-16), 0.0)
-    return lam, hes
+    return lam, hes, p
+
+
+def _debias_dev(lam, hes, p, delta, mask, a_is_i, i_pos, j_pos, ti, tj,
+                kpos):
+    """Unbiased-LambdaMART position debiasing for a device pair tensor
+    (reference ``lambdarank_obj.h:121-141`` + ``.cu``): scale each pair's
+    lambda/hessian by 1/(ti+[pos_i] * tj-[pos_j]) where pos_* index the
+    INPUT (presentation) order, and accumulate the per-position pair costs
+    that drive the post-iteration bias update. Positions >= kpos (or with
+    a zero bias estimate — the reference's Eps64 gate) pass through
+    unscaled and unaccumulated. Returns (lam, hes, cost/tmj, cost/tpi,
+    ok) with the cost terms zeroed outside ``ok``. The gate threshold is
+    the HOST loop's float64 eps (not f32 tiny): a bias estimate below it
+    must be EXCLUDED, not divided by — dividing by ~1e-20 in f32
+    overflows the lambdas where the reference trains normally."""
+    eps = jnp.float32(np.finfo(np.float64).eps)
+    tpi = ti[jnp.minimum(i_pos, kpos - 1)]
+    tmj = tj[jnp.minimum(j_pos, kpos - 1)]
+    ok = mask & (i_pos < kpos) & (j_pos < kpos) & (tpi >= eps) & (tmj >= eps)
+    scale = jnp.where(ok, tpi * tmj, 1.0)
+    lam = lam / scale
+    hes = hes / scale
+    cost = jnp.where(ok, jnp.log(1.0 / jnp.maximum(p, 1e-30)) * delta, 0.0)
+    return lam, hes, cost / jnp.maximum(tmj, eps), \
+        cost / jnp.maximum(tpi, eps), ok
 
 
 def _delta_dev(objective, *, yp, vp, order, L, gv, dv, inv_idcg,
@@ -143,9 +171,10 @@ def _map_delta_dev(rank_i, rank_j, a_is_i, Ck, T0, R):
 @functools.partial(
     jax.jit,
     static_argnames=("kcap", "L", "exp_gain", "objective", "chunk",
-                     "n_groups"))
-def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
-                        kcap, L, exp_gain, objective, chunk, n_groups):
+                     "n_groups", "kpos"))
+def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, ti=None, tj=None, *,
+                        kcap, L, exp_gain, objective, chunk, n_groups,
+                        kpos=0):
     """All-pairs LambdaRank lambdas over padded [G, L] groups.
 
     Exactly the host loop's math (orientation, RankNet clip, 1e-16 hessian
@@ -185,28 +214,52 @@ def _lambda_grad_device(s, y, qidx, slot, sizes, w_row, *,
             rank_i=jnp.broadcast_to(rank_of[:, :, None], (Cn, L, L)),
             rank_j=jnp.broadcast_to(rank_of[:, None, :], (Cn, L, L)),
             a_is_i=a_is_i)
-        lam, hes = _ranknet_dev(sp[:, :, None], sp[:, None, :], a_is_i,
-                                delta, mask)
+        lam, hes, p = _ranknet_dev(sp[:, :, None], sp[:, None, :], a_is_i,
+                                   delta, mask)
+        if kpos > 0:  # unbiased LambdaMART: slots ARE input positions
+            pos = jnp.arange(L, dtype=jnp.int32)
+            i_pos = jnp.where(a_is_i, pos[None, :, None], pos[None, None, :])
+            j_pos = jnp.where(a_is_i, pos[None, None, :], pos[None, :, None])
+            lam, hes, ci, cj, ok = _debias_dev(
+                lam, hes, p, delta, mask, a_is_i, i_pos, j_pos, ti, tj,
+                kpos)
+            # per-position pair-cost sums: i_pos is the anchor slot where
+            # a_is_i, else the partner slot (and symmetrically for j_pos)
+            li_c = (jnp.where(a_is_i, ci, 0.0).sum(axis=2).sum(axis=0)
+                    + jnp.where(~a_is_i, ci, 0.0).sum(axis=1).sum(axis=0))
+            lj_c = (jnp.where(~a_is_i, cj, 0.0).sum(axis=2).sum(axis=0)
+                    + jnp.where(a_is_i, cj, 0.0).sum(axis=1).sum(axis=0))
+        else:
+            li_c = lj_c = jnp.zeros((L,), jnp.float32)
         g = (jnp.where(a_is_i, lam, -lam).sum(axis=2)
              + jnp.where(a_is_i, -lam, lam).sum(axis=1))
         h = hes.sum(axis=2) + hes.sum(axis=1)
-        return g, h
+        return g, h, li_c, lj_c
 
     cs = lambda a: a.reshape(Gp // chunk, chunk, *a.shape[1:])
-    g_pad, h_pad = jax.lax.map(one_chunk,
-                               (cs(s_pad), cs(y_pad), cs(valid), cs(kc)))
+    g_pad, h_pad, li_s, lj_s = jax.lax.map(
+        one_chunk, (cs(s_pad), cs(y_pad), cs(valid), cs(kc)))
     g = g_pad.reshape(Gp, L)[qidx, slot] * w_row
     h = h_pad.reshape(Gp, L)[qidx, slot] * w_row
-    return jnp.stack([g, h], axis=-1)[:, None, :]    # [n, 1, 2] f32
+    gpair = jnp.stack([g, h], axis=-1)[:, None, :]   # [n, 1, 2] f32
+    if kpos > 0:
+        m = min(kpos, L)
+        li = jnp.zeros((kpos,), jnp.float32).at[:m].set(
+            li_s.sum(axis=0)[:m])
+        lj = jnp.zeros((kpos,), jnp.float32).at[:m].set(
+            lj_s.sum(axis=0)[:m])
+        return gpair, li, lj
+    return gpair, None, None
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "exp_gain", "objective", "chunk",
-                     "n_groups"))
+                     "n_groups", "kpos"))
 def _lambda_grad_device_mean(s, y, qidx, slot, sizes, w_row, key,
-                             y_order_g, n_lefts_g, n_geq_g, *,
-                             k, L, exp_gain, objective, chunk, n_groups):
+                             y_order_g, n_lefts_g, n_geq_g, ti=None,
+                             tj=None, *, k, L, exp_gain, objective, chunk,
+                             n_groups, kpos=0):
     """Sampled-pair (``mean``) LambdaRank lambdas over padded [G, L] groups.
 
     The reference's distribution (``lambdarank_obj.h:231-275``): each doc
@@ -268,24 +321,52 @@ def _lambda_grad_device_mean(s, y, qidx, slot, sizes, w_row, key,
             rank_i=jnp.broadcast_to(rank_of[:, :, None],
                                     rank_of.shape + (rival.shape[2],)),
             rank_j=take(rank_of), a_is_i=a_is_i)
-        lam, hes = _ranknet_dev(sp[:, :, None], sj, a_is_i, delta, pair_ok)
+        lam, hes, p = _ranknet_dev(sp[:, :, None], sj, a_is_i, delta,
+                                   pair_ok)
+        riv_flat = rival.reshape(C, L * k)
+        if kpos > 0:  # unbiased: anchor slot vs sampled-rival slot
+            pos = jnp.arange(L, dtype=jnp.int32)
+            i_pos = jnp.where(a_is_i, pos[None, :, None], rival)
+            j_pos = jnp.where(a_is_i, rival, pos[None, :, None])
+            lam, hes, ci, cj, ok = _debias_dev(
+                lam, hes, p, delta, pair_ok, a_is_i, i_pos, j_pos, ti, tj,
+                kpos)
+            li_c = jnp.where(a_is_i, ci, 0.0).sum(axis=2).sum(axis=0)
+            lj_c = jnp.where(~a_is_i, cj, 0.0).sum(axis=2).sum(axis=0)
+            sc_i = jnp.zeros((C, L), jnp.float32).at[
+                iota_c[:, None], riv_flat].add(
+                jnp.where(~a_is_i, ci, 0.0).reshape(C, L * k))
+            sc_j = jnp.zeros((C, L), jnp.float32).at[
+                iota_c[:, None], riv_flat].add(
+                jnp.where(a_is_i, cj, 0.0).reshape(C, L * k))
+            li_c = li_c + sc_i.sum(axis=0)
+            lj_c = lj_c + sc_j.sum(axis=0)
+        else:
+            li_c = lj_c = jnp.zeros((L,), jnp.float32)
         g = jnp.where(a_is_i, lam, -lam).sum(axis=2)
         h = hes.sum(axis=2)
         g_r = jnp.where(a_is_i, -lam, lam).reshape(C, L * k)
         h_r = hes.reshape(C, L * k)
-        riv_flat = rival.reshape(C, L * k)
         g = g.at[iota_c[:, None], riv_flat].add(g_r)
         h = h.at[iota_c[:, None], riv_flat].add(h_r)
-        return g, h
+        return g, h, li_c, lj_c
 
     cs = lambda a: a.reshape(Gp // chunk, chunk, *a.shape[1:])
     keys = jax.random.split(key, Gp // chunk)
-    g_pad, h_pad = jax.lax.map(
+    g_pad, h_pad, li_s, lj_s = jax.lax.map(
         one_chunk, (cs(s_pad), cs(y_pad), cs(valid), cs(sz), cs(op),
                     cs(nl_p), cs(ng_p), keys))
     g = g_pad.reshape(Gp, L)[qidx, slot] * w_row
     h = h_pad.reshape(Gp, L)[qidx, slot] * w_row
-    return jnp.stack([g, h], axis=-1)[:, None, :]    # [n, 1, 2] f32
+    gpair = jnp.stack([g, h], axis=-1)[:, None, :]   # [n, 1, 2] f32
+    if kpos > 0:
+        m = min(kpos, L)
+        li = jnp.zeros((kpos,), jnp.float32).at[:m].set(
+            li_s.sum(axis=0)[:m])
+        lj = jnp.zeros((kpos,), jnp.float32).at[:m].set(
+            lj_s.sum(axis=0)[:m])
+        return gpair, li, lj
+    return gpair, None, None
 
 
 class _LambdaRankBase(Objective):
@@ -418,11 +499,32 @@ class _LambdaRankBase(Objective):
         unbiased = str(self.params.get(
             "lambdarank_unbiased", "false")).lower() in ("1", "true")
         if (self.name in ("rank:ndcg", "rank:pairwise", "rank:map")
-                and method in ("topk", "mean") and not unbiased
+                and method in ("topk", "mean")
                 and os.environ.get("XTPU_RANK_HOST") != "1"):
             lay = self._device_layout(info)
             n = lay["y"].shape[0]
             s = jnp.asarray(preds, jnp.float32).reshape(-1)[:n]
+            kpos, ti_d, tj_d = 0, None, None
+            if unbiased:
+                # device unbiased LambdaMART (reference lambdarank_obj.cu):
+                # same kpos rule as the host loop; ti+/tj- live on the host
+                # in f64 (serialization + the normalize/damp update) and
+                # ride into the kernel as f32
+                max_gs = int(lay["L"])  # layout width == max group size
+                if method == "topk":
+                    kpos = int(self.params.get(
+                        "lambdarank_num_pair_per_sample", max_gs))
+                else:
+                    kpos = min(max_gs, 32)
+                kpos = max(kpos, 1)
+                if (getattr(self, "_ti_plus", None) is None
+                        or len(self._ti_plus) != kpos):
+                    self._ti_plus = np.ones(kpos, np.float64)
+                    self._tj_minus = np.ones(kpos, np.float64)
+                self._ti_plus = np.asarray(self._ti_plus, np.float64)
+                self._tj_minus = np.asarray(self._tj_minus, np.float64)
+                ti_d = jnp.asarray(self._ti_plus, jnp.float32)
+                tj_d = jnp.asarray(self._tj_minus, jnp.float32)
             if method == "mean":
                 lay = self._mean_stats(lay)
                 k = int(self.params.get(
@@ -434,18 +536,24 @@ class _LambdaRankBase(Objective):
                 # own footprint, not the all-pairs [C, L, L] budget
                 chunk = max(1, min(lay["G"],
                                    (1 << 24) // max(lay["L"] * k, 1)))
-                return _lambda_grad_device_mean(
+                gpair, li, lj = _lambda_grad_device_mean(
                     s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
                     lay["w_row"], key, lay["y_order"], lay["n_lefts"],
-                    lay["n_geq"], k=k, L=lay["L"], exp_gain=exp_gain,
-                    objective=self.name.split(":")[1], chunk=chunk,
-                    n_groups=lay["G"])
-            kcap = int(self.params.get("lambdarank_num_pair_per_sample", 0))
-            return _lambda_grad_device(
-                s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
-                lay["w_row"], kcap=kcap, L=lay["L"], exp_gain=exp_gain,
-                objective=self.name.split(":")[1], chunk=lay["chunk"],
-                n_groups=lay["G"])
+                    lay["n_geq"], ti_d, tj_d, k=k, L=lay["L"],
+                    exp_gain=exp_gain, objective=self.name.split(":")[1],
+                    chunk=chunk, n_groups=lay["G"], kpos=kpos)
+            else:
+                kcap = int(self.params.get(
+                    "lambdarank_num_pair_per_sample", 0))
+                gpair, li, lj = _lambda_grad_device(
+                    s, lay["y"], lay["qidx"], lay["slot"], lay["sizes"],
+                    lay["w_row"], ti_d, tj_d, kcap=kcap, L=lay["L"],
+                    exp_gain=exp_gain, objective=self.name.split(":")[1],
+                    chunk=lay["chunk"], n_groups=lay["G"], kpos=kpos)
+            if unbiased:
+                self._update_position_bias(np.asarray(li, np.float64),
+                                           np.asarray(lj, np.float64))
+            return gpair
         y_all = np.asarray(info.labels, dtype=np.float64).reshape(-1)
         s_all = np.asarray(preds, dtype=np.float64).reshape(-1)[: len(y_all)]
         ptr = np.asarray(info.group_ptr, dtype=np.int64)
@@ -521,16 +629,7 @@ class _LambdaRankBase(Objective):
             np.add.at(h, a + i, hes)
             np.add.at(h, a + j, hes)
         if unbiased:
-            # reference LambdaRankUpdatePositionBias: normalize to
-            # position 0 and damp by 1 / (1 + lambdarank_bias_norm)
-            reg = 1.0 / (1.0 + float(self.params.get(
-                "lambdarank_bias_norm", 1.0)))
-            if li_acc[0] >= eps64:
-                self._ti_plus = np.power(li_acc / max(li_acc[0], eps64),
-                                         reg)
-            if lj_acc[0] >= eps64:
-                self._tj_minus = np.power(lj_acc / max(lj_acc[0], eps64),
-                                          reg)
+            self._update_position_bias(li_acc, lj_acc)
         if info.weights is not None:
             # ranking weights are per query
             w = np.asarray(info.weights, dtype=np.float64)
@@ -542,6 +641,18 @@ class _LambdaRankBase(Objective):
             h *= w_row
         gpair = np.stack([g, h], axis=-1).astype(np.float32)
         return jnp.asarray(gpair)[:, None, :]
+
+    def _update_position_bias(self, li_acc, lj_acc):
+        """reference LambdaRankUpdatePositionBias: normalize the
+        accumulated pair costs to position 0 and damp by
+        1 / (1 + lambdarank_bias_norm)."""
+        eps64 = np.finfo(np.float64).eps
+        reg = 1.0 / (1.0 + float(self.params.get(
+            "lambdarank_bias_norm", 1.0)))
+        if li_acc[0] >= eps64:
+            self._ti_plus = np.power(li_acc / max(li_acc[0], eps64), reg)
+        if lj_acc[0] >= eps64:
+            self._tj_minus = np.power(lj_acc / max(lj_acc[0], eps64), reg)
 
     def init_estimation(self, info):
         return np.zeros(1, dtype=np.float32)

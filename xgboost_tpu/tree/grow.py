@@ -264,13 +264,8 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             fmask = level_mask[None, :]
 
         if constraint_sets is not None:
-            # allowed(n) = union of constraint sets containing path(n)
-            # (reference FeatureInteractionConstraintHost semantics)
             path = node_path[lo:lo + n_level]                    # [N,Fc]
-            compat = ~jnp.any(path[:, None, :] & ~constraint_sets[None, :, :],
-                              axis=2)                            # [N,S]
-            allowed = jnp.any(compat[:, :, None]
-                              & constraint_sets[None, :, :], axis=1)  # [N,Fc]
+            allowed = interaction_allowed_dev(path, constraint_sets)
             if col_split:  # local feature-mask slice of the global allowance
                 allowed = jax.lax.dynamic_slice(
                     allowed, (0, feat_off), (n_level, F))
@@ -490,6 +485,17 @@ def select_max_leaves(active: np.ndarray, is_leaf: np.ndarray,
         exists[2 * nid + 1] = exists[2 * nid + 2] = True
     was_split = active & ~is_leaf
     return exists, selected, not (selected == was_split).all()
+
+
+def interaction_allowed_dev(path_level: jnp.ndarray,
+                            cons: jnp.ndarray) -> jnp.ndarray:
+    """allowed(n) = union of constraint sets containing path(n) — the ONE
+    in-jit encoding of the constraint-set algebra (reference
+    ``FeatureInteractionConstraintHost``), shared by the scalar,
+    vector-leaf and paged level evaluators. path_level: [N, Fc];
+    cons: [S, Fc]."""
+    compat = ~jnp.any(path_level[:, None, :] & ~cons[None, :, :], axis=2)
+    return jnp.any(compat[:, :, None] & cons[None, :, :], axis=1)
 
 
 def interaction_allowed_host(path_level: np.ndarray,

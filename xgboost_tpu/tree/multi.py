@@ -11,8 +11,12 @@ TPU shape: the depth-wise jitted loop of grow.py, with the gradient matrix
 ``[n, K, 2]``, per-level histograms ``[N, F, B, K, 2]`` (one fused Pallas
 histogram pass per target), and the per-row margin delta accumulated as an
 ``[n, K]`` matrix via one ``[n, N] @ [N, K]`` one-hot matmul per level.
-Categorical splits, monotone and interaction constraints are not supported in
-this mode (the reference multi-target updater has the same restrictions).
+Interaction constraints apply per feature exactly as in the reference
+(``HistMultiEvaluator`` queries ``interaction_constraints_`` per candidate,
+``src/tree/hist/evaluate_splits.h:666-669``). Categorical splits and
+monotone constraints are not supported in this mode — the reference has the
+same restrictions (monotone: ``CHECK`` at
+``src/tree/updater_quantile_hist.cc:500``).
 """
 
 from __future__ import annotations
@@ -53,7 +57,9 @@ class GrownMulti(NamedTuple):
                      "has_missing"))
 def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
                 n_real_bins: jnp.ndarray, tree_mask: jnp.ndarray,
-                key: jax.Array, *, param: TrainParam, max_nbins: int,
+                key: jax.Array,
+                constraint_sets: Optional[jnp.ndarray] = None, *,
+                param: TrainParam, max_nbins: int,
                 hist_method: str = "auto",
                 axis_name: Optional[str] = None,
                 has_missing: bool = True) -> GrownMulti:
@@ -62,6 +68,13 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
     max_depth = param.max_depth
     max_nodes = 2 ** (max_depth + 1) - 1
     missing_bin = max_nbins - 1 if has_missing else max_nbins
+    if constraint_sets is not None:
+        # features used on the path to each node (interaction constraints —
+        # the reference's HistMultiEvaluator queries them per feature,
+        # src/tree/hist/evaluate_splits.h:666-669; same in-jit path/compat
+        # algebra as the scalar _grow)
+        F_cons = constraint_sets.shape[1]
+        node_path = jnp.zeros((max_nodes, F_cons), bool)
 
     def allreduce(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -113,6 +126,12 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
         else:
             fmask = level_mask[None, :]
 
+        if constraint_sets is not None:
+            from .grow import interaction_allowed_dev
+
+            path = node_path[lo:lo + n_level]                    # [N,Fc]
+            fmask = fmask & interaction_allowed_dev(path, constraint_sets)
+
         res = evaluate_splits_multi(hist, node_sum[lo:lo + n_level],
                                     n_real_bins, param, feature_mask=fmask,
                                     has_missing=has_missing)
@@ -135,6 +154,15 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
             jnp.where(can_split[:, None, None], res.left_sum, zero))
         node_sum = node_sum.at[ri].set(
             jnp.where(can_split[:, None, None], res.right_sum, zero))
+        if constraint_sets is not None:
+            path = node_path[lo:lo + n_level]
+            fsel = (jnp.arange(constraint_sets.shape[1],
+                               dtype=jnp.int32)[None, :]
+                    == jnp.maximum(res.feature, 0)[:, None]) \
+                & can_split[:, None]
+            child_path = path | fsel
+            node_path = node_path.at[li].set(child_path)
+            node_path = node_path.at[ri].set(child_path)
 
         if dense_delta:
             leaf_now = active[idx] & ~can_split
@@ -345,7 +373,8 @@ class MultiTargetGrower:
     def __init__(self, param: TrainParam, max_nbins: int, cuts,
                  hist_method: str = "auto",
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 has_missing: bool = True) -> None:
+                 has_missing: bool = True,
+                 constraint_sets: Optional[np.ndarray] = None) -> None:
         if param.grow_policy == "lossguide":
             raise NotImplementedError(
                 "multi_output_tree supports grow_policy=depthwise only; "
@@ -364,6 +393,8 @@ class MultiTargetGrower:
         self.hist_method = hist_method
         self.mesh = mesh
         self.has_missing = has_missing
+        self.constraint_sets = (None if constraint_sets is None
+                                else jnp.asarray(constraint_sets, bool))
         self._sharded_fn = None
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
@@ -377,6 +408,7 @@ class MultiTargetGrower:
         key = jax.random.fold_in(key, 0x5EED)
         if self.mesh is None:
             g = _grow_multi(bins, gpair, n_real_bins, tree_mask, key,
+                            self.constraint_sets,
                             param=self.param, max_nbins=self.max_nbins,
                             hist_method=self.hist_method, axis_name=None,
                             has_missing=self.has_missing)
@@ -429,7 +461,8 @@ class MultiTargetGrower:
             P = jax.sharding.PartitionSpec
 
             def inner(b, g, nr, tm, k):
-                return _grow_multi(b, g, nr, tm, k, param=self.param,
+                return _grow_multi(b, g, nr, tm, k, self.constraint_sets,
+                                   param=self.param,
                                    max_nbins=self.max_nbins,
                                    hist_method=self.hist_method,
                                    axis_name=DATA_AXIS,
@@ -492,7 +525,8 @@ class MultiLossguideGrower:
     def __init__(self, param: TrainParam, max_nbins: int, cuts,
                  hist_method: str = "auto",
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 has_missing: bool = True) -> None:
+                 has_missing: bool = True,
+                 constraint_sets: Optional[np.ndarray] = None) -> None:
         if mesh is not None:
             raise NotImplementedError(
                 "multi_output_tree lossguide does not support device "
@@ -506,6 +540,8 @@ class MultiLossguideGrower:
         self.hist_method = hist_method
         self.mesh = None
         self.has_missing = has_missing
+        self.constraint_sets = (None if constraint_sets is None
+                                else np.asarray(constraint_sets, bool))
         self._fns = None
 
     def _functions(self):
@@ -548,6 +584,8 @@ class MultiLossguideGrower:
         gn = np.zeros(cap, np.float32)
         gh = np.zeros((cap, K, 2), np.float64)
         depth_of = np.zeros(cap, np.int32)
+        cons = self.constraint_sets
+        paths = np.zeros((cap, F), bool) if cons is not None else None
         _EPS = 1e-6
 
         positions = jnp.zeros((n,), jnp.int32)
@@ -569,6 +607,13 @@ class MultiLossguideGrower:
             i1 = ids[1] if len(ids) > 1 else -1
             fm = np.stack([node_mask(int(depth_of[i])) if i >= 0
                            else np.zeros(F, bool) for i in (i0, i1)])
+            if paths is not None:
+                from .grow import interaction_allowed_host
+
+                fm[0] &= interaction_allowed_host(paths[i0][None], cons)[0]
+                if i1 >= 0:
+                    fm[1] &= interaction_allowed_host(paths[i1][None],
+                                                     cons)[0]
             psums = np.stack([gh[i0], gh[i1] if i1 >= 0
                               else np.zeros((K, 2))]).astype(np.float32)
             res = eval2(bins, gpair, positions, np.int32(i0), np.int32(i1),
@@ -610,6 +655,10 @@ class MultiLossguideGrower:
             pa[li] = pa[ri] = nid
             gh[li], gh[ri] = lsum, rsum
             depth_of[li] = depth_of[ri] = depth_of[nid] + 1
+            if paths is not None:
+                child_path = paths[nid].copy()
+                child_path[feat] = True
+                paths[li] = paths[ri] = child_path
             positions = apply1(
                 bins, positions, np.int32(nid), np.int32(feat),
                 np.int32(rbin), np.bool_(rdl), np.bool_(False),

@@ -40,6 +40,7 @@ import numpy as np
 from ..ops.histogram import build_hist
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits
+from ..utils.fetch import fetch_packed, fetch_struct
 from .grow import (GrownTree, TreeGrower, _sample_features,
                    interaction_allowed_host, monotone_child_bounds_host)
 from .lossguide import LossguideGrower
@@ -56,15 +57,465 @@ def _strip_hist_suffix(method: str) -> str:
     return method
 
 
-def _make_mesh_kernels(grower) -> "_MeshPageKernels":
-    """One construction path for every paged grower's mesh kernels — the
-    missing-bin sentinel derives from the grower's own (max_nbins,
+def _make_kernels(grower):
+    """One construction path for every paged grower's page kernels — mesh
+    growers get the shard_map variant, single-chip growers the plain one.
+    The missing-bin sentinel derives from the grower's own (max_nbins,
     has_missing) pair, the same formula as ``PagedBinnedMatrix.missing_bin``.
     """
     missing_bin = (grower.max_nbins - 1 if grower.has_missing
                    else grower.max_nbins)
-    return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
-                            _strip_hist_suffix(grower.hist_method))
+    if grower.mesh is not None:
+        return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
+                                _strip_hist_suffix(grower.hist_method))
+    return _PageKernels(grower.max_nbins, missing_bin,
+                        _strip_hist_suffix(grower.hist_method))
+
+
+def _rel_of(pos, lo, n_level, n_static):
+    """Level-relative node slot of each row (``n_static`` = not in level)."""
+    return jnp.where((pos >= lo) & (pos < lo + n_level), pos - lo,
+                     n_static).astype(jnp.int32)
+
+
+def _advance_rows(page, pos_pg, kind, arrs, cat_args, lo_prev, nl_prev,
+                  n_static, missing_bin):
+    """One page's position advance for an evaluated level — the traced core
+    shared by the plain and shard_map kernels. ``kind`` picks the dense
+    matmul advance (static level width <= 64) or the per-row gather walk
+    (deep levels, O(page) memory)."""
+    if kind == "dense":
+        feat_d, thr_d, dl_d, cs_d = arrs
+        rel_prev = _rel_of(pos_pg, lo_prev, nl_prev, n_static)
+        kw = ({} if not cat_args
+              else dict(is_cat=cat_args[0], cat_words=cat_args[1]))
+        return advance_positions_level(
+            page.astype(jnp.float32), pos_pg, rel_prev, feat_d, thr_d,
+            dl_d, cs_d, missing_bin, **kw)
+    sf_d, sb_d, dl_d, isf_d = arrs
+    kw = ({} if not cat_args
+          else dict(is_cat_split=cat_args[0], cat_words=cat_args[1]))
+    return update_positions(page, pos_pg, sf_d, sb_d, dl_d, isf_d,
+                            missing_bin, **kw)
+
+
+def _pack_level_splits(idx, can_split, n_static, n_level, split_feature,
+                       split_bin, default_left, max_nodes, lo,
+                       cat_state=None):
+    """Device split vectors for one freshly evaluated level — the inputs of
+    the NEXT pass's fused advance. ``n_static <= 64``: static-width padded
+    per-level vectors for the dense matmul advance; deeper: the full tree
+    arrays for the gather walk. ``cat_state`` is an optional
+    ``(is_cat_split, cat_words)`` pair of full host arrays."""
+    if n_static <= 64:
+        feat_pad = np.full(n_static, -1, np.int32)
+        bin_pad = np.zeros(n_static, np.int32)
+        dl_pad = np.zeros(n_static, bool)
+        cs_pad = np.zeros(n_static, bool)
+        feat_pad[:n_level] = split_feature[idx]
+        bin_pad[:n_level] = split_bin[idx]
+        dl_pad[:n_level] = default_left[idx]
+        cs_pad[:n_level] = can_split
+        cat = None
+        if cat_state is not None:
+            is_cat_split, cat_words = cat_state
+            ic_pad = np.zeros(n_static, bool)
+            cw_pad = np.zeros((n_static, cat_words.shape[1]), np.uint32)
+            ic_pad[:n_level] = is_cat_split[idx]
+            cw_pad[:n_level] = cat_words[idx]
+            cat = (jnp.asarray(ic_pad), jnp.asarray(cw_pad))
+        return {"kind": "dense", "lo": lo, "n_level": n_level,
+                "arrs": (jnp.asarray(feat_pad), jnp.asarray(bin_pad),
+                         jnp.asarray(dl_pad), jnp.asarray(cs_pad)),
+                "cat": cat}
+    is_split_full = np.zeros(max_nodes, bool)
+    is_split_full[idx] = can_split
+    cat = None
+    if cat_state is not None:
+        is_cat_split, cat_words = cat_state
+        cat = (jnp.asarray(is_cat_split), jnp.asarray(cat_words))
+    return {"kind": "walk", "lo": lo, "n_level": n_level,
+            "arrs": (jnp.asarray(split_feature), jnp.asarray(split_bin),
+                     jnp.asarray(default_left), jnp.asarray(is_split_full)),
+            "cat": cat}
+
+
+class _LevelEvaluator:
+    """Device-resident split evaluation + eval-feeding state for the paged
+    depthwise growers.
+
+    The round-3 paged tier pulled every level's split decisions to the host
+    (to update tree bookkeeping) and re-uploaded the split vectors for the
+    next advance — 8-10 blocking tunnel round trips per LEVEL. Here the
+    whole eval side lives on device, exactly like the resident ``_grow``:
+    one jitted program per level consumes the level histogram and the
+    carried state (active slots, parent sums, monotone bounds, constraint
+    paths, deep-walk tree arrays), emits the NEXT pass's advance vectors as
+    device arrays, and stashes the host-needed decision arrays. The host
+    pulls ALL levels' stashes in ONE packed transfer at tree end and replays
+    the bookkeeping. In-loop blocking syncs per tree: zero on a single host
+    (the cross-host allreduce still syncs per level when a communicator is
+    active, as it must).
+
+    Slot convention: every level uses the same static width ``n_static``
+    (the widest level); slot ``i`` of level ``d`` is heap node ``lo + i``,
+    and the children of slot ``i`` are slots ``2i``/``2i+1`` of the next
+    level. Pad slots carry ``active=False`` and can never win a split."""
+
+    def __init__(self, grower, n_static: int, max_nodes: int,
+                 deep: bool, n_real_bins) -> None:
+        self.param = grower.param
+        self.cat = grower.cat
+        self.monotone = getattr(grower, "monotone", None)
+        self.cons = getattr(grower, "constraint_sets", None)
+        self.has_missing = grower.has_missing
+        self.n_static = n_static
+        self.max_nodes = max_nodes
+        self.deep = deep
+        self.n_real_d = jnp.asarray(np.asarray(n_real_bins))
+        if self.cat is not None:
+            n_real_slots = (grower.max_nbins - 1 if grower.has_missing
+                            else grower.max_nbins)
+            self.n_words = (n_real_slots - 1) // 32 + 1
+        else:
+            self.n_words = 1
+        self._fn = None
+        self._init_fn = None
+
+    def init_state(self, root_sum):
+        """Level-0 state from the device root gradient sum."""
+        if self._init_fn is None:
+            n_static, max_nodes = self.n_static, self.max_nodes
+
+            def init(root):
+                active = jnp.zeros((n_static,), bool).at[0].set(True)
+                parent = jnp.zeros((n_static, 2),
+                                   jnp.float32).at[0].set(root)
+                mlo = jnp.full((n_static,), -jnp.inf, jnp.float32)
+                mhi = jnp.full((n_static,), jnp.inf, jnp.float32)
+                path = (jnp.zeros((n_static, self.cons.shape[1]), bool)
+                        if self.cons is not None else jnp.zeros((1,), bool))
+                if self.deep:
+                    full = (jnp.full((max_nodes,), -1, jnp.int32),
+                            jnp.zeros((max_nodes,), jnp.int32),
+                            jnp.zeros((max_nodes,), bool),
+                            jnp.zeros((max_nodes,), bool),
+                            jnp.zeros((max_nodes,), bool),
+                            jnp.zeros((max_nodes, self.n_words),
+                                      jnp.uint32))
+                else:
+                    full = jnp.zeros((1,), bool)
+                return (active, parent, mlo, mhi, path, full)
+
+            self._init_fn = jax.jit(init)
+        return self._init_fn(root_sum)
+
+    def __call__(self, hist, state, tree_mask, key, depth, lo, n_level):
+        """-> (stash dict of device arrays, next state, prev dict)."""
+        if self._fn is None:
+            self._fn = jax.jit(self._build())
+        stash, state_n, feat_v, bin_v, dl_v, cs_v, ic_v, cw_v = self._fn(
+            hist, state, tree_mask, key, depth, lo, n_level)
+        cat_prev = None if self.cat is None else (ic_v, cw_v)
+        if self.deep:
+            sf, sb, dl, isf, icf, cwf = state_n[5]
+            prev = {"kind": "walk", "lo": lo, "n_level": n_level,
+                    "arrs": (sf, sb, dl, isf),
+                    "cat": (icf, cwf) if self.cat is not None else None}
+        else:
+            prev = {"kind": "dense", "lo": lo, "n_level": n_level,
+                    "arrs": (feat_v, bin_v, dl_v, cs_v), "cat": cat_prev}
+        return stash, state_n, prev
+
+    def _build(self):
+        param = self.param
+        cat = self.cat
+        monotone = self.monotone
+        cons = self.cons
+        n_static = self.n_static
+        eps = float(max(param.gamma, _EPS))
+
+        def fn(hist, state, tree_mask, key, depth, lo, n_level):
+            from .grow import _sample_features
+            from .param import calc_weight as _cw
+
+            active, parent, mlo, mhi, path, full = state
+            level_key = jax.random.fold_in(key, depth)
+            fmask_level = _sample_features(level_key, tree_mask,
+                                           param.colsample_bylevel)
+            if param.colsample_bynode < 1.0:
+                # NOTE: draws n_static per-node masks (static width); the
+                # resident path draws n_level — same distribution, a
+                # different stream, so bynode paged runs are valid but not
+                # bit-identical to resident (none of the parity suites
+                # combine paged with colsample_bynode)
+                node_keys = jax.random.split(
+                    jax.random.fold_in(level_key, 1), n_static)
+                fmask = jax.vmap(
+                    lambda k: _sample_features(k, fmask_level,
+                                               param.colsample_bynode)
+                )(node_keys)
+            else:
+                fmask = fmask_level[None, :]
+            if cons is not None:
+                from .grow import interaction_allowed_dev
+
+                fmask = fmask & interaction_allowed_dev(path, cons)
+            mono_kw = {}
+            if monotone is not None:
+                mono_kw = dict(monotone=monotone, node_lower=mlo,
+                               node_upper=mhi)
+            res = evaluate_splits(hist, parent, self.n_real_d, param,
+                                  feature_mask=fmask, cat=cat,
+                                  has_missing=self.has_missing, **mono_kw)
+
+            can_split = active & (res.gain > eps) & jnp.isfinite(res.gain)
+            feat_v = jnp.where(can_split, res.feature, -1).astype(jnp.int32)
+            bin_v = jnp.where(can_split, res.bin, 0).astype(jnp.int32)
+            dl_v = can_split & res.default_left
+            stash = dict(gain=res.gain, feature=res.feature,
+                         bin=res.bin, default_left=res.default_left,
+                         left_sum=res.left_sum, right_sum=res.right_sum,
+                         can_split=can_split)
+            if cat is not None:
+                ic_v = can_split & res.is_cat
+                cw_v = jnp.where(ic_v[:, None], res.cat_words,
+                                 jnp.uint32(0))
+                stash["is_cat"] = res.is_cat
+                stash["cat_words"] = res.cat_words
+            else:
+                ic_v = jnp.zeros((n_static,), bool)
+                cw_v = jnp.zeros((n_static, self.n_words), jnp.uint32)
+
+            # ---- next level's state: slot j <- child j%2 of slot j//2 ----
+            j = jnp.arange(n_static)
+            half = j // 2
+            is_left = (j % 2) == 0
+            cs_h = can_split[half] & (j < 2 * n_level)
+            ls, rs = res.left_sum, res.right_sum
+            parent_n = jnp.where(
+                cs_h[:, None],
+                jnp.where(is_left[:, None], ls[half], rs[half]), 0.0)
+            active_n = cs_h
+            if monotone is not None:
+                wl = jnp.clip(_cw(ls[:, 0], ls[:, 1], param), mlo, mhi)
+                wr = jnp.clip(_cw(rs[:, 0], rs[:, 1], param), mlo, mhi)
+                mid = (wl + wr) * 0.5
+                mc = monotone[jnp.maximum(feat_v, 0)]
+                l_hi = jnp.where(mc > 0, mid, mhi)
+                r_lo = jnp.where(mc > 0, mid, mlo)
+                l_lo = jnp.where(mc < 0, mid, mlo)
+                r_hi = jnp.where(mc < 0, mid, mhi)
+                mlo_n = jnp.where(cs_h, jnp.where(is_left, l_lo[half],
+                                                  r_lo[half]), 0.0)
+                mhi_n = jnp.where(cs_h, jnp.where(is_left, l_hi[half],
+                                                  r_hi[half]), 0.0)
+            else:
+                mlo_n, mhi_n = mlo, mhi
+            if cons is not None:
+                fsel = (jnp.arange(cons.shape[1],
+                                   dtype=jnp.int32)[None, :]
+                        == jnp.maximum(feat_v, 0)[:, None]) \
+                    & can_split[:, None]
+                child_path = path | fsel
+                path_n = child_path[half]
+            else:
+                path_n = path
+            if self.deep:
+                sf, sb, dl, isf, icf, cwf = full
+                upd = jax.lax.dynamic_update_slice_in_dim
+                full_n = (upd(sf, feat_v, lo, 0), upd(sb, bin_v, lo, 0),
+                          upd(dl, dl_v, lo, 0), upd(isf, can_split, lo, 0),
+                          upd(icf, ic_v, lo, 0), upd(cwf, cw_v, lo, 0))
+            else:
+                full_n = full
+            state_n = (active_n, parent_n, mlo_n, mhi_n, path_n, full_n)
+            return stash, state_n, feat_v, bin_v, dl_v, can_split, ic_v, cw_v
+
+        return fn
+
+
+class _PageKernels:
+    """Single-chip per-page programs with IN-JIT page windowing.
+
+    The host passes the FULL per-row vectors plus a dynamic page offset and
+    every slice/rel/update happens inside the jitted program — against a
+    remote TPU each eager op between kernels is a tunnel round trip, and
+    the round-3 paged tier spent most of its 6.5 s/round in exactly that
+    op soup. Each level is ONE dispatch per page: the first level builds
+    the root histogram; later levels FUSE the previous level's position
+    advance with this level's histogram, so a page is read once per level
+    and a round costs (depth+1) passes instead of 2*depth. Histograms
+    accumulate into a donated device buffer across pages (reference: the
+    prefetch ring hides page IO behind compute,
+    ``src/data/sparse_page_source.h:180-200``; here dispatch latency is
+    the page IO)."""
+
+    def __init__(self, max_nbins: int, missing_bin: int,
+                 hist_kernel: str) -> None:
+        self.max_nbins = max_nbins
+        self.missing_bin = missing_bin
+        self.hist_kernel = hist_kernel
+        self._fns: dict = {}
+
+    def init_positions(self, n: int):
+        return jnp.zeros((n,), jnp.int32)
+
+    def _cached(self, key, build):
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = build()
+        return fn
+
+    def _builder(self, multi):
+        from ..ops.histogram import build_hist_multi
+
+        return build_hist_multi if multi else build_hist
+
+    def _acc_zeros(self, paged, gpair, n_nodes, multi):
+        shape = ((n_nodes, paged.n_features, self.max_nbins)
+                 + ((gpair.shape[1], 2) if multi else (2,)))
+        return jnp.zeros(shape, jnp.float32)
+
+    def level_hist(self, paged, gpair, positions, lo, n_level, n_static,
+                   multi=False):
+        """Histogram-only pass (the root level of each tree)."""
+        def build():
+            builder = self._builder(multi)
+
+            def fn(acc, page, gp, pos, s, lo_d, nl_d):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
+                rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                return acc + builder(page, gp_pg, rel, n_static,
+                                     self.max_nbins,
+                                     method=self.hist_kernel)
+
+            return jax.jit(fn, donate_argnums=0)
+
+        fn = self._cached(("hist", n_static, multi), build)
+        acc = self._acc_zeros(paged, gpair, n_static, multi)
+        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
+        for s, e, page in paged.pages():
+            acc = fn(acc, page, gpair, positions, jnp.int32(s), lo_d, nl_d)
+        return acc
+
+    def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
+                 multi=False):
+        """The fused pass: advance rows below the PREVIOUS level's splits,
+        then build THIS level's histogram — one dispatch per page."""
+        kind = prev["kind"]
+        cat = prev["cat"]
+        n_arr = len(prev["arrs"])
+        W = None if cat is None else int(cat[1].shape[1])
+
+        def build():
+            builder = self._builder(multi)
+
+            def fn(acc, page, gp, pos, s, lo_prev, nl_prev, lo_d, nl_d,
+                   *rest):
+                arrs, cat_args = rest[:n_arr], rest[n_arr:]
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
+                newp = _advance_rows(page, pos_pg, kind, arrs, cat_args,
+                                     lo_prev, nl_prev, n_static,
+                                     self.missing_bin)
+                pos = jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
+                rel = _rel_of(newp, lo_d, nl_d, n_static)
+                h = builder(page, gp_pg, rel, n_static, self.max_nbins,
+                            method=self.hist_kernel)
+                return pos, acc + h
+
+            return jax.jit(fn, donate_argnums=(0, 3))
+
+        fn = self._cached(("advhist", kind, n_static, multi, W), build)
+        acc = self._acc_zeros(paged, gpair, n_static, multi)
+        extra = prev["arrs"] + (() if cat is None else tuple(cat))
+        lo_prev = jnp.int32(prev["lo"])
+        nl_prev = jnp.int32(prev["n_level"])
+        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
+        for s, e, page in paged.pages():
+            positions, acc = fn(acc, page, gpair, positions, jnp.int32(s),
+                                lo_prev, nl_prev, lo_d, nl_d, *extra)
+        return positions, acc
+
+    def final_advance(self, paged, positions, prev, n_static):
+        """Advance-only pass for the LAST evaluated level (leaf routing)."""
+        kind = prev["kind"]
+        cat = prev["cat"]
+        n_arr = len(prev["arrs"])
+        W = None if cat is None else int(cat[1].shape[1])
+
+        def build():
+            def fn(page, pos, s, lo_prev, nl_prev, *rest):
+                arrs, cat_args = rest[:n_arr], rest[n_arr:]
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                newp = _advance_rows(page, pos_pg, kind, arrs, cat_args,
+                                     lo_prev, nl_prev, n_static,
+                                     self.missing_bin)
+                return jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
+
+            return jax.jit(fn, donate_argnums=1)
+
+        fn = self._cached(("adv", kind, n_static, W), build)
+        extra = prev["arrs"] + (() if cat is None else tuple(cat))
+        lo_prev = jnp.int32(prev["lo"])
+        nl_prev = jnp.int32(prev["n_level"])
+        for s, e, page in paged.pages():
+            positions = fn(page, positions, jnp.int32(s), lo_prev, nl_prev,
+                           *extra)
+        return positions
+
+    def pair_hist(self, paged, gpair, positions, i0, i1):
+        """Two-node (lossguide sibling pair) histogram over the pages."""
+        def build():
+            def fn(acc, page, gp, pos, s, i0_d, i1_d):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
+                rel = jnp.where(pos_pg == i0_d, 0,
+                                jnp.where(pos_pg == i1_d, 1, 2)
+                                ).astype(jnp.int32)
+                return acc + build_hist(page, gp_pg, rel, 2, self.max_nbins,
+                                        method=self.hist_kernel)
+
+            return jax.jit(fn, donate_argnums=0)
+
+        fn = self._cached(("hist2",), build)
+        acc = self._acc_zeros(paged, gpair, 2, False)
+        i0_d, i1_d = jnp.int32(i0), jnp.int32(i1)
+        for s, e, page in paged.pages():
+            acc = fn(acc, page, gpair, positions, jnp.int32(s), i0_d, i1_d)
+        return acc
+
+    def apply1(self, paged, positions, nid, feat, sbin, dleft, is_cat,
+               words, left_id, right_id, missing_bin):
+        """Lossguide one-node advance over the pages."""
+        from .lossguide import _apply1
+
+        W = int(np.asarray(words).shape[0])
+
+        def build():
+            def fn(page, pos, s, nid_d, feat_d, sbin_d, dl_d, ic_d,
+                   words_d, li_d, ri_d, mb_d):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                newp = _apply1(page, pos_pg, nid_d, feat_d, sbin_d, dl_d,
+                               ic_d, words_d, li_d, ri_d, mb_d)
+                return jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
+
+            return jax.jit(fn, donate_argnums=1)
+
+        fn = self._cached(("apply1", W), build)
+        words_d = jnp.asarray(words)
+        for s, e, page in paged.pages():
+            positions = fn(page, positions, jnp.int32(s), nid, feat, sbin,
+                           dleft, is_cat, words_d, left_id, right_id,
+                           missing_bin)
+        return positions
 
 
 def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
@@ -186,13 +637,86 @@ class _MeshPageKernels:
                    n_static: int, multi: bool = False):
         """One depthwise level histogram over the pages."""
         def rel_fn(pos_pg, lo_d, n_level_d):
-            return jnp.where(
-                (pos_pg >= lo_d) & (pos_pg < lo_d + n_level_d),
-                pos_pg - lo_d, n_static).astype(jnp.int32)
+            return _rel_of(pos_pg, lo_d, n_level_d, n_static)
 
         return self._hist_over_pages(
             paged, gpair, positions, rel_fn, n_static, multi,
             ("hist", n_static), (jnp.int32(lo), jnp.int32(n_level)))
+
+    def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
+                 multi=False):
+        """Fused advance(previous level) + histogram(this level), one
+        shard_map dispatch per page; shard-local partials accumulate and
+        psum once at level end."""
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        kind = prev["kind"]
+        cat = prev["cat"]
+        n_arr = len(prev["arrs"])
+        W = None if cat is None else int(cat[1].shape[1])
+        K = gpair.shape[1] if multi else None
+
+        def build_acc():
+            from ..ops.histogram import build_hist_multi
+
+            builder = build_hist_multi if multi else build_hist
+            gspec = P(axis, None, None) if multi else P(axis, None)
+
+            def inner(acc, page, gp, pos, s_loc, lo_prev, nl_prev, lo_d,
+                      nl_d, *rest):
+                arrs, cat_args = rest[:n_arr], rest[n_arr:]
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
+                newp = _advance_rows(page, pos_pg, kind, arrs, cat_args,
+                                     lo_prev, nl_prev, n_static,
+                                     self.missing_bin)
+                pos = jax.lax.dynamic_update_slice_in_dim(pos, newp, s_loc,
+                                                          0)
+                rel = _rel_of(newp, lo_d, nl_d, n_static)
+                h = builder(page, gp_pg, rel, n_static, self.max_nbins,
+                            method=self.hist_kernel)
+                return pos, acc + h[None]
+
+            acc_spec = P(axis, *([None] * (4 + int(multi))))
+            # scalars: s_loc, lo_prev, nl_prev, lo, n_level
+            n_extra = 5 + n_arr + (0 if W is None else 2)
+            return jax.jit(jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(acc_spec, P(axis, None), gspec, P(axis))
+                + (P(),) * n_extra,
+                out_specs=(P(axis), acc_spec)), donate_argnums=(0, 3))
+
+        def build_fin():
+            acc_spec = P(axis, *([None] * (4 + int(multi))))
+            return jax.jit(jax.shard_map(
+                lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
+                in_specs=(acc_spec,), out_specs=P()))
+
+        fn = self._cached(("advhist", kind, n_static, multi, W), build_acc)
+        fin = self._cached(("hist", n_static, "fin", K), build_fin)
+        shape = ((self.world, n_static, paged.n_features, self.max_nbins)
+                 + ((K, 2) if multi else (2,)))
+        acc = self._acc_zeros(shape)
+        extra = prev["arrs"] + (() if cat is None else tuple(cat))
+        lo_prev = jnp.int32(prev["lo"])
+        nl_prev = jnp.int32(prev["n_level"])
+        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
+        for s_loc, page in paged.pages_sharded(self.mesh, axis):
+            positions, acc = fn(acc, page, gpair, positions,
+                                jnp.int32(s_loc), lo_prev, nl_prev, lo_d,
+                                nl_d, *extra)
+        return positions, fin(acc)
+
+    def final_advance(self, paged, positions, prev, n_static):
+        """Advance-only pass for the LAST evaluated level (leaf routing)."""
+        if prev["kind"] == "dense":
+            return self.level_advance(paged, positions, prev["lo"],
+                                      prev["n_level"], *prev["arrs"],
+                                      cat=prev["cat"])
+        sf, sb, dl, isf = prev["arrs"]
+        return self.walk_advance(paged, positions, sf, sb, dl, isf,
+                                 cat=prev["cat"])
 
     def pair_hist(self, paged, gpair, positions, i0, i1):
         """Two-node (lossguide sibling pair) histogram over the pages."""
@@ -309,98 +833,6 @@ class _MeshPageKernels:
         return positions
 
 
-def _streamed_hist(paged, gpair: jnp.ndarray, rel_of, n_nodes: int,
-                   max_nbins: int, method: str,
-                   multi: bool = False) -> jnp.ndarray:
-    """One histogram pass over the pages + cross-host reduce. ``rel_of(s, e)``
-    maps a page's row span to its [e-s] node-slot vector. An empty local
-    shard contributes zeros so the collective stays symmetric (a rank with
-    no rows must still meet its peers in the allreduce). With ``multi`` the
-    gradient is [n, K, 2] and the histogram grows a K channel axis."""
-    from ..ops.histogram import build_hist_multi
-
-    builder = build_hist_multi if multi else build_hist
-    hist = None
-    for s, e, page in paged.pages():
-        h = builder(page, gpair[s:e], rel_of(s, e), n_nodes, max_nbins,
-                    method=method)
-        hist = h if hist is None else hist + h
-    if hist is None:
-        shape = ((n_nodes, paged.n_features, max_nbins, gpair.shape[1], 2)
-                 if multi else (n_nodes, paged.n_features, max_nbins, 2))
-        hist = jnp.zeros(shape, jnp.float32)
-    return _host_allreduce(hist)
-
-
-def _streamed_advance(paged, positions, rel_of, idx, can_split, n_static,
-                      n_level, split_feature, split_bin, default_left,
-                      max_nodes, missing_bin, cat_state=None, mk=None,
-                      lo=None):
-    """Advance positions one level with a pass over the pages — the shared
-    level-advance of the paged growers. ``n_static <= 64`` uses the dense
-    matmul advance with static-width padded split vectors (one program per
-    page shape); deeper levels use the per-row gather walk. ``cat_state``
-    is an optional ``(is_cat_split, cat_words)`` pair of full host arrays.
-    An empty local shard leaves positions unchanged (the histogram side
-    already contributed zeros symmetrically). With ``mk`` (mesh kernels)
-    the same padded split vectors feed the shard_map'd per-page advance
-    instead of the per-host loop."""
-    new_pos = []
-    if n_static <= 64:
-        feat_pad = np.full(n_static, -1, np.int32)
-        bin_pad = np.zeros(n_static, np.int32)
-        dl_pad = np.zeros(n_static, bool)
-        cs_pad = np.zeros(n_static, bool)
-        feat_pad[:n_level] = split_feature[idx]
-        bin_pad[:n_level] = split_bin[idx]
-        dl_pad[:n_level] = default_left[idx]
-        cs_pad[:n_level] = can_split
-        feat_d = jnp.asarray(feat_pad)
-        bin_d = jnp.asarray(bin_pad)
-        dl_d = jnp.asarray(dl_pad)
-        cs_d = jnp.asarray(cs_pad)
-        cat_kw = {}
-        if cat_state is not None:
-            is_cat_split, cat_words = cat_state
-            ic_pad = np.zeros(n_static, bool)
-            cw_pad = np.zeros((n_static, cat_words.shape[1]), np.uint32)
-            ic_pad[:n_level] = is_cat_split[idx]
-            cw_pad[:n_level] = cat_words[idx]
-            cat_kw = dict(is_cat=jnp.asarray(ic_pad),
-                          cat_words=jnp.asarray(cw_pad))
-        if mk is not None:
-            cat = (None if cat_state is None
-                   else (cat_kw["is_cat"], cat_kw["cat_words"]))
-            return mk.level_advance(paged, positions, lo, n_level, feat_d,
-                                    bin_d, dl_d, cs_d, cat=cat)
-        for s, e, page in paged.pages():
-            new_pos.append(advance_positions_level(
-                page.astype(jnp.float32), positions[s:e], rel_of(s, e),
-                feat_d, bin_d, dl_d, cs_d, missing_bin, **cat_kw))
-    else:  # deep levels: per-row gather walk, O(page) memory
-        sf_d = jnp.asarray(split_feature)
-        sb_d = jnp.asarray(split_bin)
-        dl_d = jnp.asarray(default_left)
-        is_split_full = np.zeros(max_nodes, bool)
-        is_split_full[idx] = can_split
-        isf_d = jnp.asarray(is_split_full)
-        cat_kw = {}
-        if cat_state is not None:
-            is_cat_split, cat_words = cat_state
-            cat_kw = dict(is_cat_split=jnp.asarray(is_cat_split),
-                          cat_words=jnp.asarray(cat_words))
-        if mk is not None:
-            cat = (None if cat_state is None
-                   else (cat_kw["is_cat_split"], cat_kw["cat_words"]))
-            return mk.walk_advance(paged, positions, sf_d, sb_d, dl_d,
-                                   isf_d, cat=cat)
-        for s, e, page in paged.pages():
-            new_pos.append(update_positions(
-                page, positions[s:e], sf_d, sb_d, dl_d, isf_d,
-                missing_bin, **cat_kw))
-    return jnp.concatenate(new_pos) if new_pos else positions
-
-
 class PagedGrower(TreeGrower):
     """Grows one tree from a ``PagedBinnedMatrix`` (host-resident bins)."""
 
@@ -417,28 +849,22 @@ class PagedGrower(TreeGrower):
                          constraint_sets=constraint_sets,
                          has_missing=has_missing, split_mode="row")
         self.mesh = mesh
-        self._mk: Optional[_MeshPageKernels] = None
+        self._mk = None
+        self._ev: Optional[_LevelEvaluator] = None
 
     def grow(self, paged, gpair: jnp.ndarray, n_real_bins,
              key: jax.Array) -> GrownTree:
         param = self.param
-        n = paged.n_rows
-        if self.mesh is not None:
-            # mesh-sharded paging: per-row vectors come padded to the mesh
-            # layout (core._make_sharded_train_state), pages stream sharded
-            n = gpair.shape[0]
-            if self._mk is None:
-                self._mk = _make_mesh_kernels(self)
+        # mesh-sharded paging: per-row vectors come padded to the mesh
+        # layout (core._make_sharded_train_state), pages stream sharded
+        n = gpair.shape[0]
+        if self._mk is None:
+            self._mk = _make_kernels(self)
         max_depth = param.max_depth
         max_nodes = 2 ** (max_depth + 1) - 1
-        max_nbins = self.max_nbins
-        missing_bin = paged.missing_bin
         cat = self.cat
         mono_np = (None if self.monotone is None
                    else np.asarray(self.monotone))
-        cons = (None if self.constraint_sets is None
-                else np.asarray(self.constraint_sets))
-        hist_kernel = _strip_hist_suffix(self.hist_method)
 
         n_real = np.asarray(n_real_bins)
         base_mask = jnp.asarray(n_real) > 0
@@ -446,7 +872,65 @@ class PagedGrower(TreeGrower):
                                      base_mask, param.colsample_bytree)
         key = jax.random.fold_in(key, 0x5EED)
 
-        # host-side tree bookkeeping (same heap layout as _grow)
+        # One static node width (2^(max_depth-1), the widest level) for
+        # EVERY per-page program: per-width jits would compile
+        # O(page_shapes x level_widths) programs, and XLA compilation on a
+        # single-core host costs ~50 s per program — the dominant cost of
+        # the first paged round. Pad nodes carry zero stats so they can
+        # never win a split.
+        n_static = 2 ** (max_depth - 1) if max_depth > 0 else 1
+        deep = n_static > 64
+        if self._ev is None:
+            self._ev = _LevelEvaluator(self, n_static, max_nodes, deep,
+                                       n_real)
+
+        # Multi-host external memory (reference: rabit row split over
+        # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): each process
+        # streams only ITS row shard's pages; the per-level histogram and
+        # the root gradient sum cross hosts through the communicator —
+        # the same two allreduces the mesh path does with lax.psum.
+        positions = self._mk.init_positions(n)  # device-resident [n]
+        root_sum = jnp.asarray(_host_allreduce(jnp.sum(gpair, axis=0)),
+                               jnp.float32)
+        state = self._ev.init_state(root_sum)
+
+        # ---- device loop: ZERO blocking host syncs on a single host ----
+        # per depth: one fused page pass (advance previous level + build
+        # this level's histogram) and one eval/state-update dispatch; the
+        # host pulls every level's decisions in ONE packed transfer at the
+        # end and replays the tree bookkeeping
+        stashes = []
+        prev = None
+        for depth in range(max_depth):
+            lo = 2 ** depth - 1
+            n_level = 2 ** depth
+            if prev is None:
+                hist = self._mk.level_hist(paged, gpair, positions, lo,
+                                           n_level, n_static)
+            else:
+                positions, hist = self._mk.adv_hist(
+                    paged, gpair, positions, prev, lo, n_level, n_static)
+            hist = _host_allreduce(hist)
+            stash, state, prev = self._ev(
+                hist, state, tree_mask, key, jnp.int32(depth),
+                jnp.int32(lo), jnp.int32(n_level))
+            stashes.append(stash)
+            # ONE-BEHIND early stop: the previous level's eval finished
+            # long before this level's page passes were even dispatched, so
+            # this tiny pull costs one RTT that overlaps the device's
+            # current work — and a tree that stops splitting early stops
+            # paying full page passes for the remaining depth budget (at
+            # most one dead level's passes are wasted)
+            if depth > 0 and not np.asarray(
+                    stashes[depth - 1]["can_split"]).any():
+                prev = None
+                break
+        if prev is not None:  # route rows below the deepest splits
+            positions = self._mk.final_advance(paged, positions, prev,
+                                               n_static)
+
+        # ---- host bookkeeping replay (one packed pull for the tree) ----
+        fetched = fetch_packed(stashes + [{"root": root_sum}])
         split_feature = np.full(max_nodes, -1, np.int32)
         split_bin = np.zeros(max_nodes, np.int32)
         default_left = np.zeros(max_nodes, bool)
@@ -455,122 +939,36 @@ class PagedGrower(TreeGrower):
         active[0] = True
         gain = np.zeros(max_nodes, np.float32)
         node_sum = np.zeros((max_nodes, 2), np.float32)
-        n_real_slots = max_nbins - 1 if self.has_missing else max_nbins
-        n_words = (n_real_slots - 1) // 32 + 1 if cat is not None else 1
+        node_sum[0] = fetched[-1]["root"]
         is_cat_split = np.zeros(max_nodes, bool)
-        cat_words = np.zeros((max_nodes, n_words), np.uint32)
+        cat_words = np.zeros((max_nodes, self._ev.n_words), np.uint32)
         if mono_np is not None:
             # per-node weight bounds (reference TreeEvaluator lower/upper)
             node_lower = np.full(max_nodes, -np.inf, np.float32)
             node_upper = np.full(max_nodes, np.inf, np.float32)
-        if cons is not None:
-            node_path = np.zeros((max_nodes, cons.shape[1]), bool)
-
-        # Multi-host external memory (reference: rabit row split over
-        # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): each process
-        # streams only ITS row shard's pages; the per-level histogram and
-        # the root gradient sum cross hosts through the communicator —
-        # the same two allreduces the mesh path does with lax.psum.
-        positions = (self._mk.init_positions(n) if self._mk is not None
-                     else jnp.zeros((n,), jnp.int32))  # device-resident [n]
-        node_sum[0] = np.asarray(_host_allreduce(jnp.sum(gpair, axis=0)))
-
-        # One static node width (2^(max_depth-1), the widest level) for
-        # EVERY per-page program: per-width jits would compile
-        # O(page_shapes x level_widths) programs, and XLA compilation on a
-        # single-core host costs ~50 s per program — the dominant cost of
-        # the first paged round. With a static width there are two hist +
-        # two advance + one eval program in total; the Pallas histogram's
-        # cost is flat in width, and pad nodes carry zero stats so they can
-        # never win a split.
-        n_static = 2 ** (max_depth - 1) if max_depth > 0 else 1
-
-        fmask_level = None
-        for depth in range(max_depth):
+        for depth, st in enumerate(fetched[:-1]):
             lo = 2 ** depth - 1
             n_level = 2 ** depth
-
-            # --- histogram: one streamed pass over the pages -------------
-            def rel_of(s, e, lo=lo, n_level=n_level):
-                return jnp.where(
-                    (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
-                    positions[s:e] - lo, n_static).astype(jnp.int32)
-
-            if self._mk is not None:
-                hist_full = _host_allreduce(self._mk.level_hist(
-                    paged, gpair, positions, lo, n_level, n_static))
-            else:
-                hist_full = _streamed_hist(paged, gpair, rel_of, n_static,
-                                           max_nbins, hist_kernel)
-
-            level_key = jax.random.fold_in(key, depth)
-            fmask_level = _sample_features(level_key, tree_mask,
-                                           param.colsample_bylevel)
-            if param.colsample_bynode < 1.0:
-                node_keys = jax.random.split(
-                    jax.random.fold_in(level_key, 1), n_level)
-                fmask = jax.vmap(
-                    lambda k: _sample_features(k, fmask_level,
-                                               param.colsample_bynode)
-                )(node_keys)
-                if n_level < n_static:  # static-width eval program
-                    fmask = jnp.concatenate(
-                        [fmask, jnp.zeros((n_static - n_level,
-                                           fmask.shape[1]), bool)])
-            else:
-                fmask = fmask_level[None, :]
-
-            if cons is not None:
-                allowed = interaction_allowed_host(
-                    node_path[lo:lo + n_level], cons)          # [N, Fc]
-                allowed_pad = np.zeros((n_static, allowed.shape[1]), bool)
-                allowed_pad[:n_level] = allowed
-                if fmask.shape[0] == 1:
-                    fmask = jnp.broadcast_to(fmask,
-                                             (n_static, fmask.shape[1]))
-                fmask = fmask & jnp.asarray(allowed_pad)
-
-            mono_kw = {}
-            if mono_np is not None:
-                lo_pad = np.full(n_static, -np.inf, np.float32)
-                hi_pad = np.full(n_static, np.inf, np.float32)
-                lo_pad[:n_level] = node_lower[lo:lo + n_level]
-                hi_pad[:n_level] = node_upper[lo:lo + n_level]
-                mono_kw = dict(monotone=self.monotone,
-                               node_lower=jnp.asarray(lo_pad),
-                               node_upper=jnp.asarray(hi_pad))
-
-            parent_pad = np.zeros((n_static, 2), np.float32)
-            parent_pad[:n_level] = node_sum[lo:lo + n_level]
-            res = evaluate_splits(hist_full, jnp.asarray(parent_pad),
-                                  jnp.asarray(n_real),
-                                  param, feature_mask=fmask, cat=cat,
-                                  has_missing=self.has_missing, **mono_kw)
-
-            res_gain = np.asarray(res.gain)[:n_level]
-            can_split = (active[lo:lo + n_level]
-                         & (res_gain > max(param.gamma, _EPS))
-                         & np.isfinite(res_gain))
+            can_split = st["can_split"][:n_level]
+            res_gain = st["gain"][:n_level]
             idx = lo + np.arange(n_level)
-            r_feat = np.asarray(res.feature)[:n_level]
-            r_bin = np.asarray(res.bin)[:n_level]
+            r_feat = st["feature"][:n_level]
             split_feature[idx] = np.where(can_split, r_feat, -1)
-            split_bin[idx] = np.where(can_split, r_bin, 0)
-            default_left[idx] = can_split \
-                & np.asarray(res.default_left)[:n_level]
+            split_bin[idx] = np.where(can_split, st["bin"][:n_level], 0)
+            default_left[idx] = can_split & st["default_left"][:n_level]
             is_leaf[idx] = ~can_split
             gain[idx] = np.where(can_split, res_gain, 0.0)
             if cat is not None:
-                r_iscat = np.asarray(res.is_cat)[:n_level]
-                r_words = np.asarray(res.cat_words)[:n_level]
+                r_iscat = st["is_cat"][:n_level]
                 is_cat_split[idx] = can_split & r_iscat
                 cat_words[idx] = np.where(
-                    (can_split & r_iscat)[:, None], r_words, np.uint32(0))
+                    (can_split & r_iscat)[:, None],
+                    st["cat_words"][:n_level], np.uint32(0))
             li, ri = 2 * idx + 1, 2 * idx + 2
             active[li] = can_split
             active[ri] = can_split
-            ls = np.asarray(res.left_sum)[:n_level]
-            rs = np.asarray(res.right_sum)[:n_level]
+            ls = st["left_sum"][:n_level]
+            rs = st["right_sum"][:n_level]
             node_sum[li] = np.where(can_split[:, None], ls, 0.0)
             node_sum[ri] = np.where(can_split[:, None], rs, 0.0)
             if mono_np is not None:
@@ -581,27 +979,8 @@ class PagedGrower(TreeGrower):
                 node_upper[li] = np.where(can_split, l_hi, 0.0)
                 node_lower[ri] = np.where(can_split, r_lo, 0.0)
                 node_upper[ri] = np.where(can_split, r_hi, 0.0)
-            if cons is not None:
-                fsel = ((np.arange(cons.shape[1])[None, :]
-                         == np.maximum(r_feat, 0)[:, None])
-                        & can_split[:, None])
-                child_path = node_path[lo:lo + n_level] | fsel
-                node_path[li] = child_path
-                node_path[ri] = child_path
-
             if not can_split.any():
-                # no node split at this level -> no deeper nodes exist;
-                # don't stream dead histogram passes for the rest of the
-                # depth budget (each costs a full pass over the pages)
                 break
-
-            # --- position advance: second streamed pass ------------------
-            positions = _streamed_advance(
-                paged, positions, rel_of, idx, can_split, n_static, n_level,
-                split_feature, split_bin, default_left, max_nodes,
-                missing_bin,
-                cat_state=(is_cat_split, cat_words) if cat is not None
-                else None, mk=self._mk, lo=lo)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[:, 0]),
                                    jnp.asarray(node_sum[:, 1]), param))
@@ -650,35 +1029,22 @@ class PagedLossguideGrower(LossguideGrower):
         self._mk: Optional[_MeshPageKernels] = None
 
     def _init_positions(self, n: int) -> jnp.ndarray:
-        if self.mesh is not None:
-            if self._mk is None:
-                self._mk = _make_mesh_kernels(self)
-            return self._mk.init_positions(n)
-        return jnp.zeros((n,), jnp.int32)
+        if self._mk is None:
+            self._mk = _make_kernels(self)
+        return self._mk.init_positions(n)
 
     def _functions(self):
         if self._fns is not None:
             return self._fns
-        from .lossguide import _apply1
-
-        hist_kernel = _strip_hist_suffix(self.hist_method)
-        apply1_jit = jax.jit(_apply1)
+        if self._mk is None:
+            self._mk = _make_kernels(self)
+        mk = self._mk
 
         def eval2(paged, gpair, positions, i0, i1, psums, fmask,
                   node_lower, node_upper, n_real_bins, bins_t=None):
-            del bins_t  # pages transpose per-page inside build_hist
-            if self._mk is not None:
-                hist = _host_allreduce(self._mk.pair_hist(
-                    paged, gpair, positions, i0, i1))
-            else:
-                def rel_of(s, e):
-                    return jnp.where(
-                        positions[s:e] == i0, 0,
-                        jnp.where(positions[s:e] == i1, 1,
-                                  2)).astype(jnp.int32)
-
-                hist = _streamed_hist(paged, gpair, rel_of, 2,
-                                      self.max_nbins, hist_kernel)
+            del bins_t  # pages window in-program inside the kernels
+            hist = _host_allreduce(mk.pair_hist(paged, gpair, positions,
+                                                i0, i1))
             return evaluate_splits(hist, psums, n_real_bins, self.param,
                                    feature_mask=fmask,
                                    monotone=self.monotone,
@@ -688,16 +1054,8 @@ class PagedLossguideGrower(LossguideGrower):
 
         def apply1(paged, positions, nid, feat, sbin, dleft, is_cat,
                    words, left_id, right_id, missing_bin):
-            if self._mk is not None:
-                return self._mk.apply1(paged, positions, nid, feat, sbin,
-                                       dleft, is_cat, words, left_id,
-                                       right_id, missing_bin)
-            new_pos = [apply1_jit(page, positions[s:e], nid, feat, sbin,
-                                  dleft, is_cat, words, left_id, right_id,
-                                  missing_bin)
-                       for s, e, page in paged.pages()]
-            # empty local shard: keep the [0] positions array as-is
-            return jnp.concatenate(new_pos) if new_pos else positions
+            return mk.apply1(paged, positions, nid, feat, sbin, dleft,
+                             is_cat, words, left_id, right_id, missing_bin)
 
         def root_sum(gpair):
             return _host_allreduce(jnp.sum(gpair, axis=0))
@@ -718,11 +1076,12 @@ class PagedMultiTargetGrower(MultiTargetGrower):
     sum cross hosts through the communicator."""
 
     def __init__(self, param, max_nbins, cuts, hist_method="auto",
-                 mesh=None, has_missing=True) -> None:
+                 mesh=None, has_missing=True, constraint_sets=None) -> None:
         # parent keeps mesh=None: its resident shard_map path must never
         # see paged data — the mesh drives _MeshPageKernels instead
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
-                         mesh=None, has_missing=has_missing)
+                         mesh=None, has_missing=has_missing,
+                         constraint_sets=constraint_sets)
         self.mesh = mesh
         self._mk: Optional[_MeshPageKernels] = None
 
@@ -731,13 +1090,12 @@ class PagedMultiTargetGrower(MultiTargetGrower):
 
         param = self.param
         n, K = gpair.shape[0], gpair.shape[1]
-        if self.mesh is not None and self._mk is None:
-            self._mk = _make_mesh_kernels(self)
+        if self._mk is None:
+            self._mk = _make_kernels(self)
         max_depth = param.max_depth
         max_nodes = 2 ** (max_depth + 1) - 1
-        max_nbins = self.max_nbins
-        missing_bin = paged.missing_bin
-        hist_kernel = _strip_hist_suffix(self.hist_method)
+        cons = (None if self.constraint_sets is None
+                else np.asarray(self.constraint_sets))
         n_real = np.asarray(n_real_bins)
         F = paged.n_features
         tree_mask = _sample_features(jax.random.fold_in(key, 0xC0),
@@ -753,27 +1111,25 @@ class PagedMultiTargetGrower(MultiTargetGrower):
         active[0] = True
         gain = np.zeros(max_nodes, np.float32)
         node_sum = np.zeros((max_nodes, K, 2), np.float32)
+        if cons is not None:
+            node_path = np.zeros((max_nodes, cons.shape[1]), bool)
         node_sum[0] = np.asarray(_host_allreduce(jnp.sum(gpair, axis=0)))
-        positions = (self._mk.init_positions(n) if self._mk is not None
-                     else jnp.zeros((n,), jnp.int32))
+        positions = self._mk.init_positions(n)
         n_static = 2 ** (max_depth - 1) if max_depth > 0 else 1
 
+        prev = None
         for depth in range(max_depth):
             lo = 2 ** depth - 1
             n_level = 2 ** depth
 
-            def rel_of(s, e, lo=lo, n_level=n_level):
-                return jnp.where(
-                    (positions[s:e] >= lo) & (positions[s:e] < lo + n_level),
-                    positions[s:e] - lo, n_static).astype(jnp.int32)
-
-            if self._mk is not None:
-                hist = _host_allreduce(self._mk.level_hist(
-                    paged, gpair, positions, lo, n_level, n_static,
-                    multi=True))
+            if prev is None:
+                hist = self._mk.level_hist(paged, gpair, positions, lo,
+                                           n_level, n_static, multi=True)
             else:
-                hist = _streamed_hist(paged, gpair, rel_of, n_static,
-                                      max_nbins, hist_kernel, multi=True)
+                positions, hist = self._mk.adv_hist(
+                    paged, gpair, positions, prev, lo, n_level, n_static,
+                    multi=True)
+            hist = _host_allreduce(hist)
 
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
@@ -792,12 +1148,23 @@ class PagedMultiTargetGrower(MultiTargetGrower):
             else:
                 fmask = fmask_level[None, :]
 
+            if cons is not None:
+                allowed = interaction_allowed_host(
+                    node_path[lo:lo + n_level], cons)          # [N, Fc]
+                allowed_pad = np.zeros((n_static, allowed.shape[1]), bool)
+                allowed_pad[:n_level] = allowed
+                if fmask.shape[0] == 1:
+                    fmask = jnp.broadcast_to(fmask,
+                                             (n_static, fmask.shape[1]))
+                fmask = fmask & jnp.asarray(allowed_pad)
+
             parent_pad = np.zeros((n_static, K, 2), np.float32)
             parent_pad[:n_level] = node_sum[lo:lo + n_level]
             res = evaluate_splits_multi(hist, jnp.asarray(parent_pad),
                                         jnp.asarray(n_real), param,
                                         feature_mask=fmask,
                                         has_missing=self.has_missing)
+            res = fetch_struct(res)  # ONE packed pull of the decisions
 
             res_gain = np.asarray(res.gain)[:n_level]
             can_split = (active[lo:lo + n_level]
@@ -819,14 +1186,26 @@ class PagedMultiTargetGrower(MultiTargetGrower):
             rs = np.asarray(res.right_sum)[:n_level]
             node_sum[li] = np.where(can_split[:, None, None], ls, 0.0)
             node_sum[ri] = np.where(can_split[:, None, None], rs, 0.0)
+            if cons is not None:
+                r_feat = np.asarray(res.feature)[:n_level]
+                fsel = ((np.arange(cons.shape[1])[None, :]
+                         == np.maximum(r_feat, 0)[:, None])
+                        & can_split[:, None])
+                child_path = node_path[lo:lo + n_level] | fsel
+                node_path[li] = child_path
+                node_path[ri] = child_path
 
             if not can_split.any():
+                prev = None
                 break
 
-            positions = _streamed_advance(
-                paged, positions, rel_of, idx, can_split, n_static, n_level,
-                split_feature, split_bin, default_left, max_nodes,
-                missing_bin, mk=self._mk, lo=lo)
+            prev = _pack_level_splits(
+                idx, can_split, n_static, n_level, split_feature, split_bin,
+                default_left, max_nodes, lo)
+
+        if prev is not None:  # route rows below the deepest splits
+            positions = self._mk.final_advance(paged, positions, prev,
+                                               n_static)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[..., 0]),
                                    jnp.asarray(node_sum[..., 1]),
