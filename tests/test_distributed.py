@@ -268,3 +268,101 @@ def test_aggregator_helpers():
         assert out[("sum", r)][0] == 6.0          # 1+2+3
         assert out[("ratio", r)] == 1.0           # 6 / 6
         assert out[("awl", r)] == "labels!"       # broadcast from rank 0
+
+
+def test_col_split_lossguide_matches_single_device(mesh):
+    """Round-4 col-split cap lift: grow_policy=lossguide under a feature-
+    sharded mesh (per-split best-split exchange + decision-psum advance,
+    lossguide._eval2_col/_apply1_col)."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(3000, 13).astype(np.float32)
+    y = (X @ rng.randn(13) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "grow_policy": "lossguide",
+              "max_leaves": 14, "max_depth": 0, "eta": 0.3}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+        assert int(t1.is_leaf.sum()) <= 14
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_lossguide_monotone(mesh):
+    rng = np.random.RandomState(9)
+    X = rng.randn(2500, 6).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] + 0.1 * rng.randn(2500)).astype(np.float32)
+    params = {"objective": "reg:squarederror", "grow_policy": "lossguide",
+              "max_leaves": 10, "max_depth": 0,
+              "monotone_constraints": "(1,0,0,0,0,0)"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+    # monotonicity holds on the col-split model
+    base = np.zeros((50, 6), np.float32)
+    grid = np.linspace(-2, 2, 50).astype(np.float32)
+    Xg = base.copy()
+    Xg[:, 0] = grid
+    p = b2.predict(xgb.DMatrix(Xg))
+    assert (np.diff(p) >= -1e-5).all()
+
+
+def test_col_split_multi_output_tree_matches_single_device(mesh):
+    """Round-4 col-split cap lift: vector-leaf trees under a feature-
+    sharded mesh (multi._grow_multi split_mode=col best-split exchange)."""
+    rng = np.random.RandomState(11)
+    X = rng.randn(3000, 13).astype(np.float32)
+    Y = np.stack([X @ rng.randn(13), X @ rng.randn(13)],
+                 axis=1).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4,
+              "multi_strategy": "multi_output_tree"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=Y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=Y), 4, verbose_eval=False)
+    assert len(b2.gbm.trees) == 4
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_multi_output_deep_tree(mesh):
+    # depth 8 -> the update_positions gather walk with decision psum
+    rng = np.random.RandomState(13)
+    X = rng.randn(2500, 5).astype(np.float32)
+    Y = np.stack([X @ rng.randn(5), X @ rng.randn(5)],
+                 axis=1).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 8,
+              "min_child_weight": 4.0,
+              "multi_strategy": "multi_output_tree"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=Y), 2, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=Y), 2, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_col_split_model_loads_without_mesh(mesh, tmp_path):
+    # the split mode describes the training data layout, not the model:
+    # a col-trained model must load for prediction with no mesh around
+    rng = np.random.RandomState(17)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    b = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                   "mesh": mesh, "data_split_mode": "col"},
+                  xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+    path = str(tmp_path / "col.json")
+    b.save_model(path)
+    b2 = xgb.Booster(model_file=path)
+    np.testing.assert_array_equal(b2.predict(xgb.DMatrix(X)),
+                                  b.predict(xgb.DMatrix(X)))

@@ -230,7 +230,6 @@ class GBTree:
                     from ..tree.lossguide import LossguideGrower
 
                     cls = LossguideGrower
-                kw = {}
             elif paged:
                 from ..tree.paged import PagedGrower
 
@@ -433,7 +432,8 @@ class GBTree:
                 param, binned.max_nbins, binned.cuts,
                 hist_method=self.hist_method, mesh=self.mesh,
                 has_missing=binned.has_missing,
-                constraint_sets=self.constraint_sets)
+                constraint_sets=self.constraint_sets,
+                split_mode=self.split_mode)
         grower = self._grower
         n_real = binned.n_real_bins()
         delta = jnp.zeros(gpair.shape[:2], jnp.float32)

@@ -414,16 +414,27 @@ class Booster:
                     "data_split_mode=col requires a mesh (in-process column "
                     "sharding) or an active distributed communicator "
                     "(vertical federated training)")
-            if (tm in ("approx", "exact")
-                    or self.tree_param.grow_policy == "lossguide"
-                    or ms == "multi_output_tree"):
+            if tm in ("approx", "exact"):
                 raise NotImplementedError(
-                    "data_split_mode=col supports tree_method=hist with "
-                    "depthwise scalar trees only")
-            if self.ctx.mesh is None and name != "gbtree":
+                    "data_split_mode=col supports tree_method=hist only")
+            if (self.tree_param.grow_policy == "lossguide"
+                    and ms == "multi_output_tree"):
                 raise NotImplementedError(
-                    "vertical federated column split supports "
-                    "booster=gbtree only")
+                    "multi_output_tree lossguide does not support "
+                    "data_split_mode=col")
+            if self.ctx.mesh is None:
+                # vertical federated (communicator ranks, no mesh): the
+                # host-level decision-bit protocol covers depthwise scalar
+                # gbtree only; in-process col meshes cover the rest
+                if (self.tree_param.grow_policy == "lossguide"
+                        or ms == "multi_output_tree"):
+                    raise NotImplementedError(
+                        "vertical federated column split supports "
+                        "depthwise scalar trees only")
+                if name != "gbtree":
+                    raise NotImplementedError(
+                        "vertical federated column split supports "
+                        "booster=gbtree only")
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
@@ -1389,6 +1400,16 @@ class Booster:
         cfg = obj.get("config", {})
         self.tree_param = TrainParam.from_dict(cfg.get("tree_param", {}))
         self.learner_params.update(cfg.get("learner_params", {}))
+        if self.learner_params.get("data_split_mode", "row") == "col":
+            # the split mode describes the TRAINING data layout, not the
+            # model (in the reference it lives on the DMatrix) — a model
+            # trained under column split must load for prediction in an
+            # environment with no mesh or communicator; continuation
+            # training re-specifies the mode with the new data
+            from .parallel import collective
+
+            if self.ctx.mesh is None and not collective.is_distributed():
+                self.learner_params["data_split_mode"] = "row"
         self.attributes_ = dict(learner.get("attributes", {}))
         self.feature_names = learner.get("feature_names") or None
         self.feature_types = learner.get("feature_types") or None

@@ -507,22 +507,9 @@ class _LambdaRankBase(Objective):
             kpos, ti_d, tj_d = 0, None, None
             if unbiased:
                 # device unbiased LambdaMART (reference lambdarank_obj.cu):
-                # same kpos rule as the host loop; ti+/tj- live on the host
-                # in f64 (serialization + the normalize/damp update) and
-                # ride into the kernel as f32
-                max_gs = int(lay["L"])  # layout width == max group size
-                if method == "topk":
-                    kpos = int(self.params.get(
-                        "lambdarank_num_pair_per_sample", max_gs))
-                else:
-                    kpos = min(max_gs, 32)
-                kpos = max(kpos, 1)
-                if (getattr(self, "_ti_plus", None) is None
-                        or len(self._ti_plus) != kpos):
-                    self._ti_plus = np.ones(kpos, np.float64)
-                    self._tj_minus = np.ones(kpos, np.float64)
-                self._ti_plus = np.asarray(self._ti_plus, np.float64)
-                self._tj_minus = np.asarray(self._tj_minus, np.float64)
+                # ti+/tj- live on the host in f64 (serialization + the
+                # normalize/damp update) and ride into the kernel as f32
+                kpos = self._position_bias_state(method, int(lay["L"]))
                 ti_d = jnp.asarray(self._ti_plus, jnp.float32)
                 tj_d = jnp.asarray(self._tj_minus, jnp.float32)
             if method == "mean":
@@ -569,17 +556,8 @@ class _LambdaRankBase(Objective):
             # from the accumulated pair costs. k positions tracked:
             # truncation level under topk, else min(max group, 32).
             sizes = np.diff(ptr)
-            max_gs = int(sizes.max(initial=1))
-            if method == "topk":
-                kpos = int(self.params.get(
-                    "lambdarank_num_pair_per_sample", max_gs))
-            else:
-                kpos = min(max_gs, 32)
-            kpos = max(kpos, 1)
-            if (getattr(self, "_ti_plus", None) is None
-                    or len(self._ti_plus) != kpos):
-                self._ti_plus = np.ones(kpos, np.float64)
-                self._tj_minus = np.ones(kpos, np.float64)
+            kpos = self._position_bias_state(
+                method, int(sizes.max(initial=1)))
             li_acc = np.zeros(kpos, np.float64)
             lj_acc = np.zeros(kpos, np.float64)
             eps64 = np.finfo(np.float64).eps
@@ -641,6 +619,24 @@ class _LambdaRankBase(Objective):
             h *= w_row
         gpair = np.stack([g, h], axis=-1).astype(np.float32)
         return jnp.asarray(gpair)[:, None, :]
+
+    def _position_bias_state(self, method: str, max_gs: int) -> int:
+        """The ONE kpos rule + ti+/tj- (re)initialization, shared by the
+        device and host unbiased paths (k positions tracked: truncation
+        level under topk, else min(max group, 32))."""
+        if method == "topk":
+            kpos = int(self.params.get(
+                "lambdarank_num_pair_per_sample", max_gs))
+        else:
+            kpos = min(max_gs, 32)
+        kpos = max(kpos, 1)
+        if (getattr(self, "_ti_plus", None) is None
+                or len(self._ti_plus) != kpos):
+            self._ti_plus = np.ones(kpos, np.float64)
+            self._tj_minus = np.ones(kpos, np.float64)
+        self._ti_plus = np.asarray(self._ti_plus, np.float64)
+        self._tj_minus = np.asarray(self._tj_minus, np.float64)
+        return kpos
 
     def _update_position_bias(self, li_acc, lj_acc):
         """reference LambdaRankUpdatePositionBias: normalize the

@@ -68,6 +68,91 @@ def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
                            cat=cat, has_missing=has_missing)
 
 
+def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
+               node_lower, node_upper, n_real_bins, bins_t, monotone, cat, *,
+               param: TrainParam, max_nbins: int, hist_method: str,
+               axis_name: str, has_missing: bool = True):
+    """Column-split ``_eval2``: this shard's bins hold global features
+    [off, off + F); rows replicate so the two-node histogram needs no
+    psum, each shard evaluates ITS features (local slices of the
+    replicated global monotone/cat arrays), and the per-shard best goes
+    through the scalar ``_grow`` best-split exchange — all-gather the
+    gains, psum-select the winner's fields with its feature id globalised
+    (reference ``HistEvaluator::EvaluateSplits`` column-split all-gather,
+    src/tree/hist/evaluate_splits.h:294-409)."""
+    F = bins.shape[1]
+    my = jax.lax.axis_index(axis_name)
+    feat_off = my * F
+    mono_loc = (None if monotone is None
+                else jax.lax.dynamic_slice(monotone, (feat_off,), (F,)))
+    cat_loc = (None if cat is None else CatInfo(
+        is_cat=jax.lax.dynamic_slice(cat.is_cat, (feat_off,), (F,)),
+        is_onehot=jax.lax.dynamic_slice(cat.is_onehot, (feat_off,), (F,))))
+    rel = jnp.where(positions == id0, 0,
+                    jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
+    hist = build_hist(bins, gpair, rel, 2, max_nbins, method=hist_method,
+                      bins_t=bins_t)
+    res = evaluate_splits(hist, parent_sums, n_real_bins, param,
+                          feature_mask=fmask, monotone=mono_loc,
+                          node_lower=node_lower, node_upper=node_upper,
+                          cat=cat_loc, has_missing=has_missing)
+    gains = jax.lax.all_gather(res.gain, axis_name)          # [P, 2]
+    mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
+
+    def _sel(x):
+        return jax.lax.psum(jnp.where(mine, x, jnp.zeros_like(x)),
+                            axis_name)
+
+    def _sel2(x):
+        return jax.lax.psum(jnp.where(mine[:, None], x, jnp.zeros_like(x)),
+                            axis_name)
+
+    repl = dict(
+        gain=jnp.max(gains, axis=0),
+        feature=_sel(res.feature + my * F),
+        bin=_sel(res.bin),
+        default_left=_sel(res.default_left.astype(jnp.int32)) > 0,
+        left_sum=_sel2(res.left_sum),
+        right_sum=_sel2(res.right_sum))
+    if cat is not None:
+        # bitcast (not astype): the winner's uint32 bitmask words must
+        # cross the psum bit-exactly (one nonzero term per node)
+        repl["is_cat"] = _sel(res.is_cat.astype(jnp.int32)) > 0
+        repl["cat_words"] = jax.lax.bitcast_convert_type(
+            _sel2(jax.lax.bitcast_convert_type(res.cat_words, jnp.int32)),
+            jnp.uint32)
+    return res._replace(**repl)
+
+
+def _apply1_col(bins, positions, nid, feat, sbin, dleft, is_cat, words,
+                left_id, right_id, missing_bin, *, axis_name: str):
+    """One-node advance under column split: only the shard owning the
+    winning GLOBAL feature can read its bins; one boolean psum fans its
+    routing decisions out (the reference partition-bitvector broadcast,
+    src/tree/common_row_partitioner.h)."""
+    F = bins.shape[1]
+    my = jax.lax.axis_index(axis_name)
+    lf = feat - my * F
+    owned = (lf >= 0) & (lf < F)
+    safe = jnp.clip(lf, 0, F - 1)
+    at_node = positions == nid
+    b = jnp.take_along_axis(
+        bins, jnp.full((bins.shape[0], 1), safe, jnp.int32),
+        axis=1)[:, 0].astype(jnp.int32)
+    missing = b == missing_bin
+    go_right = b > sbin
+    go_right = jnp.where(is_cat,
+                         cat_goes_right(b, jnp.broadcast_to(
+                             words[None, :], (bins.shape[0],
+                                              words.shape[0]))),
+                         go_right)
+    go_right = jnp.where(missing, ~dleft, go_right)
+    contrib = at_node & owned & go_right
+    go_right = jax.lax.psum(contrib.astype(jnp.int32), axis_name) > 0
+    child = jnp.where(go_right, right_id, left_id)
+    return jnp.where(at_node, child, positions)
+
+
 def _apply1(bins, positions, nid, feat, sbin, dleft, is_cat, words,
             left_id, right_id, missing_bin):
     """Advance rows sitting at `nid` to its fresh children."""
@@ -128,10 +213,14 @@ class LossguideGrower:
                  mesh: Optional[jax.sharding.Mesh] = None,
                  monotone: Optional[np.ndarray] = None,
                  constraint_sets: Optional[np.ndarray] = None,
-                 has_missing: bool = True) -> None:
+                 has_missing: bool = True,
+                 split_mode: str = "row") -> None:
         if param.max_leaves <= 0 and param.max_depth <= 0:
             raise ValueError(
                 "grow_policy=lossguide needs max_leaves > 0 or max_depth > 0")
+        if split_mode == "col" and mesh is None:
+            raise ValueError("data_split_mode=col requires a mesh")
+        self.split_mode = split_mode
         self.param = param
         self.max_nbins = max_nbins
         self.has_missing = has_missing
@@ -154,6 +243,26 @@ class LossguideGrower:
         else:
             self.cat = None
             self.n_words = 1
+        if split_mode == "col":
+            # bins pad the feature axis to a multiple of the mesh width;
+            # the replicated GLOBAL constraint/cat arrays must match so
+            # each shard's slice [off, off + F_loc) stays in range
+            # (padding columns have n_real == 0, never winning a split)
+            from ..context import DATA_AXIS
+
+            world = mesh.shape.get(DATA_AXIS, 1)
+            F = int(np.asarray(cuts.is_cat()).shape[0])
+            pad = (-F) % world
+            if pad:
+                if self.monotone is not None:
+                    self.monotone = jnp.pad(self.monotone, (0, pad))
+                if self.constraint_sets is not None:
+                    self.constraint_sets = np.pad(self.constraint_sets,
+                                                  ((0, 0), (0, pad)))
+                if self.cat is not None:
+                    self.cat = CatInfo(
+                        is_cat=jnp.pad(self.cat.is_cat, (0, pad)),
+                        is_onehot=jnp.pad(self.cat.is_onehot, (0, pad)))
         self._fns = None
 
     # ------------------------------------------------------------- jit setup
@@ -172,6 +281,34 @@ class LossguideGrower:
                          jax.jit(functools.partial(_root_sum,
                                                    axis_name=None)),
                          jax.jit(lambda lv, pos: lv[pos]))
+        elif self.split_mode == "col":
+            from ..context import DATA_AXIS
+            P = jax.sharding.PartitionSpec
+
+            ev = functools.partial(_eval2_col, monotone=self.monotone,
+                                   cat=self.cat, axis_name=DATA_AXIS, **kw)
+            # features sharded, rows replicated; outputs come out
+            # replicated through the best-split exchange (the static
+            # replication checker can't prove it — check_vma off, as in
+            # the depthwise col grower)
+            sharded_eval = jax.jit(jax.shard_map(
+                ev, mesh=self.mesh,
+                in_specs=(P(None, DATA_AXIS), P(), P(), P(), P(), P(),
+                          P(None, DATA_AXIS), P(), P(), P(DATA_AXIS),
+                          P(DATA_AXIS, None)),
+                out_specs=P(), check_vma=False))
+            sharded_apply = jax.jit(jax.shard_map(
+                functools.partial(_apply1_col, axis_name=DATA_AXIS),
+                mesh=self.mesh,
+                in_specs=(P(None, DATA_AXIS), P()) + (P(),) * 9,
+                out_specs=P(), check_vma=False))
+            # rows replicate: a local sum IS the global root sum, and the
+            # leaf gather runs on replicated arrays
+            sharded_root = jax.jit(lambda g: jnp.sum(g, axis=0))
+            sharded_gather = jax.jit(lambda lv, pos: lv[pos])
+            self._fns = (sharded_eval, sharded_apply, sharded_root,
+                         sharded_gather)
+            return self._fns
         else:
             from ..context import DATA_AXIS
             P = jax.sharding.PartitionSpec

@@ -54,7 +54,7 @@ class GrownMulti(NamedTuple):
 @functools.partial(
     jax.jit,
     static_argnames=("param", "max_nbins", "hist_method", "axis_name",
-                     "has_missing"))
+                     "has_missing", "split_mode"))
 def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
                 n_real_bins: jnp.ndarray, tree_mask: jnp.ndarray,
                 key: jax.Array,
@@ -62,12 +62,21 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
                 param: TrainParam, max_nbins: int,
                 hist_method: str = "auto",
                 axis_name: Optional[str] = None,
-                has_missing: bool = True) -> GrownMulti:
+                has_missing: bool = True,
+                split_mode: str = "row") -> GrownMulti:
+    """``split_mode="col"``: features sharded over ``axis_name``, rows
+    replicated — per level each shard evaluates ITS features, an
+    all-gather picks the winning shard per node, and one boolean psum
+    fans the owner's routing decisions out (the same best-split exchange
+    as the scalar ``_grow``; reference ``HistMultiEvaluator`` under
+    column split gathers expand entries, evaluate_splits.h:580-626)."""
     n, F = bins.shape
     K = gpair.shape[1]
     max_depth = param.max_depth
     max_nodes = 2 ** (max_depth + 1) - 1
     missing_bin = max_nbins - 1 if has_missing else max_nbins
+    col_split = split_mode == "col"
+    feat_off = (jax.lax.axis_index(axis_name) * F if col_split else None)
     if constraint_sets is not None:
         # features used on the path to each node (interaction constraints —
         # the reference's HistMultiEvaluator queries them per feature,
@@ -77,7 +86,10 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
         node_path = jnp.zeros((max_nodes, F_cons), bool)
 
     def allreduce(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+        # column split: every shard already sees all rows -> no hist psum
+        if axis_name is None or col_split:
+            return x
+        return jax.lax.psum(x, axis_name)
 
     split_feature = jnp.full((max_nodes,), -1, jnp.int32)
     split_bin = jnp.zeros((max_nodes,), jnp.int32)
@@ -130,11 +142,42 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
             from .grow import interaction_allowed_dev
 
             path = node_path[lo:lo + n_level]                    # [N,Fc]
-            fmask = fmask & interaction_allowed_dev(path, constraint_sets)
+            allowed = interaction_allowed_dev(path, constraint_sets)
+            if col_split:  # local feature-mask slice of the global allow
+                allowed = jax.lax.dynamic_slice(
+                    allowed, (0, feat_off), (n_level, F))
+            fmask = fmask & allowed
 
         res = evaluate_splits_multi(hist, node_sum[lo:lo + n_level],
                                     n_real_bins, param, feature_mask=fmask,
                                     has_missing=has_missing)
+
+        if col_split:
+            # best-split exchange (scalar _grow protocol): all-gather the
+            # per-shard best gains, pick the winner per node, psum-select
+            # its split fields (feature id globalised by the shard offset)
+            my = jax.lax.axis_index(axis_name)
+            gains = jax.lax.all_gather(res.gain, axis_name)      # [P, N]
+            mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
+
+            def _sel(x):
+                return jax.lax.psum(
+                    jnp.where(mine, x, jnp.zeros_like(x)), axis_name)
+
+            def _sel3(x):
+                return jax.lax.psum(
+                    jnp.where(mine[:, None, None], x, jnp.zeros_like(x)),
+                    axis_name)
+
+            local_feat, local_bin = res.feature, res.bin
+            local_dl = res.default_left
+            res = res._replace(
+                gain=jnp.max(gains, axis=0),
+                feature=_sel(res.feature + my * F),
+                bin=_sel(res.bin),
+                default_left=_sel(res.default_left.astype(jnp.int32)) > 0,
+                left_sum=_sel3(res.left_sum),
+                right_sum=_sel3(res.right_sum))
 
         can_split = (active[lo:lo + n_level]
                      & (res.gain > max(param.gamma, _EPS))
@@ -175,7 +218,16 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
                 (((1,), (0,)), ((), ())),
                 precision=jax.lax.Precision.HIGHEST)
 
-        if n_level <= DENSE_LEVEL_MAX:
+        if col_split and n_level <= DENSE_LEVEL_MAX:
+            # only the owning shard routes rows; one boolean psum fans the
+            # decisions out (reference partition-bitvector broadcast)
+            positions = advance_positions_level(
+                bins_f32, positions, rel,
+                jnp.where(can_split & mine, local_feat, -1),
+                jnp.where(can_split & mine, local_bin, 0),
+                can_split & mine & local_dl, can_split, missing_bin,
+                decision_axis=axis_name)
+        elif n_level <= DENSE_LEVEL_MAX:
             positions = advance_positions_level(
                 bins_f32, positions, rel,
                 jnp.where(can_split, res.feature, -1),
@@ -186,7 +238,9 @@ def _grow_multi(bins: jnp.ndarray, gpair: jnp.ndarray,
                 can_split)
             positions = update_positions(
                 bins, positions, split_feature, split_bin, default_left,
-                is_split_full, missing_bin)
+                is_split_full, missing_bin,
+                decision_axis=axis_name if col_split else None,
+                feat_offset=feat_off)
 
     w = calc_weight(node_sum[..., 0], node_sum[..., 1], param) * param.eta
     leaf_mask = (active & is_leaf)[:, None]
@@ -374,7 +428,8 @@ class MultiTargetGrower:
                  hist_method: str = "auto",
                  mesh: Optional[jax.sharding.Mesh] = None,
                  has_missing: bool = True,
-                 constraint_sets: Optional[np.ndarray] = None) -> None:
+                 constraint_sets: Optional[np.ndarray] = None,
+                 split_mode: str = "row") -> None:
         if param.grow_policy == "lossguide":
             raise NotImplementedError(
                 "multi_output_tree supports grow_policy=depthwise only; "
@@ -387,14 +442,29 @@ class MultiTargetGrower:
             raise NotImplementedError(
                 "multi_output_tree max_leaves is not supported on "
                 "multi-process meshes yet")
+        if split_mode == "col" and mesh is None:
+            raise ValueError("data_split_mode=col requires a mesh")
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
         self.hist_method = hist_method
         self.mesh = mesh
         self.has_missing = has_missing
+        self.split_mode = split_mode
         self.constraint_sets = (None if constraint_sets is None
                                 else jnp.asarray(constraint_sets, bool))
+        if split_mode == "col" and self.constraint_sets is not None:
+            # bins pad the feature axis to a multiple of the mesh width;
+            # the replicated GLOBAL constraint arrays must match (padding
+            # columns have n_real == 0 and can never win a split)
+            from ..context import DATA_AXIS
+
+            world = mesh.shape.get(DATA_AXIS, 1)
+            F = int(self.constraint_sets.shape[1])
+            pad = (-F) % world
+            if pad:
+                self.constraint_sets = jnp.pad(self.constraint_sets,
+                                               ((0, 0), (0, pad)))
         self._sharded_fn = None
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
@@ -466,18 +536,34 @@ class MultiTargetGrower:
                                    max_nbins=self.max_nbins,
                                    hist_method=self.hist_method,
                                    axis_name=DATA_AXIS,
-                                   has_missing=self.has_missing)
+                                   has_missing=self.has_missing,
+                                   split_mode=self.split_mode)
 
-            out_specs = GrownMulti(
-                split_feature=P(), split_bin=P(), default_left=P(),
-                is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
-                gain=P(), positions=P(DATA_AXIS), delta=P(DATA_AXIS, None),
-                base_weight=P())
+            if self.split_mode == "col":
+                # features sharded, rows replicated; every output passes
+                # through the best-split exchange and is replicated — the
+                # static replication checker cannot prove it through the
+                # owner-shard select chain (same as the scalar grower)
+                in_specs = (P(None, DATA_AXIS), P(), P(DATA_AXIS),
+                            P(DATA_AXIS), P())
+                out_specs = GrownMulti(
+                    split_feature=P(), split_bin=P(), default_left=P(),
+                    is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
+                    gain=P(), positions=P(), delta=P(), base_weight=P())
+                check_vma = False
+            else:
+                in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None, None),
+                            P(), P(), P())
+                out_specs = GrownMulti(
+                    split_feature=P(), split_bin=P(), default_left=P(),
+                    is_leaf=P(), active=P(), leaf_value=P(), node_sum=P(),
+                    gain=P(), positions=P(DATA_AXIS),
+                    delta=P(DATA_AXIS, None), base_weight=P())
+                check_vma = True
             self._sharded_fn = jax.jit(jax.shard_map(
                 inner, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None, None), P(),
-                          P(), P()),
-                out_specs=out_specs))
+                in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma))
         return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
 
     def to_tree_model(self, g) -> MultiTargetTreeModel:
@@ -526,11 +612,16 @@ class MultiLossguideGrower:
                  hist_method: str = "auto",
                  mesh: Optional[jax.sharding.Mesh] = None,
                  has_missing: bool = True,
-                 constraint_sets: Optional[np.ndarray] = None) -> None:
+                 constraint_sets: Optional[np.ndarray] = None,
+                 split_mode: str = "row") -> None:
         if mesh is not None:
             raise NotImplementedError(
                 "multi_output_tree lossguide does not support device "
                 "meshes yet; use depthwise or a single chip")
+        if split_mode != "row":
+            raise NotImplementedError(
+                "multi_output_tree lossguide supports data_split_mode=row "
+                "only")
         if param.max_leaves <= 0 and param.max_depth <= 0:
             raise ValueError(
                 "grow_policy=lossguide needs max_leaves > 0 or max_depth > 0")

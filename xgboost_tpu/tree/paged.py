@@ -1018,7 +1018,10 @@ class PagedLossguideGrower(LossguideGrower):
 
     def __init__(self, param, max_nbins, cuts, hist_method="auto",
                  mesh=None, monotone=None, constraint_sets=None,
-                 has_missing=True) -> None:
+                 has_missing=True, split_mode="row") -> None:
+        if split_mode != "row":
+            raise NotImplementedError(
+                "external-memory training supports data_split_mode=row only")
         # parent keeps mesh=None: its resident shard_map _functions must
         # never see paged data — the mesh drives _MeshPageKernels instead
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
@@ -1076,7 +1079,11 @@ class PagedMultiTargetGrower(MultiTargetGrower):
     sum cross hosts through the communicator."""
 
     def __init__(self, param, max_nbins, cuts, hist_method="auto",
-                 mesh=None, has_missing=True, constraint_sets=None) -> None:
+                 mesh=None, has_missing=True, constraint_sets=None,
+                 split_mode="row") -> None:
+        if split_mode != "row":
+            raise NotImplementedError(
+                "external-memory training supports data_split_mode=row only")
         # parent keeps mesh=None: its resident shard_map path must never
         # see paged data — the mesh drives _MeshPageKernels instead
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,

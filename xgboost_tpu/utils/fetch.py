@@ -51,6 +51,21 @@ def fetch_packed(dicts: list) -> list:
     return out
 
 
+class _Host:
+    """Plain-attribute view over a fetched dict (duck-types the source)."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, dd):
+        self._d = dd
+
+    def __getattr__(self, name):
+        try:
+            return self._d[name]
+        except KeyError:
+            raise AttributeError(name)
+
+
 def fetch_struct(res):
     """One packed pull of a NamedTuple/dataclass of device arrays ->
     plain-attribute host object (duck-types the original for ``.field``
@@ -58,19 +73,6 @@ def fetch_struct(res):
     d = res._asdict() if hasattr(res, "_asdict") else dict(vars(res))
     arrays = {k: v for k, v in d.items() if isinstance(v, jnp.ndarray)}
     host = fetch_packed([arrays])[0] if arrays else {}
-
-    class _Host:
-        __slots__ = ("_d",)
-
-        def __init__(self, dd):
-            self._d = dd
-
-        def __getattr__(self, name):
-            try:
-                return self._d[name]
-            except KeyError:
-                raise AttributeError(name)
-
     merged = dict(d)
     merged.update(host)
     return _Host(merged)
