@@ -148,3 +148,30 @@ def test_dart_incremental_margin_matches_recompute(monkeypatch):
     p1 = np.asarray(b1.predict(xgb.DMatrix(X)))
     p2 = np.asarray(b2.predict(xgb.DMatrix(X)))
     np.testing.assert_allclose(p1, p2, rtol=1e-3, atol=1e-5)
+
+
+def test_dart_delta_cache_matches_forest_walk(monkeypatch):
+    """The per-round delta ring (round-4: replaces the dropped-trees
+    gather walk) must reproduce the walk's training margins: same drop
+    RNG, same trees, prediction parity within f32 reduction tolerance."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(3000, 8).astype(np.float32)
+    y = (X @ rng.randn(8) > 0).astype(np.float32)
+    ycls = (X @ rng.randn(8, 3)).argmax(axis=1).astype(np.float32)
+    for label, extra in ((y, {"objective": "binary:logistic"}),
+                         (ycls, {"objective": "multi:softprob",
+                                 "num_class": 3})):
+        params = {"booster": "dart", "rate_drop": 0.4, "max_depth": 4,
+                  "eta": 0.3, **extra}
+        dm = xgb.DMatrix(X, label=label)
+        monkeypatch.delenv("XTPU_DART_CACHE_BYTES", raising=False)
+        b_cache = xgb.train(params, dm, 8, verbose_eval=False)
+        assert any("dart_deltas" in st
+                   for st in b_cache._caches.values())  # ring engaged
+        monkeypatch.setenv("XTPU_DART_CACHE_BYTES", "0")
+        b_walk = xgb.train(params, xgb.DMatrix(X, label=label), 8,
+                           verbose_eval=False)
+        assert b_walk.gbm._dcache_off
+        np.testing.assert_allclose(b_cache.predict(xgb.DMatrix(X)),
+                                   b_walk.predict(xgb.DMatrix(X)),
+                                   rtol=1e-4, atol=1e-5)

@@ -828,11 +828,15 @@ class Booster:
             obj_params = tuple(sorted(scalars.items()))
             grower = gbm._grower_for(binned)
             info = state["info"]
+            dev = getattr(info, "labels_device", None)
+            wdev = getattr(info, "weights_device", None)
             self._fused_round = (
                 state, obj_params, grower,
-                jnp.asarray(info.labels, jnp.float32),
-                None if info.weights is None
-                else jnp.asarray(info.weights, jnp.float32),
+                dev() if dev is not None
+                else jnp.asarray(info.labels, jnp.float32),
+                ((wdev() if wdev is not None
+                  else jnp.asarray(info.weights, jnp.float32))
+                 if info.weights is not None else None),
                 binned.n_real_bins())
         return self._fused_round[1:]
 
