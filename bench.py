@@ -106,11 +106,88 @@ def bench_sklearn(X, y):
     return rps
 
 
+def bench_paged11m():
+    """External-memory tier at the north-star shape (BASELINE.md): 11M x 28
+    depth 6, 3 x 4M-row pages, HBM page cache on. Steady s/round by the
+    slope method. Skip with BENCH_PAGED=0."""
+    import tempfile
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    os.environ.setdefault("XTPU_PAGE_ROWS", "4000000")
+    N = 11_000_000
+    X, y = make_data(N, COLS)
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self.parts = np.array_split(np.arange(N), 11)
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(self.parts):
+                return 0
+            idx = self.parts[self.i]
+            input_data(data=X[idx], label=y[idx])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    it = It()
+    tmp = tempfile.TemporaryDirectory(prefix="bench_paged_")
+    it.cache_prefix = os.path.join(tmp.name, "pc")
+    dm = None
+    try:
+        dm = xgb.QuantileDMatrix(it, max_bin=256)
+        del X, y
+        timed_train(dm, 2)  # compiles
+        t5 = min(timed_train(dm, 5)[0] for _ in range(2))
+        t15 = min(timed_train(dm, 15)[0] for _ in range(2))
+    finally:
+        del dm  # release the memmap before the dir is removed
+        tmp.cleanup()
+    # None (JSON null), never float nan: json.dumps emits bare NaN which
+    # strict parsers reject, losing the driver's WHOLE metric line
+    return round((t15 - t5) / 10.0, 3) if t15 > t5 else None
+
+
+def bench_dart_multiclass():
+    """Dart covertype shape (BASELINE.md #4): 50k x 20, 7 classes,
+    rate_drop 0.3. Steady rounds/s over rounds 10-50. Skip with
+    BENCH_DART=0."""
+    import time as _time
+
+    import xgboost_tpu as xgb
+
+    n, F, K = 50_000, 20, 7
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X @ rng.randn(F, K)).argmax(axis=1).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    b = xgb.Booster(params={"objective": "multi:softprob", "num_class": K,
+                            "max_depth": DEPTH, "eta": 0.3, "max_bin": 256,
+                            "booster": "dart", "rate_drop": 0.3},
+                    cache=[dm])
+    for i in range(10):
+        b.update(dm, i)
+    _ = b.gbm.trees
+    t0 = _time.perf_counter()
+    for i in range(10, 50):
+        b.update(dm, i)
+    _ = b.gbm.trees
+    return 40.0 / (_time.perf_counter() - t0)
+
+
 def bench_higgs11m():
     """North-star shape (BASELINE.md): 11M x 28, depth 6. Returns cold
-    20-round r/s and steady-state r/s (slope between 20 and 100 rounds —
-    the only honest per-round number over the axon tunnel). Both slope
-    endpoints are best-of-2 so tunnel noise (+-30%) hits them evenly."""
+    20-round r/s, steady-state r/s (slope between 20 and 100 rounds —
+    the only honest per-round number over the axon tunnel), and the
+    steady rate of the opt-in two-level histogram
+    (hist_method='coarse'; slope 20->60). Slope endpoints are best-of-2
+    so tunnel noise (+-30%) hits them evenly."""
     import xgboost_tpu as xgb
 
     X, y = make_data(11_000_000, COLS)
@@ -118,8 +195,26 @@ def bench_higgs11m():
     timed_train(dm, 2)  # warm-up: binning upload + compile
     t20 = min(timed_train(dm, 20)[0] for _ in range(2))
     t100 = min(timed_train(dm, 100)[0] for _ in range(2))
-    steady = 80.0 / (t100 - t20) if t100 > t20 else float("nan")
-    return 20.0 / t20, steady
+    steady = 80.0 / (t100 - t20) if t100 > t20 else None
+    coarse = None
+    if os.environ.get("BENCH_COARSE", "1") != "0":
+        pc = {**PARAMS, "hist_method": "coarse"}
+
+        def timed_c(rounds):
+            import jax
+
+            t0 = time.perf_counter()
+            bst = xgb.train(pc, dm, rounds, verbose_eval=False)
+            for st in bst._caches.values():
+                jax.block_until_ready(st["margin"])
+                float(np.asarray(st["margin"][0, 0]))
+            return time.perf_counter() - t0
+
+        timed_c(2)
+        c20 = min(timed_c(20) for _ in range(2))
+        c60 = min(timed_c(60) for _ in range(2))
+        coarse = round(40.0 / (c60 - c20), 4) if c60 > c20 else None
+    return 20.0 / t20, steady, coarse
 
 
 def main():
@@ -134,12 +229,20 @@ def main():
         "vs_baseline": round(ours_rps / base_rps, 4),
     }
     if os.environ.get("BENCH_11M", "1") != "0":
-        cold20, steady = bench_higgs11m()
+        cold20, steady, coarse = bench_higgs11m()
         # gpu_hist-class derived target: BASELINE.md "North star" section
         result["higgs11m_cold20_rounds_per_sec"] = round(cold20, 4)
-        result["higgs11m_steady_rounds_per_sec"] = round(steady, 4)
+        result["higgs11m_steady_rounds_per_sec"] = (
+            None if steady is None else round(steady, 4))
         result["higgs11m_target_gpu_hist_class"] = 8.0
-        result["higgs11m_vs_target"] = round(steady / 8.0, 4)
+        result["higgs11m_vs_target"] = (
+            None if steady is None else round(steady / 8.0, 4))
+        result["higgs11m_coarse_steady_rounds_per_sec"] = coarse
+    if os.environ.get("BENCH_PAGED", "1") != "0":
+        result["paged11m_steady_sec_per_round"] = bench_paged11m()
+    if os.environ.get("BENCH_DART", "1") != "0":
+        result["dart_covertype_rounds_per_sec"] = round(
+            bench_dart_multiclass(), 3)
     print(json.dumps(result))
     print(f"# auc={auc:.4f} baseline(sklearn-hist)={base_rps:.3f} rounds/s",
           file=sys.stderr)

@@ -291,3 +291,51 @@ def test_predict_returns_mutable_numpy_after_device_stump():
     bs = json.loads(bytes(bst.save_raw("json")))
     assert np.isfinite(bs["learner"]["learner_model_param"]
                        ["base_score"]).all()
+
+
+def test_coarse_hist_matches_exact_at_small_max_bin():
+    """hist_method='coarse' (two-level coarse->refine histogram): with
+    max_bin <= 32 every fine bin lives inside the 32-bin refine window,
+    so the search space equals the exact evaluator's and the forests must
+    be BIT-identical."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(8000, 8).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.05] = np.nan
+    y = ((np.nan_to_num(X) @ rng.randn(8)) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 5, "max_bin": 32}
+    b_e = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    for te, tc in zip(b_e.gbm.trees, b_c.gbm.trees):
+        np.testing.assert_array_equal(te.split_feature, tc.split_feature)
+        np.testing.assert_array_equal(te.split_bin, tc.split_bin)
+        np.testing.assert_allclose(te.leaf_value, tc.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_coarse_hist_quality_at_full_max_bin():
+    """At max_bin=256 the coarse path searches every coarse boundary
+    exactly plus the best span's fine bins — training quality must match
+    the exact path to a hair (the monotone/constraint machinery rides the
+    same synthetic evaluator)."""
+    rng = np.random.RandomState(11)
+    X = rng.randn(12000, 8).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(12000)).astype(
+        np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    params = {"objective": "reg:squarederror", "max_depth": 6,
+              "max_bin": 256, "eval_metric": "rmse",
+              "monotone_constraints": "(1,0,0,0,0,0,0,0)"}
+    r_e, r_c = {}, {}
+    xgb.train(params, xgb.DMatrix(X, label=y), 8, evals=[(dm, "t")],
+              evals_result=r_e, verbose_eval=False)
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 8, evals=[(dm, "t")],
+                    evals_result=r_c, verbose_eval=False)
+    assert abs(r_e["t"]["rmse"][-1] - r_c["t"]["rmse"][-1]) \
+        < 0.02 * r_e["t"]["rmse"][-1] + 1e-6
+    # monotonicity holds on the coarse-trained model
+    grid = np.zeros((50, 8), np.float32)
+    grid[:, 0] = np.linspace(-2, 2, 50)
+    p = b_c.predict(xgb.DMatrix(grid))
+    assert (np.diff(p) >= -1e-5).all()

@@ -40,6 +40,7 @@ def test_mesh_matches_single_device(mesh):
     np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_mesh_padding_uneven_rows(mesh):
     # 1003 rows does not divide 8 — padded rows must not change the model
     X, y = make_regression(1003, 5)
@@ -133,6 +134,7 @@ def test_col_split_matches_single_device(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_col_split_with_missing(mesh):
     rng = np.random.RandomState(4)
     X = rng.randn(2000, 10).astype(np.float32)
@@ -335,6 +337,7 @@ def test_col_split_multi_output_tree_matches_single_device(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_col_split_multi_output_deep_tree(mesh):
     # depth 8 -> the update_positions gather walk with decision psum
     rng = np.random.RandomState(13)

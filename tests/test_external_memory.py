@@ -266,6 +266,7 @@ def _assert_same_forest(bst_p, bst_m):
                                    rtol=2e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_paged_monotone_matches_resident(tmp_path, monkeypatch):
     rng = np.random.RandomState(7)
     X = rng.randn(4000, 4).astype(np.float32)
@@ -287,6 +288,7 @@ def test_paged_monotone_matches_resident(tmp_path, monkeypatch):
         assert (d >= -1e-5).all()
 
 
+@pytest.mark.slow
 def test_paged_interaction_matches_resident(tmp_path, monkeypatch):
     rng = np.random.RandomState(8)
     X = rng.randn(4000, 4).astype(np.float32)

@@ -137,6 +137,7 @@ def test_paged_mesh_dart(tmp_path, monkeypatch, mesh):
     np.testing.assert_allclose(p, bst_m.predict(dmx), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_paged_mesh_lossguide(tmp_path, monkeypatch, mesh):
     params = {"objective": "binary:logistic", "grow_policy": "lossguide",
               "max_leaves": 12, "max_depth": 0, "max_bin": 64}
@@ -147,6 +148,7 @@ def test_paged_mesh_lossguide(tmp_path, monkeypatch, mesh):
         assert int(tree.is_leaf.sum()) <= 12
 
 
+@pytest.mark.slow
 def test_paged_mesh_multi_output_tree(tmp_path, monkeypatch, mesh):
     rng = np.random.RandomState(7)
     X = rng.randn(3000, 6).astype(np.float32)
@@ -175,6 +177,7 @@ def test_paged_mesh_multi_output_tree(tmp_path, monkeypatch, mesh):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_paged_mesh_monotone_and_categorical(tmp_path, monkeypatch, mesh):
     rng = np.random.RandomState(5)
     n = 4000

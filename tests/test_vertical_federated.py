@@ -86,6 +86,7 @@ def test_vertical_matches_pooled_inmemory():
         assert dump == pooled_dump
 
 
+@pytest.mark.slow
 def test_vertical_colsample_subsample_matches_pooled():
     params = dict(PARAMS, colsample_bytree=0.7, colsample_bylevel=0.8,
                   subsample=0.8, seed=11)
@@ -230,6 +231,7 @@ def test_vertical_matches_pooled_federated_grpc():
 # the same partition-bitvector sync).
 
 
+@pytest.mark.slow
 def test_vertical_monotone_matches_pooled():
     rng = np.random.RandomState(31)
     n, F = 1500, 6
@@ -292,6 +294,7 @@ def test_vertical_interaction_matches_pooled():
         walk(0, set())
 
 
+@pytest.mark.slow
 def test_vertical_categorical_matches_pooled():
     rng = np.random.RandomState(33)
     n, k = 1500, 8

@@ -138,13 +138,14 @@ class Dart(GBTree):
     def _cached(self, state: dict):
         c = state.get("dart_margin")
         if (c is not None and c["n"] == len(self._trees)
+                and c.get("sv") == self._stat_version
                 and np.array_equal(c["w"], np.asarray(self.weight_drop))):
             return c["m"]
         return None
 
     def _store(self, state: dict, m) -> None:
         state["dart_margin"] = {
-            "n": len(self._trees),
+            "n": len(self._trees), "sv": self._stat_version,
             "w": np.asarray(self.weight_drop, np.float64).copy(), "m": m}
 
     def _cached_drop_sum(self, state: dict, idx: List[int]):

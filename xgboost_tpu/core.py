@@ -403,6 +403,13 @@ class Booster:
                 "multi_output_tree does not support monotone constraints "
                 "or the dart booster (the reference rejects both for "
                 "vector-leaf trees)")
+        if self.learner_params.get("hist_method") == "coarse" and (
+                tm in ("approx", "exact")
+                or self.tree_param.grow_policy == "lossguide"
+                or ms == "multi_output_tree"):
+            raise NotImplementedError(
+                "hist_method='coarse' supports the resident depthwise "
+                "hist updater with scalar trees only")
         dsm = self.learner_params.get("data_split_mode", "row")
         if dsm not in ("row", "col"):
             raise ValueError(f"unknown data_split_mode: {dsm}")
@@ -978,6 +985,10 @@ class Booster:
             self.gbm.trees.append(tree)
             self.gbm.tree_info.append(k)
         self.gbm.iteration_indptr.append(len(self.gbm.trees))
+        # refreshed trees carry NEW leaf values at existing indices — any
+        # per-tree cache keyed by tree index (dart's delta ring / margin
+        # cache) is stale now
+        self.gbm._stat_version += 1
         # committed trees are immutable once appended; the incremental margin
         # cache walks only the newly committed trees on the next predict
 

@@ -163,6 +163,11 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                n_nodes: int, max_nbins: int, method: str = "auto",
                block_rows: int = 1 << 16,
                bins_t: jnp.ndarray = None, axis_name=None) -> jnp.ndarray:
+    if method == "coarse":
+        raise ValueError(
+            "hist_method='coarse' runs inside the resident depthwise "
+            "grower only (tree/grow.py); this code path (lossguide / "
+            "paged / vector-leaf / vertical) does not support it")
     if method == "auto":
         backend = jax.default_backend()
         # The fused Pallas kernel accumulates [F_blk, max_nbins, 2*n_nodes]

@@ -281,3 +281,96 @@ def evaluate_splits_multi(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         gain=best_gain, feature=f_idx, bin=b_idx,
         default_left=d_idx.astype(bool), left_sum=best_left,
         right_sum=best_right)
+
+
+# ---- two-level coarse->refine histogram (hist_method="coarse") -------------
+# The packed-SWAR one-pass kernel is VPU-bound on the 256-wide one-hot
+# build; a coarse pass over ``bins >> 4`` plus a refine pass over a 32-bin
+# fine window measures ~2.8x cheaper at the kernel level
+# (docs/performance.md round-4 section, tools/bench_hist_coarse.py — a
+# 32-wide int8 one-hot fills the same 32-sublane tile a 16-wide one pads
+# to, so the window costs nothing extra).
+# Exactness: gains at every coarse boundary stay exact, and the refine
+# window covers BOTH spans adjacent to the best coarse boundary, so the
+# chosen split is never worse than a max_bin=16 split and equals the
+# exact max_bin=256 one whenever the best fine split lies within a span
+# of the best coarse boundary.
+
+COARSE_SPAN = 16   # fine bins per coarse bin
+COARSE_B = 20      # coarse hist slots: 16 real + 3 pad + missing at 19
+WINDOW = 32        # refined fine bins: the 2 spans around the boundary
+SYN_B = 46         # synthetic slots: 14 lower + 32 fine + (upper folded)
+
+
+def choose_refine_window(hist_c: jnp.ndarray, parent_sum: jnp.ndarray,
+                         n_real_bins: jnp.ndarray, param: TrainParam,
+                         has_missing: bool) -> jnp.ndarray:
+    """[N, F] int32 window start w: the refine window covers coarse spans
+    w and w+1 — both sides of the best coarse-boundary gain — clamped per
+    FEATURE to the real coarse-bin count (without the clamp, a degenerate
+    all-left boundary past the data could shift the window off the
+    occupied bins and break the max_bin<=32 bit-exactness guarantee).
+    Heuristic chooser (no monotone clamp; both missing directions;
+    min_child_weight gate) — the FINAL split is scored exactly by
+    ``evaluate_splits`` on the assembled synthetic histogram."""
+    present = jnp.moveaxis(hist_c[:, :, :16, :], 3, 2)     # [N,F,2,16]
+    if has_missing:
+        miss = hist_c[:, :, COARSE_B - 1, :]               # [N,F,2]
+    else:
+        miss = jnp.zeros(hist_c.shape[:2] + (2,), hist_c.dtype)
+    cum = jnp.cumsum(present, axis=3)
+    parent5 = parent_sum[:, None, None, :, None]
+    n_dirs = 2 if has_missing else 1
+    left = jnp.stack([cum, cum + miss[:, :, :, None]][:n_dirs], axis=2)
+    right = parent5 - left                                 # [N,F,dirs,2,16]
+    lg, lh = left[:, :, :, 0, :], left[:, :, :, 1, :]
+    rg, rh = right[:, :, :, 0, :], right[:, :, :, 1, :]
+    g = calc_gain(lg, lh, param) + calc_gain(rg, rh, param)
+    ok = (lh >= param.min_child_weight) & (rh >= param.min_child_weight)
+    g = jnp.max(jnp.where(ok, g, -jnp.inf), axis=2)        # [N,F,16]
+    best = jnp.argmax(g, axis=2).astype(jnp.int32)         # boundary id
+    c_cnt = (n_real_bins.astype(jnp.int32) + COARSE_SPAN - 1) // COARSE_SPAN
+    w_max = jnp.maximum(c_cnt - 2, 0)[None, :]             # [1, F]
+    return jnp.clip(best, 0, jnp.minimum(w_max, 14))
+
+
+def assemble_two_level(hist_c: jnp.ndarray, hist_r: jnp.ndarray,
+                       window: jnp.ndarray, n_real_bins: jnp.ndarray,
+                       has_missing: bool):
+    """Order-preserving synthetic histogram -> (hist_syn, n_real_syn).
+
+    Slot layout per (node, feature) with window start w: slots [0, w)
+    carry the merged coarse bins below the window, slots [w, w+32) the
+    window's fine bins, slots [w+32, 46) the coarse bins above it, and
+    the last slot the missing mass. Cumulative sums over this layout are
+    exact, so ``evaluate_splits`` scores every coarse boundary and every
+    in-window fine boundary exactly."""
+    s = jnp.arange(SYN_B, dtype=jnp.int32)[None, None, :]
+    w = window[:, :, None]
+    in_fine = (s >= w) & (s < w + WINDOW)
+    c_idx = jnp.clip(jnp.where(s < w, s, s - 30), 0, 15)
+    f_idx = jnp.clip(s - w, 0, WINDOW - 1)
+
+    def take(h, idx):
+        return jnp.take_along_axis(h, idx[..., None], axis=2)
+
+    syn = jnp.where(in_fine[..., None], take(hist_r, f_idx),
+                    take(hist_c, c_idx))
+    if has_missing:
+        syn = jnp.concatenate(
+            [syn, hist_c[:, :, COARSE_B - 1:COARSE_B, :]], axis=2)
+    c_cnt = (n_real_bins + COARSE_SPAN - 1) // COARSE_SPAN
+    n_real_syn = jnp.clip(c_cnt + 30, 1, SYN_B).astype(jnp.int32)
+    return syn, n_real_syn
+
+
+def decode_two_level_bin(slot: jnp.ndarray,
+                         window_sel: jnp.ndarray) -> jnp.ndarray:
+    """Synthetic slot id -> FINE split bin, given each node's window start
+    for its winning feature."""
+    lower = 16 * slot + 15
+    fine = 16 * window_sel + (slot - window_sel)
+    upper = 16 * (slot - 30) + 15
+    return jnp.where(slot < window_sel, lower,
+                     jnp.where(slot < window_sel + WINDOW, fine,
+                               upper)).astype(jnp.int32)

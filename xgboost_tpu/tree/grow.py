@@ -207,6 +207,31 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                   and hist_kernel == "prehot")
     oh_pre = (build_onehot_plane(bins_t, max_nbins) if use_prehot else None)
 
+    # Two-level coarse->refine histogram (hist_method="coarse"): a 20-slot
+    # pass over bins >> 4, a span choice per (node, feature) from the
+    # coarse boundary gains, a 16-bin refine pass over the chosen span,
+    # and an exact evaluate_splits over the order-preserving synthetic
+    # layout — 2.8x cheaper per level than the 256-wide one-pass kernel
+    # (docs/performance.md round-4 section). Exactness: every coarse
+    # boundary is scored exactly; in-span fine boundaries exactly; fine
+    # splits OUTSIDE the chosen span are not searched.
+    use_coarse = hist_kernel == "coarse"
+    if use_coarse:
+        if cat is not None or col_split \
+                or max_nbins > 256 + int(has_missing):
+            raise NotImplementedError(
+                "hist_method='coarse' supports numeric features, row "
+                "split, and max_bin <= 256")
+        from ..ops.split import (assemble_two_level,
+                                 choose_refine_window,
+                                 decode_two_level_bin)
+        if has_missing:
+            cb_t = jnp.where(bins_t.astype(jnp.int32) == missing_bin, 19,
+                             bins_t.astype(jnp.int32) >> 4).astype(jnp.uint8)
+        else:
+            cb_t = (bins_t.astype(jnp.int32) >> 4).astype(jnp.uint8)
+        cb = cb_t.T
+
     for depth in range(max_depth):
         lo = 2 ** depth - 1
         n_level = 2 ** depth
@@ -214,7 +239,42 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
 
         in_level = (positions >= lo) & (positions < lo + n_level)
         rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
-        if depth == 0 or not use_compaction:
+        span = None
+        if use_coarse:
+            row_axis = axis_name if not col_split else None
+            hist_c = allreduce(build_hist(cb, gpair, rel, n_level, 20,
+                                          method="auto", bins_t=cb_t,
+                                          axis_name=row_axis))
+            span = choose_refine_window(hist_c,
+                                        node_sum[lo:lo + n_level],
+                                        n_real_bins, param,
+                                        has_missing)              # [N, F]
+            # per-row window of the row's node, via one [F,N+1]@[N+1,n]
+            # MXU matmul (rows outside the level hit the zero pad row;
+            # their kernel contribution is dropped by rel == n_level)
+            span_pad = jnp.concatenate(
+                [span.astype(jnp.float32),
+                 jnp.zeros((1, F), jnp.float32)]).T         # [F, N+1]
+            oh_rel = (rel[None, :] == jnp.arange(
+                n_level + 1, dtype=jnp.int32)[:, None]).astype(jnp.float32)
+            c_row_t = jax.lax.dot_general(
+                span_pad, oh_rel, (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST)        # [F, n]
+            rb_t = bins_t.astype(jnp.int32) - 16 * c_row_t.astype(jnp.int32)
+            ok = (rb_t >= 0) & (rb_t < 32)
+            if has_missing:
+                ok &= bins_t.astype(jnp.int32) != missing_bin
+            # out-of-window sentinel must be a VALID slot of the kernel
+            # (the flat-index segment path would bleed an out-of-range id
+            # into the next feature's bins); slot 35 of a 36-wide pass is
+            # discarded below, and 36 keeps the packed SWAR kernel's %4
+            rb_t = jnp.where(ok, rb_t, 35).astype(jnp.uint8)
+            hist_r = allreduce(build_hist(rb_t.T, gpair, rel, n_level, 36,
+                                          method="auto", bins_t=rb_t,
+                                          axis_name=row_axis))[:, :, :32, :]
+            hist, n_real_eval = assemble_two_level(
+                hist_c, hist_r, span, n_real_bins, has_missing)
+        elif depth == 0 or not use_compaction:
             if use_prehot:
                 hist = build_hist_prehot(
                     oh_pre, gpair, rel, n_level, max_nbins,
@@ -273,13 +333,20 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
 
         parent_sum = node_sum[lo:lo + n_level]
         res = evaluate_splits(
-            hist, parent_sum, n_real_bins, param, feature_mask=fmask,
-            monotone=mono_loc,
+            hist, parent_sum,
+            n_real_eval if use_coarse else n_real_bins, param,
+            feature_mask=fmask, monotone=mono_loc,
             node_lower=node_lower[lo:lo + n_level]
             if monotone is not None else None,
             node_upper=node_upper[lo:lo + n_level]
             if monotone is not None else None,
             cat=cat_loc, has_missing=has_missing)
+        if use_coarse:
+            # synthetic slot -> fine bin, per node's span for its feature
+            span_sel = jnp.take_along_axis(
+                span, jnp.maximum(res.feature, 0)[:, None], axis=1)[:, 0]
+            res = res._replace(
+                bin=decode_two_level_bin(res.bin, span_sel))
 
         if col_split:
             # column-split best-split exchange: all-gather per-shard best
