@@ -369,3 +369,19 @@ def test_col_split_model_loads_without_mesh(mesh, tmp_path):
     b2 = xgb.Booster(model_file=path)
     np.testing.assert_array_equal(b2.predict(xgb.DMatrix(X)),
                                   b.predict(xgb.DMatrix(X)))
+
+
+def test_mesh_coarse_hist_matches_single_device(mesh):
+    # the two-level histogram's coarse/refine passes psum across the row
+    # mesh like the one-pass kernel; same-model check vs single device
+    rng = np.random.RandomState(23)
+    X = rng.randn(4000, 9).astype(np.float32)
+    y = (X @ rng.randn(9) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 4,
+              "hist_method": "coarse"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh}, xgb.DMatrix(X, label=y), 4,
+                   verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
