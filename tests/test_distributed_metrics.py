@@ -264,3 +264,58 @@ def test_ranking_auc_metric_end_to_end():
               evals=[(dm, "train")], evals_result=res, verbose_eval=False)
     assert res["train"]["auc"][-1] > res["train"]["auc"][0]
     assert 0.0 < res["train"]["aucpr"][-1] <= 1.0
+
+
+def test_topk_rank_metrics_vectorized_match_per_query_oracle():
+    """ndcg@k / map@k / pre@k are computed in one lexsort + segment sweep;
+    they must reproduce the per-query oracle exactly (ties, single-doc,
+    all-irrelevant and k>size groups included)."""
+    from xgboost_tpu.metric import get_metric
+    from xgboost_tpu.metric.rank_metric import dcg_at
+
+    class _Info:
+        def __init__(self, labels, ptr, weights=None):
+            self.labels = labels
+            self.group_ptr = ptr
+            self.weights = weights
+            self.data_split_mode = "row"
+
+    rng = np.random.RandomState(2)
+    sizes = np.concatenate([[1, 0, 2, 0], rng.randint(0, 20, 300)])
+    ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    n = int(ptr[-1])
+    y = rng.randint(0, 4, n).astype(np.float64)
+    y[ptr[3]:ptr[4]] = 0.0  # one all-irrelevant query
+    p = np.round(rng.randn(n), 1)
+    wq = rng.rand(len(sizes))
+
+    def oracle(name, k):
+        total, wsum = 0.0, 0.0
+        for q in range(len(ptr) - 1):
+            a, b = int(ptr[q]), int(ptr[q + 1])
+            if b == a:
+                continue
+            yy, ss = y[a:b], p[a:b]
+            kk = min(k if k > 0 else len(yy), len(yy))
+            order = np.argsort(-ss, kind="stable")
+            if name == "ndcg":
+                ideal = dcg_at(np.sort(yy)[::-1], kk)
+                sc = dcg_at(yy[order], kk) / ideal if ideal > 0 else 1.0
+            elif name == "map":
+                rel = (yy[order] > 0).astype(np.float64)
+                hits = np.cumsum(rel)
+                prec = np.where(rel[:kk] > 0,
+                                hits[:kk] / (np.arange(kk) + 1.0), 0.0)
+                nr = rel.sum()
+                sc = prec.sum() / min(nr, kk) if nr > 0 else 1.0
+            else:  # pre
+                sc = float((yy[order][:kk] > 0).mean())
+            total += sc * wq[q]
+            wsum += wq[q]
+        return total / wsum
+
+    for name in ("ndcg", "map", "pre"):
+        for k in (0, 3, 10, 50):
+            m = get_metric(f"{name}@{k}" if k else name)
+            got = m(p, _Info(y, ptr, wq))
+            assert abs(got - oracle(name, k)) < 1e-9, (name, k)
