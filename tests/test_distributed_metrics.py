@@ -285,7 +285,7 @@ def test_topk_rank_metrics_vectorized_match_per_query_oracle():
     ptr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
     n = int(ptr[-1])
     y = rng.randint(0, 4, n).astype(np.float64)
-    y[ptr[3]:ptr[4]] = 0.0  # one all-irrelevant query
+    y[ptr[2]:ptr[3]] = 0.0  # one all-irrelevant query (size-2 group)
     p = np.round(rng.randn(n), 1)
     wq = rng.rand(len(sizes))
 

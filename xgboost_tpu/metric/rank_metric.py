@@ -95,9 +95,13 @@ class MAP(_TopKMetric):
 
     def _scores(self, y, y_s, q_s, rank, k_g, G, qidx, ptr):
         rel = (y_s > 0).astype(np.float64)
+        if len(rel) == 0:  # zero-row shard: every group masks out below
+            return np.ones(G)
         cum = np.cumsum(rel)
         starts = ptr[:-1]
-        base = np.where(starts > 0, cum[np.maximum(starts, 1) - 1], 0.0)
+        base = np.where(starts > 0,
+                        cum[np.minimum(np.maximum(starts, 1) - 1,
+                                       len(cum) - 1)], 0.0)
         hits = cum - base[q_s]              # within-group cumulative hits
         contrib = np.where((rel > 0) & (rank < k_g[q_s]),
                            hits / (rank + 1.0), 0.0)
