@@ -339,3 +339,45 @@ def test_coarse_hist_quality_at_full_max_bin():
     grid[:, 0] = np.linspace(-2, 2, 50)
     p = b_c.predict(xgb.DMatrix(grid))
     assert (np.diff(p) >= -1e-5).all()
+
+
+def test_coarse_hist_multiclass_and_sampling():
+    """hist_method='coarse' through the class-scanned multiclass grow and
+    under row/column sampling + weights — trains to comparable quality as
+    the exact path."""
+    rng = np.random.RandomState(3)
+    n, K = 6000, 4
+    X, y = make_classification(n, 10, rng=rng, n_classes=K)
+    w = rng.rand(n).astype(np.float32) + 0.5
+    params = {"objective": "multi:softprob", "num_class": K, "max_depth": 5,
+              "subsample": 0.8, "colsample_bytree": 0.8,
+              "eval_metric": "mlogloss"}
+    r_e, r_c = {}, {}
+    dm = xgb.DMatrix(X, label=y, weight=w)
+    xgb.train(params, dm, 8,
+              evals=[(dm, "t")], evals_result=r_e, verbose_eval=False)
+    xgb.train({**params, "hist_method": "coarse"}, dm, 8,
+              evals=[(dm, "t")], evals_result=r_c, verbose_eval=False)
+    assert r_c["t"]["mlogloss"][-1] < r_c["t"]["mlogloss"][0]
+    assert abs(r_e["t"]["mlogloss"][-1] - r_c["t"]["mlogloss"][-1]) < 0.05
+
+
+def test_coarse_hist_unsupported_configs_raise():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    for bad in ({"grow_policy": "lossguide", "max_leaves": 8,
+                 "max_depth": 0},
+                {"tree_method": "approx"}):
+        with pytest.raises(NotImplementedError):
+            xgb.train({"objective": "binary:logistic",
+                       "hist_method": "coarse", **bad},
+                      xgb.DMatrix(X, label=y), 1, verbose_eval=False)
+    # categorical features reject at trace time inside _grow
+    Xc = np.concatenate([X, rng.randint(0, 5, (500, 1)).astype(np.float32)],
+                        axis=1)
+    dmc = xgb.DMatrix(Xc, label=y, feature_types=["q"] * 4 + ["c"],
+                      enable_categorical=True)
+    with pytest.raises(NotImplementedError):
+        xgb.train({"objective": "binary:logistic", "hist_method": "coarse"},
+                  dmc, 1, verbose_eval=False)
