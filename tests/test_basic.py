@@ -381,3 +381,33 @@ def test_coarse_hist_unsupported_configs_raise():
     with pytest.raises(NotImplementedError):
         xgb.train({"objective": "binary:logistic", "hist_method": "coarse"},
                   dmc, 1, verbose_eval=False)
+
+
+def test_auto_coarse_promotion_rule():
+    """hist_method='auto' promotes to the two-level coarse histogram only
+    on TPU, numeric row-split, wide bins, and at scale (round-5 promotion
+    — quality table in docs/performance.md)."""
+    from xgboost_tpu.tree.grow import (AUTO_COARSE_MIN_BINS,
+                                       AUTO_COARSE_MIN_ROWS,
+                                       auto_selects_coarse)
+
+    ok = dict(numeric=True, col_split=False, backend="tpu")
+    assert auto_selects_coarse(AUTO_COARSE_MIN_ROWS, 257, True, **ok)
+    assert auto_selects_coarse(1 << 20, 256, False, **ok)
+    # every precondition individually gates the promotion
+    assert not auto_selects_coarse(AUTO_COARSE_MIN_ROWS - 1, 257, True,
+                                   **ok)
+    assert not auto_selects_coarse(1 << 20, AUTO_COARSE_MIN_BINS,
+                                   True, **ok)  # 127 real bins < 128
+    assert not auto_selects_coarse(1 << 20, 258, True, **ok)  # > 256 real
+    assert not auto_selects_coarse(1 << 20, 257, True,
+                                   numeric=False, col_split=False,
+                                   backend="tpu")
+    assert not auto_selects_coarse(1 << 20, 257, True,
+                                   numeric=True, col_split=True,
+                                   backend="tpu")
+    # CPU keeps the exact kernel: the segment-sum build's cost is
+    # bin-width-independent, so two passes would be a strict loss
+    assert not auto_selects_coarse(1 << 20, 257, True,
+                                   numeric=True, col_split=False,
+                                   backend="cpu")

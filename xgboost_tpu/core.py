@@ -408,8 +408,9 @@ class Booster:
                 or self.tree_param.grow_policy == "lossguide"
                 or ms == "multi_output_tree"):
             raise NotImplementedError(
-                "hist_method='coarse' supports the resident depthwise "
-                "hist updater with scalar trees only")
+                "hist_method='coarse' supports the depthwise hist "
+                "updater (resident or external-memory) with scalar "
+                "trees only")
         dsm = self.learner_params.get("data_split_mode", "row")
         if dsm not in ("row", "col"):
             raise ValueError(f"unknown data_split_mode: {dsm}")

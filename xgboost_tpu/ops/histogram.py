@@ -165,9 +165,10 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                bins_t: jnp.ndarray = None, axis_name=None) -> jnp.ndarray:
     if method == "coarse":
         raise ValueError(
-            "hist_method='coarse' runs inside the resident depthwise "
-            "grower only (tree/grow.py); this code path (lossguide / "
-            "paged / vector-leaf / vertical) does not support it")
+            "hist_method='coarse' runs inside the depthwise scalar "
+            "growers only (tree/grow.py resident, tree/paged.py external "
+            "memory); this code path (lossguide / vector-leaf / vertical) "
+            "does not support it")
     if method == "auto":
         backend = jax.default_backend()
         # The fused Pallas kernel accumulates [F_blk, max_nbins, 2*n_nodes]

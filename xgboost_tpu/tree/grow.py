@@ -71,6 +71,35 @@ def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
     return base_mask & (u <= thr)
 
 
+# hist_method="auto" -> two-level coarse histogram promotion rule.
+# Engages only where coarse is BOTH supported and measured faster than the
+# one-pass exact kernel: TPU backend (on CPU the segment-sum kernel's cost
+# is bin-width-independent, so two passes are a strict loss), numeric
+# features, row split, wide bins (the win scales with bin count; below
+# ~128 slots the one-pass kernel is already cheap), and enough local rows
+# that the second pass + window choice amortise (crossover measured on
+# v5e — tools/bench_hist_coarse.py + docs/performance.md round-5 table).
+# Quality: eval-set parity validated across binary/multiclass/ranking x 3
+# seeds (docs/performance.md); coarse is bit-exact for max_bin <= 32 and
+# scores every coarse boundary exactly, so the promotion changes argmax
+# choices only among near-tie fine splits inside unrefined windows.
+AUTO_COARSE_MIN_ROWS = 1 << 16
+AUTO_COARSE_MIN_BINS = 128
+
+
+def auto_selects_coarse(n_rows: int, max_nbins: int, has_missing: bool, *,
+                        numeric: bool, col_split: bool,
+                        backend: Optional[str] = None) -> bool:
+    """True when ``hist_method='auto'`` should route to the two-level
+    coarse->refine histogram (depthwise scalar resident/paged growers)."""
+    if backend is None:
+        backend = jax.default_backend()
+    return (backend == "tpu" and numeric and not col_split
+            and max_nbins <= 256 + int(has_missing)
+            and max_nbins - int(has_missing) >= AUTO_COARSE_MIN_BINS
+            and n_rows >= AUTO_COARSE_MIN_ROWS)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("param", "max_nbins", "hist_method", "axis_name",
@@ -215,7 +244,16 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     # (docs/performance.md round-4 section). Exactness: every coarse
     # boundary is scored exactly; in-span fine boundaries exactly; fine
     # splits OUTSIDE the chosen span are not searched.
+    #
+    # Round 5: "auto" promotes to coarse where its preconditions hold and
+    # it measured faster (TPU, numeric, wide bins, enough rows) — the
+    # eval-set validation table in docs/performance.md is the quality
+    # justification. All sizes below the thresholds keep the exact kernel.
     use_coarse = hist_kernel == "coarse"
+    if hist_kernel == "auto":
+        use_coarse = auto_selects_coarse(
+            n, max_nbins, has_missing, numeric=cat is None,
+            col_split=col_split)
     if use_coarse:
         if cat is not None or col_split \
                 or max_nbins > 256 + int(has_missing):

@@ -65,17 +65,58 @@ def _make_kernels(grower):
     """
     missing_bin = (grower.max_nbins - 1 if grower.has_missing
                    else grower.max_nbins)
+    method = _strip_hist_suffix(grower.hist_method)
+    if method == "coarse" or getattr(grower, "_coarse", False):
+        # two-level scheme: the coarse/refine page passes are plain
+        # narrow-width builds — let the per-backend auto selection pick
+        # their kernel
+        method = "auto"
     if grower.mesh is not None:
         return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
-                                _strip_hist_suffix(grower.hist_method))
-    return _PageKernels(grower.max_nbins, missing_bin,
-                        _strip_hist_suffix(grower.hist_method))
+                                method)
+    return _PageKernels(grower.max_nbins, missing_bin, method)
 
 
 def _rel_of(pos, lo, n_level, n_static):
     """Level-relative node slot of each row (``n_static`` = not in level)."""
     return jnp.where((pos >= lo) & (pos < lo + n_level), pos - lo,
                      n_static).astype(jnp.int32)
+
+
+def _coarse_bins(page, missing_bin):
+    """Coarse-pass bin ids (two-level histogram): ``bins >> log2(span)``
+    with the missing slot remapped to the coarse missing slot — identical
+    to the resident coarse pass (tree/grow.py); computed in-kernel so the
+    page streams once."""
+    from ..ops.split import COARSE_B, COARSE_SPAN
+
+    shift = COARSE_SPAN.bit_length() - 1
+    p = page.astype(jnp.int32)
+    return jnp.where(p == missing_bin, COARSE_B - 1,
+                     p >> shift).astype(jnp.uint8)
+
+
+def _refine_bins(page, rel, span, n_static, missing_bin):
+    """Refine-pass relative bin ids: each row's node picks its WINDOW-bin
+    fine window start from ``span`` [n_static, F] (one one-hot MXU
+    matmul, no data-dependent gather); rows outside their window / at the
+    missing slot / outside the level land on the discarded pad slot
+    WINDOW+3, which keeps the packed SWAR kernel's width (WINDOW+4) a
+    multiple of 4."""
+    from ..ops.split import COARSE_SPAN, WINDOW
+
+    span_pad = jnp.concatenate(
+        [span.astype(jnp.float32),
+         jnp.zeros((1, span.shape[1]), jnp.float32)])       # [N+1, F]
+    oh_rel = (rel[:, None] == jnp.arange(
+        n_static + 1, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+    c_row = jax.lax.dot_general(
+        oh_rel, span_pad, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)                # [p, F]
+    pi = page.astype(jnp.int32)
+    rb = pi - COARSE_SPAN * c_row.astype(jnp.int32)
+    ok = (rb >= 0) & (rb < WINDOW) & (pi != missing_bin)
+    return jnp.where(ok, rb, WINDOW + 3).astype(jnp.uint8)
 
 
 def _advance_rows(page, pos_pg, kind, arrs, cat_args, lo_prev, nl_prev,
@@ -163,7 +204,7 @@ class _LevelEvaluator:
     level. Pad slots carry ``active=False`` and can never win a split."""
 
     def __init__(self, grower, n_static: int, max_nodes: int,
-                 deep: bool, n_real_bins) -> None:
+                 deep: bool, n_real_bins, coarse: bool = False) -> None:
         self.param = grower.param
         self.cat = grower.cat
         self.monotone = getattr(grower, "monotone", None)
@@ -172,6 +213,7 @@ class _LevelEvaluator:
         self.n_static = n_static
         self.max_nodes = max_nodes
         self.deep = deep
+        self.coarse = coarse
         self.n_real_d = jnp.asarray(np.asarray(n_real_bins))
         if self.cat is not None:
             n_real_slots = (grower.max_nbins - 1 if grower.has_missing
@@ -181,6 +223,23 @@ class _LevelEvaluator:
             self.n_words = 1
         self._fn = None
         self._init_fn = None
+        self._win_fn = None
+
+    def choose_window(self, hist_c, state):
+        """Refine-window starts [n_static, F] from the GLOBAL coarse
+        histogram and the carried parent sums (paged two-level histogram:
+        the window choice is node-level, after the coarse page pass)."""
+        if self._win_fn is None:
+            from ..ops.split import choose_refine_window
+
+            param, hm = self.param, self.has_missing
+
+            def fn(hc, parent):
+                return choose_refine_window(hc, parent, self.n_real_d,
+                                            param, hm)
+
+            self._win_fn = jax.jit(fn)
+        return self._win_fn(hist_c, state[1])
 
     def init_state(self, root_sum):
         """Level-0 state from the device root gradient sum."""
@@ -211,11 +270,16 @@ class _LevelEvaluator:
         return self._init_fn(root_sum)
 
     def __call__(self, hist, state, tree_mask, key, depth, lo, n_level):
-        """-> (stash dict of device arrays, next state, prev dict)."""
+        """-> (stash dict of device arrays, next state, prev dict).
+
+        ``hist`` is the [n_static, F, B, 2] level histogram — or, in
+        coarse mode, the ``(hist_c, hist_r, span)`` triple assembled
+        on device inside the jitted program."""
         if self._fn is None:
             self._fn = jax.jit(self._build())
+        hist = hist if isinstance(hist, tuple) else (hist,)
         stash, state_n, feat_v, bin_v, dl_v, cs_v, ic_v, cw_v = self._fn(
-            hist, state, tree_mask, key, depth, lo, n_level)
+            *hist, state, tree_mask, key, depth, lo, n_level)
         cat_prev = None if self.cat is None else (ic_v, cw_v)
         if self.deep:
             sf, sb, dl, isf, icf, cwf = state_n[5]
@@ -235,10 +299,15 @@ class _LevelEvaluator:
         n_static = self.n_static
         eps = float(max(param.gamma, _EPS))
 
-        def fn(hist, state, tree_mask, key, depth, lo, n_level):
+        def fn(*args):
             from .grow import _sample_features
             from .param import calc_weight as _cw
 
+            if self.coarse:
+                (hist_c, hist_r, span, state, tree_mask, key, depth, lo,
+                 n_level) = args
+            else:
+                hist, state, tree_mask, key, depth, lo, n_level = args
             active, parent, mlo, mhi, path, full = state
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
@@ -265,9 +334,25 @@ class _LevelEvaluator:
             if monotone is not None:
                 mono_kw = dict(monotone=monotone, node_lower=mlo,
                                node_upper=mhi)
-            res = evaluate_splits(hist, parent, self.n_real_d, param,
+            if self.coarse:
+                from ..ops.split import (assemble_two_level,
+                                         decode_two_level_bin)
+
+                hist, n_real_eval = assemble_two_level(
+                    hist_c, hist_r, span, self.n_real_d, self.has_missing)
+            else:
+                n_real_eval = self.n_real_d
+            res = evaluate_splits(hist, parent, n_real_eval, param,
                                   feature_mask=fmask, cat=cat,
                                   has_missing=self.has_missing, **mono_kw)
+            if self.coarse:
+                # synthetic slot -> fine bin, per node's span for its
+                # winning feature (same decode as the resident path)
+                span_sel = jnp.take_along_axis(
+                    span, jnp.maximum(res.feature, 0)[:, None],
+                    axis=1)[:, 0]
+                res = res._replace(
+                    bin=decode_two_level_bin(res.bin, span_sel))
 
             can_split = active & (res.gain > eps) & jnp.isfinite(res.gain)
             feat_v = jnp.where(can_split, res.feature, -1).astype(jnp.int32)
@@ -372,14 +457,20 @@ class _PageKernels:
 
         return build_hist_multi if multi else build_hist
 
-    def _acc_zeros(self, paged, gpair, n_nodes, multi):
-        shape = ((n_nodes, paged.n_features, self.max_nbins)
+    def _acc_zeros(self, paged, gpair, n_nodes, multi, nbins=None):
+        shape = ((n_nodes, paged.n_features, nbins or self.max_nbins)
                  + ((gpair.shape[1], 2) if multi else (2,)))
         return jnp.zeros(shape, jnp.float32)
 
     def level_hist(self, paged, gpair, positions, lo, n_level, n_static,
-                   multi=False):
-        """Histogram-only pass (the root level of each tree)."""
+                   multi=False, coarse=False):
+        """Histogram-only pass (the root level of each tree). With
+        ``coarse`` the pass builds the 20-slot coarse histogram of the
+        two-level scheme over ``bins >> 4`` (computed in-kernel)."""
+        from ..ops.split import COARSE_B
+
+        B = COARSE_B if coarse else self.max_nbins
+
         def build():
             builder = self._builder(multi)
 
@@ -388,23 +479,28 @@ class _PageKernels:
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
                 rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
-                return acc + builder(page, gp_pg, rel, n_static,
-                                     self.max_nbins,
+                data = (_coarse_bins(page, self.missing_bin) if coarse
+                        else page)
+                return acc + builder(data, gp_pg, rel, n_static, B,
                                      method=self.hist_kernel)
 
             return jax.jit(fn, donate_argnums=0)
 
-        fn = self._cached(("hist", n_static, multi), build)
-        acc = self._acc_zeros(paged, gpair, n_static, multi)
+        fn = self._cached(("hist", n_static, multi, coarse), build)
+        acc = self._acc_zeros(paged, gpair, n_static, multi,
+                              nbins=B if coarse else None)
         lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
         for s, e, page in paged.pages():
             acc = fn(acc, page, gpair, positions, jnp.int32(s), lo_d, nl_d)
         return acc
 
     def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
-                 multi=False):
+                 multi=False, coarse=False):
         """The fused pass: advance rows below the PREVIOUS level's splits,
         then build THIS level's histogram — one dispatch per page."""
+        from ..ops.split import COARSE_B
+
+        B = COARSE_B if coarse else self.max_nbins
         kind = prev["kind"]
         cat = prev["cat"]
         n_arr = len(prev["arrs"])
@@ -424,14 +520,18 @@ class _PageKernels:
                                      self.missing_bin)
                 pos = jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
                 rel = _rel_of(newp, lo_d, nl_d, n_static)
-                h = builder(page, gp_pg, rel, n_static, self.max_nbins,
+                data = (_coarse_bins(page, self.missing_bin) if coarse
+                        else page)
+                h = builder(data, gp_pg, rel, n_static, B,
                             method=self.hist_kernel)
                 return pos, acc + h
 
             return jax.jit(fn, donate_argnums=(0, 3))
 
-        fn = self._cached(("advhist", kind, n_static, multi, W), build)
-        acc = self._acc_zeros(paged, gpair, n_static, multi)
+        fn = self._cached(("advhist", kind, n_static, multi, W, coarse),
+                          build)
+        acc = self._acc_zeros(paged, gpair, n_static, multi,
+                              nbins=B if coarse else None)
         extra = prev["arrs"] + (() if cat is None else tuple(cat))
         lo_prev = jnp.int32(prev["lo"])
         nl_prev = jnp.int32(prev["n_level"])
@@ -440,6 +540,37 @@ class _PageKernels:
             positions, acc = fn(acc, page, gpair, positions, jnp.int32(s),
                                 lo_prev, nl_prev, lo_d, nl_d, *extra)
         return positions, acc
+
+    def refine_hist(self, paged, gpair, positions, span, lo, n_level,
+                    n_static):
+        """Refine pass of the two-level histogram: a (WINDOW+4)-slot build
+        over each row's in-window relative bin (positions already advanced
+        by the coarse pass), summed across pages; the top 4 slots are
+        discarded out-of-window pads."""
+        from ..ops.split import WINDOW
+
+        def build():
+            def fn(acc, page, gp, pos, s, lo_d, nl_d, span_d):
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
+                rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                rb = _refine_bins(page, rel, span_d, n_static,
+                                  self.missing_bin)
+                h = build_hist(rb, gp_pg, rel, n_static, WINDOW + 4,
+                               method=self.hist_kernel)
+                return acc + h
+
+            return jax.jit(fn, donate_argnums=0)
+
+        fn = self._cached(("rhist", n_static), build)
+        acc = self._acc_zeros(paged, gpair, n_static, False,
+                              nbins=WINDOW + 4)
+        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
+        for s, e, page in paged.pages():
+            acc = fn(acc, page, gpair, positions, jnp.int32(s), lo_d, nl_d,
+                     span)
+        return acc[:, :, :WINDOW, :]
 
     def final_advance(self, paged, positions, prev, n_static):
         """Advance-only pass for the LAST evaluated level (leaf routing)."""
@@ -588,13 +719,17 @@ class _MeshPageKernels:
         return self._cached(("zeros", shape), build)()
 
     def _hist_over_pages(self, paged, gpair, positions, rel_fn, n_nodes,
-                         multi, key, extra):
+                         multi, key, extra, nbins=None, data_fn=None):
         """Shared page loop: ``rel_fn(pos_page, *extra)`` maps positions to
-        node slots; ``extra`` are traced scalars (level bounds / node ids).
+        node slots; ``extra`` are traced scalars (level bounds / node ids)
+        or replicated arrays. ``data_fn(page, rel, *extra)`` optionally
+        rewrites the binned page before the build (two-level coarse /
+        refine passes); ``nbins`` overrides the histogram width.
         """
         P = jax.sharding.PartitionSpec
         axis = self.axis
         K = gpair.shape[1] if multi else None
+        B = nbins or self.max_nbins
 
         def build_acc():
             from ..ops.histogram import build_hist_multi
@@ -607,7 +742,9 @@ class _MeshPageKernels:
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
                 rel = rel_fn(pos_pg, *extra_d)
-                h = builder(page, gp_pg, rel, n_nodes, self.max_nbins,
+                data = page if data_fn is None else data_fn(page, rel,
+                                                            *extra_d)
+                h = builder(data, gp_pg, rel, n_nodes, B,
                             method=self.hist_kernel)
                 return acc + h[None]
 
@@ -626,7 +763,7 @@ class _MeshPageKernels:
 
         fn = self._cached(key + ("acc", K), build_acc)
         fin = self._cached(key + ("fin", K), build_fin)
-        shape = ((self.world, n_nodes, paged.n_features, self.max_nbins)
+        shape = ((self.world, n_nodes, paged.n_features, B)
                  + ((K, 2) if multi else (2,)))
         acc = self._acc_zeros(shape)
         for s_loc, page in paged.pages_sharded(self.mesh, axis):
@@ -634,20 +771,52 @@ class _MeshPageKernels:
         return fin(acc)
 
     def level_hist(self, paged, gpair, positions, lo: int, n_level: int,
-                   n_static: int, multi: bool = False):
+                   n_static: int, multi: bool = False, coarse: bool = False):
         """One depthwise level histogram over the pages."""
+        from ..ops.split import COARSE_B
+
         def rel_fn(pos_pg, lo_d, n_level_d):
             return _rel_of(pos_pg, lo_d, n_level_d, n_static)
 
+        data_fn = None
+        if coarse:
+            def data_fn(page, rel, lo_d, n_level_d):
+                return _coarse_bins(page, self.missing_bin)
+
         return self._hist_over_pages(
             paged, gpair, positions, rel_fn, n_static, multi,
-            ("hist", n_static), (jnp.int32(lo), jnp.int32(n_level)))
+            ("hist", n_static, coarse), (jnp.int32(lo), jnp.int32(n_level)),
+            nbins=COARSE_B if coarse else None, data_fn=data_fn)
+
+    def refine_hist(self, paged, gpair, positions, span, lo, n_level,
+                    n_static):
+        """Refine pass of the two-level histogram (mesh tier): the
+        replicated window array rides as an extra input; shard-local
+        (WINDOW+4)-slot partials psum once at pass end like every level
+        hist."""
+        from ..ops.split import WINDOW
+
+        def rel_fn(pos_pg, lo_d, n_level_d, span_d):
+            return _rel_of(pos_pg, lo_d, n_level_d, n_static)
+
+        def data_fn(page, rel, lo_d, n_level_d, span_d):
+            return _refine_bins(page, rel, span_d, n_static,
+                                self.missing_bin)
+
+        h = self._hist_over_pages(
+            paged, gpair, positions, rel_fn, n_static, False,
+            ("rhist", n_static),
+            (jnp.int32(lo), jnp.int32(n_level), span),
+            nbins=WINDOW + 4, data_fn=data_fn)
+        return h[:, :, :WINDOW, :]
 
     def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
-                 multi=False):
+                 multi=False, coarse=False):
         """Fused advance(previous level) + histogram(this level), one
         shard_map dispatch per page; shard-local partials accumulate and
         psum once at level end."""
+        from ..ops.split import COARSE_B
+
         P = jax.sharding.PartitionSpec
         axis = self.axis
         kind = prev["kind"]
@@ -655,6 +824,7 @@ class _MeshPageKernels:
         n_arr = len(prev["arrs"])
         W = None if cat is None else int(cat[1].shape[1])
         K = gpair.shape[1] if multi else None
+        B = COARSE_B if coarse else self.max_nbins
 
         def build_acc():
             from ..ops.histogram import build_hist_multi
@@ -674,7 +844,9 @@ class _MeshPageKernels:
                 pos = jax.lax.dynamic_update_slice_in_dim(pos, newp, s_loc,
                                                           0)
                 rel = _rel_of(newp, lo_d, nl_d, n_static)
-                h = builder(page, gp_pg, rel, n_static, self.max_nbins,
+                data = (_coarse_bins(page, self.missing_bin) if coarse
+                        else page)
+                h = builder(data, gp_pg, rel, n_static, B,
                             method=self.hist_kernel)
                 return pos, acc + h[None]
 
@@ -693,9 +865,10 @@ class _MeshPageKernels:
                 lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
                 in_specs=(acc_spec,), out_specs=P()))
 
-        fn = self._cached(("advhist", kind, n_static, multi, W), build_acc)
+        fn = self._cached(("advhist", kind, n_static, multi, W, coarse),
+                          build_acc)
         fin = self._cached(("hist", n_static, "fin", K), build_fin)
-        shape = ((self.world, n_static, paged.n_features, self.max_nbins)
+        shape = ((self.world, n_static, paged.n_features, B)
                  + ((K, 2) if multi else (2,)))
         acc = self._acc_zeros(shape)
         extra = prev["arrs"] + (() if cat is None else tuple(cat))
@@ -851,6 +1024,7 @@ class PagedGrower(TreeGrower):
         self.mesh = mesh
         self._mk = None
         self._ev: Optional[_LevelEvaluator] = None
+        self._coarse = False
 
     def grow(self, paged, gpair: jnp.ndarray, n_real_bins,
              key: jax.Array) -> GrownTree:
@@ -859,6 +1033,34 @@ class PagedGrower(TreeGrower):
         # layout (core._make_sharded_train_state), pages stream sharded
         n = gpair.shape[0]
         if self._mk is None:
+            # two-level coarse->refine histogram over pages (explicit
+            # hist_method="coarse", or the "auto" promotion rule at
+            # scale): both passes accumulate across pages, the window
+            # choice is node-level after the coarse pass — decided once
+            # (n is fixed per DMatrix), before the kernels are built so
+            # their underlying builds run the plain kernel selection
+            from .grow import auto_selects_coarse
+
+            base = _strip_hist_suffix(self.hist_method)
+            if base == "coarse" and (
+                    self.cat is not None
+                    or self.max_nbins > 256 + int(self.has_missing)):
+                raise NotImplementedError(
+                    "hist_method='coarse' supports numeric features and "
+                    "max_bin <= 256")
+            # the promotion threshold is LOCAL rows per shard (the
+            # measured crossover is per-device work); on the mesh tier
+            # gpair is the padded GLOBAL row count
+            if self.mesh is not None:
+                from ..context import DATA_AXIS
+
+                n_local = n // self.mesh.shape.get(DATA_AXIS, 1)
+            else:
+                n_local = n
+            self._coarse = base == "coarse" or (
+                base == "auto" and auto_selects_coarse(
+                    n_local, self.max_nbins, self.has_missing,
+                    numeric=self.cat is None, col_split=False))
             self._mk = _make_kernels(self)
         max_depth = param.max_depth
         max_nodes = 2 ** (max_depth + 1) - 1
@@ -882,7 +1084,7 @@ class PagedGrower(TreeGrower):
         deep = n_static > 64
         if self._ev is None:
             self._ev = _LevelEvaluator(self, n_static, max_nodes, deep,
-                                       n_real)
+                                       n_real, coarse=self._coarse)
 
         # Multi-host external memory (reference: rabit row split over
         # SparsePageDMatrix, src/data/sparse_page_dmatrix.cc): each process
@@ -906,11 +1108,21 @@ class PagedGrower(TreeGrower):
             n_level = 2 ** depth
             if prev is None:
                 hist = self._mk.level_hist(paged, gpair, positions, lo,
-                                           n_level, n_static)
+                                           n_level, n_static,
+                                           coarse=self._coarse)
             else:
                 positions, hist = self._mk.adv_hist(
-                    paged, gpair, positions, prev, lo, n_level, n_static)
+                    paged, gpair, positions, prev, lo, n_level, n_static,
+                    coarse=self._coarse)
             hist = _host_allreduce(hist)
+            if self._coarse:
+                # node-level window choice from the GLOBAL coarse hist
+                # (allreduced above, so every host/shard refines the same
+                # windows), then the refine pass re-streams the pages
+                span = self._ev.choose_window(hist, state)
+                hist_r = _host_allreduce(self._mk.refine_hist(
+                    paged, gpair, positions, span, lo, n_level, n_static))
+                hist = (hist, hist_r, span)
             stash, state, prev = self._ev(
                 hist, state, tree_mask, key, jnp.int32(depth),
                 jnp.int32(lo), jnp.int32(n_level))
