@@ -211,6 +211,32 @@ def test_col_split_monotone_and_interaction(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_col_split_approx_matches_single_device(mesh):
+    """tree_method=approx under data_split_mode=col (VERDICT r4 #3): rows
+    replicate, so the per-iteration hessian-weighted re-sketch is already
+    identical everywhere; the re-binned matrix lands feature-sharded into
+    the same col-split evaluator hist uses (reference updater_approx.cc
+    runs under DataSplitMode::kCol through the shared
+    evaluate_splits.h:294-409 allgather)."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(2500, 13).astype(np.float32)  # 13 -> pads to 16 columns
+    y = (X @ rng.randn(13) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3,
+              "tree_method": "approx"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)
+    # exact stays rejected under col split (reference parity: ColMaker
+    # CHECKs DataSplitMode::kRow; exact x mesh already raises at configure)
+    with pytest.raises((NotImplementedError, ValueError)):
+        xgb.train({**params, "tree_method": "exact", "mesh": mesh,
+                   "data_split_mode": "col"},
+                  xgb.DMatrix(X, label=y), 1, verbose_eval=False)
+
+
 def test_col_split_requires_mesh():
     X = np.random.RandomState(0).randn(100, 4).astype(np.float32)
     with pytest.raises(ValueError):

@@ -226,6 +226,12 @@ class GBTree:
                     from ..tree.paged import PagedLossguideGrower
 
                     cls = PagedLossguideGrower
+                elif self.split_mode == "col" and self.mesh is None:
+                    # vertical federated lossguide: winner allgather +
+                    # decision-bit allreduce around the same greedy loop
+                    from ..tree.vertical import VerticalLossguideGrower
+
+                    cls = VerticalLossguideGrower
                 else:
                     from ..tree.lossguide import LossguideGrower
 
@@ -331,6 +337,18 @@ class GBTree:
                                          self.tree_param.max_bin, w,
                                          info.feature_types)
                     binned = BinnedMatrix.from_dense(np.asarray(dm.X), cuts)
+                if self.split_mode == "col" and self.mesh is not None:
+                    # column-split mesh: the re-sketched matrix lands
+                    # feature-sharded exactly like the hist training state
+                    # (rows replicate, so the host-side sketch is already
+                    # identical everywhere; vertical federated needs no
+                    # sync either — each rank sketches only the columns it
+                    # owns, reference updater_approx.cc under kCol)
+                    from ..context import DATA_AXIS
+                    from ..data.binned import pad_features_for_mesh
+
+                    binned = pad_features_for_mesh(binned, self.mesh,
+                                                   DATA_AXIS)
                 # reuse the grower (and its jitted kernels) across re-sketches
                 # when the compiled shapes are unchanged; categorical split
                 # sets depend on the cuts, so those rebuild

@@ -422,9 +422,13 @@ class Booster:
                     "data_split_mode=col requires a mesh (in-process column "
                     "sharding) or an active distributed communicator "
                     "(vertical federated training)")
-            if tm in ("approx", "exact"):
+            if tm == "exact":
+                # reference parity: ColMaker has no distributed support
+                # (src/tree/updater_colmaker.cc CHECKs kRow); approx shares
+                # the hist col-split evaluator (updater_approx.cc runs
+                # under DataSplitMode::kCol via evaluate_splits.h:294-409)
                 raise NotImplementedError(
-                    "data_split_mode=col supports tree_method=hist only")
+                    "data_split_mode=col supports tree_method=hist/approx")
             if (self.tree_param.grow_policy == "lossguide"
                     and ms == "multi_output_tree"):
                 raise NotImplementedError(
@@ -432,17 +436,19 @@ class Booster:
                     "data_split_mode=col")
             if self.ctx.mesh is None:
                 # vertical federated (communicator ranks, no mesh): the
-                # host-level decision-bit protocol covers depthwise scalar
-                # gbtree only; in-process col meshes cover the rest
-                if (self.tree_param.grow_policy == "lossguide"
-                        or ms == "multi_output_tree"):
+                # decision-bit protocol covers scalar trees — depthwise
+                # and lossguide, gbtree and dart (r5 lift; reference:
+                # the col-split evaluator is updater-generic,
+                # src/tree/hist/evaluate_splits.h:294-409)
+                if ms == "multi_output_tree":
                     raise NotImplementedError(
                         "vertical federated column split supports "
-                        "depthwise scalar trees only")
-                if name != "gbtree":
+                        "scalar trees only")
+                if name == "gblinear":
                     raise NotImplementedError(
-                        "vertical federated column split supports "
-                        "booster=gbtree only")
+                        "vertical federated column split supports tree "
+                        "boosters only (the reference's linear updaters "
+                        "run under DataSplitMode::kRow)")
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
@@ -586,21 +592,9 @@ class Booster:
                 raise NotImplementedError(
                     "external-memory (paged) training supports "
                     "data_split_mode=row only")
-            bins_np = np.asarray(binned.bins)
-            F = bins_np.shape[1]
-            f_pad = ((F + world - 1) // world) * world - F
-            n_real = np.asarray(binned.cuts.n_real_bins(), np.int32)
-            if f_pad:
-                bins_np = np.concatenate(
-                    [bins_np, np.zeros((n, f_pad), bins_np.dtype)], axis=1)
-                n_real = np.concatenate(
-                    [n_real, np.zeros(f_pad, np.int32)])
-            sharding = jsh.NamedSharding(
-                mesh, jsh.PartitionSpec(None, DATA_AXIS))
-            binned_p = BinnedMatrix(
-                bins=jax.device_put(bins_np, sharding), cuts=binned.cuts,
-                max_nbins=binned.max_nbins, has_missing=binned.has_missing,
-                n_real_override=n_real)
+            from .data.binned import pad_features_for_mesh
+
+            binned_p = pad_features_for_mesh(binned, mesh, DATA_AXIS)
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
             return self._store_cache(key, binned_p, margin, True, dm,
                                      dm.info, n)

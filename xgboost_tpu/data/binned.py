@@ -104,6 +104,32 @@ def search_bin_into(X: np.ndarray, cuts: HistogramCuts, missing_bin: int,
     out[:] = np.where(b < 0, missing_bin, b)
 
 
+def pad_features_for_mesh(binned: "BinnedMatrix", mesh, axis_name: str
+                          ) -> "BinnedMatrix":
+    """Column-split mesh layout for a host-built BinnedMatrix: features pad
+    to a multiple of the mesh axis with zero-bin columns whose real-bin
+    count is 0 (they can never win a split), and the bin matrix lands
+    feature-sharded (reference ``DataSplitMode::kCol``). Shared by the
+    hist training state and the per-iteration approx re-sketch."""
+    import jax
+    import jax.sharding as jsh
+
+    world = mesh.shape.get(axis_name, 1)
+    bins_np = np.asarray(binned.bins)
+    n, F = bins_np.shape
+    f_pad = (-F) % world
+    n_real = np.asarray(binned.cuts.n_real_bins(), np.int32)
+    if f_pad:
+        bins_np = np.concatenate(
+            [bins_np, np.zeros((n, f_pad), bins_np.dtype)], axis=1)
+        n_real = np.concatenate([n_real, np.zeros(f_pad, np.int32)])
+    sharding = jsh.NamedSharding(mesh, jsh.PartitionSpec(None, axis_name))
+    return BinnedMatrix(
+        bins=jax.device_put(bins_np, sharding), cuts=binned.cuts,
+        max_nbins=binned.max_nbins, has_missing=binned.has_missing,
+        n_real_override=n_real)
+
+
 @dataclass
 class BinnedMatrix:
     """Quantized feature matrix resident in HBM.

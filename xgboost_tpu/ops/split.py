@@ -302,6 +302,29 @@ WINDOW = 32        # refined fine bins: the 2 spans around the boundary
 SYN_B = 46         # synthetic slots: 14 lower + 32 fine + (upper folded)
 
 
+def coarse_bin_ids(bins_i32: jnp.ndarray, missing_bin: int) -> jnp.ndarray:
+    """Coarse-pass slot per element: ``bins >> log2(COARSE_SPAN)`` with the
+    missing slot remapped to ``COARSE_B - 1``. Orientation-agnostic
+    (elementwise); shared by the resident and paged growers so the layout
+    has exactly one definition. When the matrix has no missing slot,
+    ``missing_bin`` is an out-of-range sentinel and the remap never fires."""
+    shift = COARSE_SPAN.bit_length() - 1
+    return jnp.where(bins_i32 == missing_bin, COARSE_B - 1,
+                     bins_i32 >> shift).astype(jnp.uint8)
+
+
+def refine_bin_ids(bins_i32: jnp.ndarray, span_sel_i32: jnp.ndarray,
+                   missing_bin: int) -> jnp.ndarray:
+    """Refine-pass slot per element given each element's window start (in
+    coarse units): in-window elements land on [0, WINDOW); everything else
+    (out of window / missing) on the discarded pad slot WINDOW + 3, which
+    keeps the kernel width WINDOW + 4 a multiple of 4 for the packed SWAR
+    build."""
+    rb = bins_i32 - COARSE_SPAN * span_sel_i32
+    ok = (rb >= 0) & (rb < WINDOW) & (bins_i32 != missing_bin)
+    return jnp.where(ok, rb, WINDOW + 3).astype(jnp.uint8)
+
+
 def choose_refine_window(hist_c: jnp.ndarray, parent_sum: jnp.ndarray,
                          n_real_bins: jnp.ndarray, param: TrainParam,
                          has_missing: bool) -> jnp.ndarray:

@@ -260,14 +260,10 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             raise NotImplementedError(
                 "hist_method='coarse' supports numeric features, row "
                 "split, and max_bin <= 256")
-        from ..ops.split import (assemble_two_level,
-                                 choose_refine_window,
-                                 decode_two_level_bin)
-        if has_missing:
-            cb_t = jnp.where(bins_t.astype(jnp.int32) == missing_bin, 19,
-                             bins_t.astype(jnp.int32) >> 4).astype(jnp.uint8)
-        else:
-            cb_t = (bins_t.astype(jnp.int32) >> 4).astype(jnp.uint8)
+        from ..ops.split import (assemble_two_level, choose_refine_window,
+                                 coarse_bin_ids, decode_two_level_bin,
+                                 refine_bin_ids)
+        cb_t = coarse_bin_ids(bins_t.astype(jnp.int32), missing_bin)
         cb = cb_t.T
 
     for depth in range(max_depth):
@@ -298,18 +294,16 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             c_row_t = jax.lax.dot_general(
                 span_pad, oh_rel, (((1,), (0,)), ((), ())),
                 precision=jax.lax.Precision.HIGHEST)        # [F, n]
-            rb_t = bins_t.astype(jnp.int32) - 16 * c_row_t.astype(jnp.int32)
-            ok = (rb_t >= 0) & (rb_t < 32)
-            if has_missing:
-                ok &= bins_t.astype(jnp.int32) != missing_bin
-            # out-of-window sentinel must be a VALID slot of the kernel
-            # (the flat-index segment path would bleed an out-of-range id
-            # into the next feature's bins); slot 35 of a 36-wide pass is
-            # discarded below, and 36 keeps the packed SWAR kernel's %4
-            rb_t = jnp.where(ok, rb_t, 35).astype(jnp.uint8)
-            hist_r = allreduce(build_hist(rb_t.T, gpair, rel, n_level, 36,
-                                          method="auto", bins_t=rb_t,
-                                          axis_name=row_axis))[:, :, :32, :]
+            # out-of-window sentinel (refine_bin_ids) must be a VALID slot
+            # of the kernel — the flat-index segment path would bleed an
+            # out-of-range id into the next feature's bins; the pad slots
+            # of the WINDOW+4-wide pass are discarded below
+            from ..ops.split import WINDOW
+            rb_t = refine_bin_ids(bins_t.astype(jnp.int32),
+                                  c_row_t.astype(jnp.int32), missing_bin)
+            hist_r = allreduce(build_hist(
+                rb_t.T, gpair, rel, n_level, WINDOW + 4, method="auto",
+                bins_t=rb_t, axis_name=row_axis))[:, :, :WINDOW, :]
             hist, n_real_eval = assemble_two_level(
                 hist_c, hist_r, span, n_real_bins, has_missing)
         elif depth == 0 or not use_compaction:

@@ -342,6 +342,18 @@ class LossguideGrower:
         """Root positions [n] — paged-mesh subclasses shard this."""
         return jnp.zeros((n,), jnp.int32)
 
+    def _feature_width(self, F: int) -> int:
+        """Width of the colsample-mask / constraint-path feature space.
+        Local F by default; the vertical federated subclass returns the
+        GLOBAL width so every rank draws identical masks."""
+        return F
+
+    def _split_values(self, sf: np.ndarray, sb: np.ndarray) -> np.ndarray:
+        """Raw thresholds for the finished tree. Local cuts resolve every
+        feature here; the vertical federated subclass sums owner
+        contributions across ranks instead."""
+        return self.cuts.split_values(sf, sb)
+
     # ------------------------------------------------------------- sampling
     def _col_masks(self, seed: int, F: int):
         return col_masks(self.param, seed, F)
@@ -370,6 +382,7 @@ class LossguideGrower:
             seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1])
         except (TypeError, ValueError):
             seed = int(np.asarray(key).ravel()[-1])
+        F = self._feature_width(F)  # global width under vertical federated
         node_mask = self._col_masks(seed, F)
 
         # host-side node arrays (compact ids in allocation order)
@@ -498,7 +511,7 @@ class LossguideGrower:
         w = np.clip(w, lower[:n_nodes], upper[:n_nodes]) * param.eta
         is_leaf = lc[:n_nodes] < 0
         leaf_value = np.where(is_leaf, w, 0.0).astype(np.float32)
-        split_value = self.cuts.split_values(sf[:n_nodes], sb[:n_nodes])
+        split_value = self._split_values(sf[:n_nodes], sb[:n_nodes])
         tree = TreeModel(
             left_child=lc[:n_nodes].copy(), right_child=rc[:n_nodes].copy(),
             parent=pa[:n_nodes].copy(),

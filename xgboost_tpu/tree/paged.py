@@ -84,26 +84,20 @@ def _rel_of(pos, lo, n_level, n_static):
 
 
 def _coarse_bins(page, missing_bin):
-    """Coarse-pass bin ids (two-level histogram): ``bins >> log2(span)``
-    with the missing slot remapped to the coarse missing slot — identical
-    to the resident coarse pass (tree/grow.py); computed in-kernel so the
-    page streams once."""
-    from ..ops.split import COARSE_B, COARSE_SPAN
+    """Coarse-pass bin ids of one page — the shared two-level mapping
+    (ops/split.py coarse_bin_ids), computed in-kernel so the page streams
+    once."""
+    from ..ops.split import coarse_bin_ids
 
-    shift = COARSE_SPAN.bit_length() - 1
-    p = page.astype(jnp.int32)
-    return jnp.where(p == missing_bin, COARSE_B - 1,
-                     p >> shift).astype(jnp.uint8)
+    return coarse_bin_ids(page.astype(jnp.int32), missing_bin)
 
 
 def _refine_bins(page, rel, span, n_static, missing_bin):
     """Refine-pass relative bin ids: each row's node picks its WINDOW-bin
     fine window start from ``span`` [n_static, F] (one one-hot MXU
-    matmul, no data-dependent gather); rows outside their window / at the
-    missing slot / outside the level land on the discarded pad slot
-    WINDOW+3, which keeps the packed SWAR kernel's width (WINDOW+4) a
-    multiple of 4."""
-    from ..ops.split import COARSE_SPAN, WINDOW
+    matmul, no data-dependent gather); the elementwise slot mapping is
+    the shared ops/split.py refine_bin_ids."""
+    from ..ops.split import refine_bin_ids
 
     span_pad = jnp.concatenate(
         [span.astype(jnp.float32),
@@ -113,10 +107,8 @@ def _refine_bins(page, rel, span, n_static, missing_bin):
     c_row = jax.lax.dot_general(
         oh_rel, span_pad, (((1,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST)                # [p, F]
-    pi = page.astype(jnp.int32)
-    rb = pi - COARSE_SPAN * c_row.astype(jnp.int32)
-    ok = (rb >= 0) & (rb < WINDOW) & (pi != missing_bin)
-    return jnp.where(ok, rb, WINDOW + 3).astype(jnp.uint8)
+    return refine_bin_ids(page.astype(jnp.int32),
+                          c_row.astype(jnp.int32), missing_bin)
 
 
 def _advance_rows(page, pos_pg, kind, arrs, cat_args, lo_prev, nl_prev,

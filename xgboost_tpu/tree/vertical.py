@@ -46,8 +46,22 @@ from ..ops.split import evaluate_splits
 from ..parallel import collective
 from .grow import (_EPS, GrownTree, _sample_features,
                    interaction_allowed_host, monotone_child_bounds_host)
+from .lossguide import LossguideGrower
 from .param import TrainParam, calc_weight
 from .tree import TreeModel
+
+
+def exchange_feature_topology(comm, base_local: np.ndarray, w_local: int):
+    """The ONE feature-topology protocol of the vertical growers: every
+    rank contributes (its real-bin base mask, its cat word width) through
+    one object allgather; returns ``(f_offset, base_global,
+    n_words_global)`` with rank-ordered contiguous feature blocks."""
+    parts = comm.allgather_objects((np.asarray(base_local), int(w_local)))
+    widths = [len(p[0]) for p in parts]
+    off = int(sum(widths[: comm.get_rank()]))
+    base_global = np.concatenate([np.asarray(p[0]) for p in parts])
+    n_words = max(p[1] for p in parts)
+    return off, base_global, n_words
 
 
 class VerticalFederatedGrower:
@@ -94,18 +108,20 @@ class VerticalFederatedGrower:
         self._n_words_global: int = 1
         self._bins_np = None  # (device array, host copy) identity-keyed
 
-    # -- one-time topology exchange -------------------------------------------
+    # -- per-tree topology exchange -------------------------------------------
     def _bind_features(self, n_real_bins) -> None:
-        if self._f_offset is not None:
-            return
+        """Re-exchanged EVERY tree, in lockstep: approx re-sketches cuts
+        per iteration, and a feature can lose all real bins on one rank
+        only — a changed-locally-only guard would desync the collective,
+        and a frozen mask would desync the colsample draw pool from the
+        pooled run (which recomputes the base mask from fresh
+        n_real_bins)."""
         base_local = np.asarray(n_real_bins) > 0
         nb = self.max_nbins - 1 if self.has_missing else self.max_nbins
         w_local = (max(nb, 1) - 1) // 32 + 1  # evaluate_splits word width
-        parts = self.comm.allgather_objects((base_local, w_local))
-        widths = [len(p[0]) for p in parts]
-        self._f_offset = int(sum(widths[: self.comm.get_rank()]))
-        self._base_global = np.concatenate([np.asarray(p[0]) for p in parts])
-        self._n_words_global = max(p[1] for p in parts)
+        (self._f_offset, self._base_global,
+         self._n_words_global) = exchange_feature_topology(
+            self.comm, base_local, w_local)
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
              n_real_bins: jnp.ndarray, key: jax.Array) -> GrownTree:
@@ -338,6 +354,167 @@ class VerticalFederatedGrower:
             is_cat_split=np.asarray(g.is_cat_split),
             cat_words=np.asarray(g.cat_words),
             base_weight=np.asarray(g.base_weight))
+
+
+class VerticalLossguideGrower(LossguideGrower):
+    """Loss-guided growth across vertical federated parties (VERDICT r4
+    #4): the greedy pop loop of ``LossguideGrower`` runs replicated on
+    every rank — per split, the two-child histogram and enumeration run
+    on LOCAL features, one allgather crosses the per-node winner (lowest
+    rank wins ties = the pooled argmax's lowest-feature preference), and
+    the popped node's rows advance through the owner's decision-bit
+    allreduce. Reference: the col-split machinery is updater-generic —
+    the same evaluator allgather (src/tree/hist/evaluate_splits.h:
+    294-409) and partition-bitvector sync (src/tree/
+    common_row_partitioner.h) serve the LossGuide Driver unchanged
+    (src/tree/driver.h imposes no split-mode restriction)."""
+
+    def __init__(self, param: TrainParam, max_nbins: int, cuts,
+                 hist_method: str = "auto", mesh=None,
+                 monotone: Optional[np.ndarray] = None,
+                 constraint_sets: Optional[np.ndarray] = None,
+                 has_missing: bool = True, split_mode: str = "col") -> None:
+        if split_mode != "col":
+            raise ValueError("VerticalLossguideGrower is col-split only")
+        # base init in row mode (its col branch expects a mesh); the
+        # monotone/interaction arrays stay GLOBAL-feature-indexed, which
+        # is exactly what the replicated pq bookkeeping indexes with the
+        # winner's global feature ids
+        super().__init__(param, max_nbins, cuts, hist_method=hist_method,
+                         mesh=None, monotone=monotone,
+                         constraint_sets=constraint_sets,
+                         has_missing=has_missing, split_mode="row")
+        self.split_mode = "col"
+        self.comm = collective.get_communicator()
+        self._f_offset: Optional[int] = None
+        self._F_global: Optional[int] = None
+        self._bins_np = None
+
+    @property
+    def f_offset(self) -> Optional[int]:
+        """Feature-block offset for the Booster's federated predict path
+        (same contract as VerticalFederatedGrower)."""
+        return self._f_offset
+
+    # hooks into LossguideGrower.grow ---------------------------------
+    def _feature_width(self, F: int) -> int:
+        return self._F_global
+
+    def _init_positions(self, n: int) -> np.ndarray:
+        return np.zeros(n, np.int32)
+
+    def _split_values(self, sf: np.ndarray, sb: np.ndarray) -> np.ndarray:
+        """Owner ranks resolve their winning features' thresholds from
+        local cuts; one sum-allreduce assembles the full array (leaves
+        carry feature -1 and contribute 0 everywhere)."""
+        off, F_loc = self._f_offset, self._F_loc
+        vals = np.zeros(len(sf), np.float32)
+        loc = (sf >= off) & (sf < off + F_loc)
+        if loc.any():
+            vals[loc] = self.cuts.split_values(sf[loc] - off, sb[loc])
+        return np.asarray(self.comm.allreduce(vals, op="sum"), np.float32)
+
+    def _functions(self):
+        if self._fns is not None:
+            return self._fns
+        comm = self.comm
+        base_local = np.asarray(self.cuts.n_real_bins()) > 0
+        F_loc = len(base_local)
+        self._F_loc = F_loc
+        nb = self.max_nbins - 1 if self.has_missing else self.max_nbins
+        w_local = (max(nb, 1) - 1) // 32 + 1
+        off, base_global, self.n_words = exchange_feature_topology(
+            comm, base_local, w_local)
+        self._f_offset = off
+        self._F_global = len(base_global)
+        n_words = self.n_words
+        missing_bin = (self.max_nbins - 1 if self.has_missing
+                       else self.max_nbins)
+        mono_loc = (None if self.monotone is None else
+                    jnp.asarray(np.asarray(self.monotone)[off:off + F_loc]))
+        param = self.param
+
+        from ..ops.split import SplitResult
+
+        def _host_bins(bins):
+            if self._bins_np is None or self._bins_np[0] is not bins:
+                self._bins_np = (bins, np.asarray(bins))
+            return self._bins_np[1]
+
+        def eval2(bins, gpair, positions, i0, i1, psums, fm, lo2, hi2,
+                  n_real_bins, bins_t):
+            rel = np.where(positions == int(i0), 0,
+                           np.where(positions == int(i1), 1, 2)
+                           ).astype(np.int32)
+            hist = build_hist(bins, gpair, jnp.asarray(rel), 2,
+                              self.max_nbins, method=self.hist_method,
+                              bins_t=bins_t)
+            fm_loc = jnp.asarray(np.asarray(fm)[:, off:off + F_loc])
+            res = evaluate_splits(hist, psums, n_real_bins, param,
+                                  feature_mask=fm_loc, monotone=mono_loc,
+                                  node_lower=lo2, node_upper=hi2,
+                                  cat=self.cat,
+                                  has_missing=self.has_missing)
+            loc_words = np.asarray(res.cat_words, np.uint32)
+            if loc_words.shape[1] < n_words:
+                loc_words = np.pad(
+                    loc_words, ((0, 0), (0, n_words - loc_words.shape[1])))
+            payload = {
+                "gain": np.asarray(res.gain, np.float32),
+                "feature": np.asarray(res.feature, np.int32) + off,
+                "bin": np.asarray(res.bin, np.int32),
+                "default_left": np.asarray(res.default_left, bool),
+                "left_sum": np.asarray(res.left_sum, np.float32),
+                "right_sum": np.asarray(res.right_sum, np.float32),
+                "is_cat": np.asarray(res.is_cat, bool),
+                "cat_words": loc_words,
+            }
+            cands = comm.allgather_objects(payload)
+            gains = np.stack([c["gain"] for c in cands])       # [P, 2]
+            winner = np.argmax(gains, axis=0)
+            sel = np.arange(gains.shape[1])
+
+            def pick(k):
+                return np.stack([c[k] for c in cands])[winner, sel]
+
+            return SplitResult(
+                gain=gains[winner, sel], feature=pick("feature"),
+                bin=pick("bin"), default_left=pick("default_left"),
+                left_sum=pick("left_sum"), right_sum=pick("right_sum"),
+                is_cat=pick("is_cat"), cat_words=pick("cat_words"))
+
+        def apply1(bins, positions, nid, feat, sbin, dleft, ric, words,
+                   li, ri, _mb):
+            f = int(feat)
+            at_node = positions == int(nid)
+            if off <= f < off + F_loc:
+                b = _host_bins(bins)[:, f - off].astype(np.int32)
+                go_right = b > int(sbin)
+                if bool(ric):
+                    w_np = np.asarray(words, np.uint32)
+                    widx = np.clip(b // 32, 0, n_words - 1)
+                    bit = (w_np[widx] >> (b % 32).astype(np.uint32)
+                           ) & np.uint32(1)
+                    go_right = bit == 0
+                go_right = np.where(b == missing_bin, not bool(dleft),
+                                    go_right)
+                contrib = (at_node & go_right).astype(np.uint8)
+            else:
+                contrib = np.zeros(positions.shape[0], np.uint8)
+            bits = np.asarray(comm.allreduce(contrib, op="sum")) > 0
+            child = np.where(bits, int(ri), int(li))
+            return np.where(at_node, child, positions).astype(np.int32)
+
+        # rows replicate: the local sum IS the global root sum, via the
+        # same XLA reduction as the pooled path (numpy's pairwise sum
+        # differs in low-order f32 bits)
+        root_sum = jax.jit(lambda g: jnp.sum(g, axis=0))
+
+        def gather(lv, pos):
+            return jnp.asarray(np.asarray(lv)[pos])
+
+        self._fns = (eval2, apply1, root_sum, gather)
+        return self._fns
 
 
 def federated_vertical_margin(trees, tree_info, n_groups: int,
