@@ -434,14 +434,6 @@ class MultiTargetGrower:
             raise NotImplementedError(
                 "multi_output_tree supports grow_policy=depthwise only; "
                 "use MultiLossguideGrower via grow_policy=lossguide")
-        if param.max_leaves > 0 and mesh is not None and any(
-                d.process_index != jax.process_index()
-                for d in mesh.devices.flat):
-            # the truncation schedule runs host-side over [n] positions; a
-            # multi-process mesh's positions span non-addressable devices
-            raise NotImplementedError(
-                "multi_output_tree max_leaves is not supported on "
-                "multi-process meshes yet")
         if split_mode == "col" and mesh is None:
             raise ValueError("data_split_mode=col requires a mesh")
         self.param = param
@@ -466,6 +458,7 @@ class MultiTargetGrower:
                 self.constraint_sets = jnp.pad(self.constraint_sets,
                                                ((0, 0), (0, pad)))
         self._sharded_fn = None
+        self._repark_fn = None
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
              n_real_bins: jnp.ndarray, key: jax.Array) -> GrownMulti:
@@ -504,10 +497,19 @@ class MultiTargetGrower:
         new_is_leaf = exists & ~selected
         leaf_value = np.where(new_is_leaf[:, None], base_weight,
                               0.0).astype(np.float32)
-        pos = np.asarray(g.positions)
-        for _ in range(self.param.max_depth):
-            # re-park rows of truncated subtrees on the surviving ancestor
-            pos = np.where(exists[pos], pos, (pos - 1) // 2)
+        if self.mesh is not None and self.split_mode == "row":
+            # row-split mesh: positions are data-sharded (and on a
+            # multi-process mesh not host-addressable) — re-park rows of
+            # truncated subtrees ON DEVICE with the replicated node arrays
+            pos, delta = self._repark(g.positions, jnp.asarray(exists),
+                                      jnp.asarray(leaf_value))
+        else:
+            pos = np.asarray(g.positions)
+            for _ in range(self.param.max_depth):
+                # re-park rows of truncated subtrees on the ancestor
+                pos = np.where(exists[pos], pos, (pos - 1) // 2)
+            pos = pos.astype(np.int32)
+            delta = jnp.asarray(leaf_value[pos])
         return GrownMulti(
             split_feature=np.where(selected, np.asarray(g.split_feature),
                                    -1).astype(np.int32),
@@ -519,10 +521,32 @@ class MultiTargetGrower:
             node_sum=np.asarray(g.node_sum),
             gain=np.where(selected, np.asarray(g.gain),
                           0.0).astype(np.float32),
-            positions=pos.astype(np.int32),
-            delta=jnp.asarray(leaf_value[pos]),
+            positions=pos, delta=delta,
             base_weight=np.where(exists[:, None], base_weight,
                                  0.0).astype(np.float32))
+
+    def _repark(self, positions, exists, leaf_value):
+        """Device-side max_leaves re-park over sharded positions: walk each
+        row up to its deepest surviving ancestor and gather its new leaf
+        vector — one shard_map dispatch, no host pull of [n] arrays."""
+        from ..context import DATA_AXIS
+
+        if self._repark_fn is None:
+            P = jax.sharding.PartitionSpec
+            max_depth = self.param.max_depth
+
+            def repark(pos, ex, lv):
+                def body(_, p):
+                    return jnp.where(ex[p], p, (p - 1) // 2)
+
+                pos = jax.lax.fori_loop(0, max_depth, body, pos)
+                return pos, lv[pos]
+
+            self._repark_fn = jax.jit(jax.shard_map(
+                repark, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS), P(), P()),
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS, None))))
+        return self._repark_fn(positions, exists, leaf_value)
 
     def _sharded(self, bins, gpair, n_real_bins, tree_mask, key):
         from ..context import DATA_AXIS
@@ -584,15 +608,20 @@ class MultiTargetGrower:
 
 def _eval2_multi(bins, gpair, positions, id0, id1, parent_sums, fmask,
                  n_real_bins, bins_t, *, param: TrainParam, max_nbins: int,
-                 hist_method: str, has_missing: bool = True):
+                 hist_method: str, has_missing: bool = True,
+                 axis_name: Optional[str] = None):
     """Histogram + shared-split enumeration for (up to) two sibling nodes
     over the K-channel gradient — the vector-leaf mirror of
     ``lossguide._eval2`` (``bins_t``: loop-invariant transpose, once per
-    tree)."""
+    tree). Under a row-split mesh the two-node histogram psums across the
+    data axis, one collective per split (the same placement as the
+    depthwise ``_grow_multi`` level psum)."""
     rel = jnp.where(positions == id0, 0,
                     jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
     hist = build_hist_multi(bins, gpair, rel, 2, max_nbins,
                             method=hist_method, bins_t=bins_t)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
     return evaluate_splits_multi(hist, parent_sums, n_real_bins, param,
                                  feature_mask=fmask,
                                  has_missing=has_missing)
@@ -614,10 +643,6 @@ class MultiLossguideGrower:
                  has_missing: bool = True,
                  constraint_sets: Optional[np.ndarray] = None,
                  split_mode: str = "row") -> None:
-        if mesh is not None:
-            raise NotImplementedError(
-                "multi_output_tree lossguide does not support device "
-                "meshes yet; use depthwise or a single chip")
         if split_mode != "row":
             raise NotImplementedError(
                 "multi_output_tree lossguide supports data_split_mode=row "
@@ -629,7 +654,7 @@ class MultiLossguideGrower:
         self.max_nbins = max_nbins
         self.cuts = cuts
         self.hist_method = hist_method
-        self.mesh = None
+        self.mesh = mesh
         self.has_missing = has_missing
         self.constraint_sets = (None if constraint_sets is None
                                 else np.asarray(constraint_sets, bool))
@@ -639,12 +664,45 @@ class MultiLossguideGrower:
         if self._fns is None:
             from .lossguide import _apply1
 
-            ev = functools.partial(
-                _eval2_multi, param=self.param, max_nbins=self.max_nbins,
-                hist_method=self.hist_method, has_missing=self.has_missing)
-            self._fns = (jax.jit(ev), jax.jit(_apply1),
-                         jax.jit(lambda g: jnp.sum(g, axis=0)),
-                         jax.jit(lambda lv, pos: lv[pos]))
+            kw = dict(param=self.param, max_nbins=self.max_nbins,
+                      hist_method=self.hist_method,
+                      has_missing=self.has_missing)
+            if self.mesh is None:
+                ev = functools.partial(_eval2_multi, axis_name=None, **kw)
+                self._fns = (jax.jit(ev), jax.jit(_apply1),
+                             jax.jit(lambda g: jnp.sum(g, axis=0)),
+                             jax.jit(lambda lv, pos: lv[pos]))
+            else:
+                # row-split mesh (VERDICT r4 #5): the same two per-split
+                # kernels as the scalar lossguide mesh branch, K-channel —
+                # rows shard, the two-node histogram psums once per split
+                from ..context import DATA_AXIS
+                from .lossguide import _root_sum
+                P = jax.sharding.PartitionSpec
+
+                ev = functools.partial(_eval2_multi, axis_name=DATA_AXIS,
+                                       **kw)
+                sharded_eval = jax.jit(jax.shard_map(
+                    ev, mesh=self.mesh,
+                    in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None, None),
+                              P(DATA_AXIS), P(), P(), P(), P(), P(),
+                              P(None, DATA_AXIS)),
+                    out_specs=P()))
+                sharded_apply = jax.jit(jax.shard_map(
+                    _apply1, mesh=self.mesh,
+                    in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
+                              P(), P(), P(), P(), P(), P(), P()),
+                    out_specs=P(DATA_AXIS)))
+                sharded_root = jax.jit(jax.shard_map(
+                    functools.partial(_root_sum, axis_name=DATA_AXIS),
+                    mesh=self.mesh,
+                    in_specs=(P(DATA_AXIS, None, None),), out_specs=P()))
+                sharded_gather = jax.jit(jax.shard_map(
+                    lambda lv, pos: lv[pos], mesh=self.mesh,
+                    in_specs=(P(), P(DATA_AXIS)),
+                    out_specs=P(DATA_AXIS, None)))
+                self._fns = (sharded_eval, sharded_apply, sharded_root,
+                             sharded_gather)
         return self._fns
 
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
