@@ -160,13 +160,17 @@ def test_paged_mesh_multi_output_tree(tmp_path, monkeypatch, mesh):
     qdm_p = xgb.QuantileDMatrix(it, max_bin=64)
     assert qdm_p.binned(64).n_pages() > 1
     qdm_m = xgb.QuantileDMatrix(BatchIter(X, y, n_batches=4), max_bin=64)
+    # max_leaves exercises the device-side truncation re-park over the
+    # sharded positions (r5: works on any mesh, paged included)
     params = {"objective": "reg:squarederror", "max_depth": 4,
               "multi_strategy": "multi_output_tree", "mesh": mesh,
-              "max_bin": 64}
+              "max_bin": 64, "max_leaves": 10}
     bst_p = xgb.train(params, qdm_p, 4, verbose_eval=False)
     bst_m = xgb.train(params, qdm_m, 4, verbose_eval=False)
     trees_p, trees_m = bst_p.gbm.trees, bst_m.gbm.trees
     assert len(trees_p) == len(trees_m) == 4
+    for t in trees_p:
+        assert int(np.asarray(t.is_leaf).sum()) <= 10
     for tp, tm in zip(trees_p, trees_m):
         np.testing.assert_array_equal(tp.split_feature, tm.split_feature)
         np.testing.assert_array_equal(tp.split_bin, tm.split_bin)

@@ -1,0 +1,31 @@
+"""doc == code for the feature x tier support matrix (VERDICT r4 #7).
+
+``tools/support_matrix.py`` derives the matrix by RUNNING every
+(feature, tier) combination; this test regenerates it and asserts the
+table embedded in ``docs/distributed.md`` matches exactly — a support
+claim that contradicts the guards cannot survive a test run."""
+
+import os
+import re
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+@pytest.mark.slow
+def test_doc_matrix_matches_guards():
+    import support_matrix as sm
+
+    generated = sm.to_markdown(sm.support_matrix())
+    with open(os.path.join(ROOT, "docs", "distributed.md")) as fh:
+        doc = fh.read()
+    m = re.search(r"<!-- BEGIN SUPPORT MATRIX -->\n(.*?)\n"
+                  r"<!-- END SUPPORT MATRIX -->", doc, re.S)
+    assert m, "docs/distributed.md lost its support-matrix markers"
+    assert m.group(1).strip() == generated.strip(), (
+        "docs/distributed.md support matrix drifted from the guards — "
+        "regenerate with `python tools/support_matrix.py` and paste "
+        "between the markers.\n\nGENERATED:\n" + generated)

@@ -107,8 +107,13 @@ class GBLinear:
 
     def _X_of(self, state: dict) -> jnp.ndarray:
         if "linear_X" not in state:
-            X = np.nan_to_num(np.asarray(state["dm"].X, dtype=np.float32),
-                              nan=0.0)
+            dm_x = state["dm"].X
+            if getattr(dm_x, "is_paged", False) or np.ndim(dm_x) != 2:
+                # the dense-matmul linear round wants the resident matrix
+                raise NotImplementedError(
+                    "booster=gblinear does not support external-memory "
+                    "(paged) matrices; train on a resident DMatrix")
+            X = np.nan_to_num(np.asarray(dm_x, dtype=np.float32), nan=0.0)
             state["linear_X"] = jnp.asarray(X)
         return state["linear_X"]
 

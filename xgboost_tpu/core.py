@@ -524,10 +524,13 @@ class Booster:
                 # losslessly — neither trains against a shared binned matrix,
                 # so margins always walk raw thresholds (binned=None).
                 binned = None
+                self._check_row_comm_sync(paged=False)
             elif is_train:
                 binned = dm.binned(self.tree_param.max_bin)
                 if self.ctx.mesh is not None:
                     return self._make_sharded_train_state(key, dm, binned)
+                self._check_row_comm_sync(
+                    paged=getattr(binned, "is_paged", False))
             else:
                 train_cuts = None
                 for st in self._caches.values():
@@ -544,6 +547,31 @@ class Booster:
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
             self._store_cache(key, binned, margin, is_train, dm, dm.info, n)
         return self._caches[key]
+
+    def _check_row_comm_sync(self, paged: bool) -> None:
+        """Refuse silently-local training: with an active world>1
+        communicator and no device mesh, ROW-split training syncs only on
+        the external-memory tier (per-level histogram allreduce,
+        tree/paged.py) — the resident growers run the whole tree in one
+        jitted program with no communicator hook, so each rank would fit
+        only its local rows and diverge without any error. The reference
+        allreduces inside its hist builders (src/tree/hist/histogram.h:
+        183-190); our multi-host resident path is the global mesh
+        (parallel/launch.train_per_host, mesh = world)."""
+        if paged or self.learner_params.get(
+                "data_split_mode", "row") != "row":
+            return
+        from .parallel import collective
+
+        comm = collective.get_communicator()
+        if comm.is_distributed() and comm.get_world_size() > 1:
+            raise NotImplementedError(
+                "row-split training of a RESIDENT matrix under a "
+                "multi-rank communicator is not synchronized (each rank "
+                "would silently fit only its local rows); use "
+                "parallel.launch.train_per_host (sharded ingestion over "
+                "the global mesh) or an external-memory DMatrix (pages "
+                "sync through the communicator)")
 
     def _store_cache(self, key, binned, margin, is_train, dm, info,
                      n_valid):

@@ -383,8 +383,14 @@ class PagedBinnedMatrix:
 
     def pages(self, device=None):
         """(start, end, device_page) triples through the prefetch ring."""
-        n = self.n_rows
-        if n == 0:
+        yield from self.stream_pages(
+            list(range(0, self.n_rows, self.page_rows)), device)
+
+    def stream_pages(self, starts, device=None):
+        """(start, end, device_page) for the given page starts, through
+        the prefetch ring (cache hits yield straight from HBM; uploads
+        cache under the budget)."""
+        if not starts or self.n_rows == 0:
             return
         page_bytes = (self.page_rows * self.n_features
                       * self.bins_host.dtype.itemsize)
@@ -393,10 +399,26 @@ class PagedBinnedMatrix:
             s, e, page, uploaded = self._fetch(s, device)
             return s, (e, page), uploaded
 
-        for s, (e, page) in self._ring(list(range(0, n, self.page_rows)),
-                                       fetch, self._device_cache,
+        for s, (e, page) in self._ring(starts, fetch, self._device_cache,
                                        page_bytes):
             yield s, e, page
+
+    def cached_split(self):
+        """``(cached, streamed)``: ``cached`` = [(s, e, page)] already in
+        the HBM page cache, ``streamed`` = page starts that must upload
+        this visit. Per-level consumers run ONE fused dispatch over every
+        cached page (each per-page dispatch over a remote-device tunnel
+        costs an RTT — with the cache warm that latency, not H2D, is the
+        whole gap to the resident tier) and ride the prefetch ring only
+        for the overflow."""
+        cached, streamed = [], []
+        for s in range(0, self.n_rows, self.page_rows):
+            hit = self._device_cache.get(s)
+            if hit is None:
+                streamed.append(s)
+            else:
+                cached.append((s, hit[0], hit[1]))
+        return cached, streamed
 
     def mesh_layout(self, world: int):
         """Row layout for mesh-sharded paging -> ``(n_pad, n_loc, p_loc)``.
@@ -426,8 +448,18 @@ class PagedBinnedMatrix:
         per GPU — here one mesh axis shard per chip). Uploads ride a
         one-page prefetch ring and cache in HBM under the same budget as
         the single-chip stream."""
+        world = mesh.shape[axis_name]
+        n_loc, p_loc = self.mesh_layout(world)[1:]
+        yield from self.stream_pages_sharded(
+            list(range(0, n_loc, p_loc)), mesh, axis_name)
+
+    def stream_pages_sharded(self, starts, mesh, axis_name: str):
+        """``(s_loc, page)`` for the given local page starts through the
+        prefetch ring (mesh-sharded variant of ``stream_pages``)."""
         import jax.sharding as jsh
 
+        if not starts:
+            return
         world = mesh.shape[axis_name]
         n_pad, n_loc, p_loc = self.mesh_layout(world)
         sharding = jsh.NamedSharding(mesh,
@@ -452,8 +484,22 @@ class PagedBinnedMatrix:
             return s_loc, page, uploaded
 
         yield from self._ring(
-            list(range(0, n_loc, p_loc)), fetch, self._mesh_cache,
+            starts, fetch, self._mesh_cache,
             world * p_loc * F * self.bins_host.dtype.itemsize)
+
+    def cached_split_mesh(self, world: int):
+        """``(cached, streamed)`` for the mesh page stream: ``cached`` =
+        [(s_loc, page)] already in the HBM cache, ``streamed`` = local
+        page starts needing upload (see ``cached_split``)."""
+        n_loc, p_loc = self.mesh_layout(world)[1:]
+        cached, streamed = [], []
+        for s in range(0, n_loc, p_loc):
+            page = self._mesh_cache.get(s)
+            if page is None:
+                streamed.append(s)
+            else:
+                cached.append((s, page))
+        return cached, streamed
 
     def to_values_host(self) -> np.ndarray:
         """Representative feature values from bin ids, page-wise on host

@@ -419,14 +419,16 @@ class _PageKernels:
     every slice/rel/update happens inside the jitted program — against a
     remote TPU each eager op between kernels is a tunnel round trip, and
     the round-3 paged tier spent most of its 6.5 s/round in exactly that
-    op soup. Each level is ONE dispatch per page: the first level builds
-    the root histogram; later levels FUSE the previous level's position
-    advance with this level's histogram, so a page is read once per level
-    and a round costs (depth+1) passes instead of 2*depth. Histograms
-    accumulate into a donated device buffer across pages (reference: the
+    op soup. The first level builds the root histogram; later levels FUSE
+    the previous level's position advance with this level's histogram, so
+    a page is read once per level and a round costs (depth+1) passes
+    instead of 2*depth. Since round 5 each pass is ONE dispatch over ALL
+    HBM-cached pages (``_drive``) — with a warm page cache the per-page
+    dispatch RTT, not H2D, was the whole remaining gap to the resident
+    tier — and only cache-overflow pages go one-dispatch-per-page through
+    the prefetch ring, upload overlapped one page ahead (reference: the
     prefetch ring hides page IO behind compute,
-    ``src/data/sparse_page_source.h:180-200``; here dispatch latency is
-    the page IO)."""
+    ``src/data/sparse_page_source.h:180-200``)."""
 
     def __init__(self, max_nbins: int, missing_bin: int,
                  hist_kernel: str) -> None:
@@ -454,19 +456,56 @@ class _PageKernels:
                  + ((gpair.shape[1], 2) if multi else (2,)))
         return jnp.zeros(shape, jnp.float32)
 
+    def _drive(self, paged, key, make_body, carry, consts):
+        """Run ``body(carry, page, start, consts)`` over every page: ONE
+        fused jitted dispatch covering all HBM-cached pages (r5: each
+        per-page dispatch over a remote-device tunnel costs an RTT, and
+        with a warm cache that latency — not H2D — was the paged tier's
+        whole gap to the resident path), then the prefetch ring for the
+        cache overflow, one dispatch each with the next upload overlapped
+        one page ahead. The carry pytree is donated both ways."""
+        cached, streamed = paged.cached_split()
+        if cached:
+            def build_fused():
+                body = make_body()
+
+                def fn(carry, consts, starts, pages):
+                    for st, page in zip(starts, pages):
+                        carry = body(carry, page, st, consts)
+                    return carry
+
+                return jax.jit(fn, donate_argnums=0)
+
+            fused = self._cached(key + ("fused",), build_fused)
+            carry = fused(carry, consts,
+                          tuple(jnp.int32(s) for s, _, _ in cached),
+                          tuple(p for _, _, p in cached))
+        if streamed:
+            def build_single():
+                body = make_body()
+                return jax.jit(
+                    lambda carry, page, s, consts:
+                    body(carry, page, s, consts), donate_argnums=0)
+
+            single = self._cached(key + ("single",), build_single)
+            for s, e, page in paged.stream_pages(streamed):
+                carry = single(carry, page, jnp.int32(s), consts)
+        return carry
+
     def level_hist(self, paged, gpair, positions, lo, n_level, n_static,
                    multi=False, coarse=False):
         """Histogram-only pass (the root level of each tree). With
-        ``coarse`` the pass builds the 20-slot coarse histogram of the
-        two-level scheme over ``bins >> 4`` (computed in-kernel)."""
+        ``coarse`` the pass builds the coarse histogram of the two-level
+        scheme over ``bins >> 4`` (computed in-kernel)."""
         from ..ops.split import COARSE_B
 
         B = COARSE_B if coarse else self.max_nbins
 
-        def build():
+        def make_body():
             builder = self._builder(multi)
 
-            def fn(acc, page, gp, pos, s, lo_d, nl_d):
+            def body(acc, page, s, consts):
+                gp, pos, lo_d, nl_d = consts
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
@@ -476,20 +515,18 @@ class _PageKernels:
                 return acc + builder(data, gp_pg, rel, n_static, B,
                                      method=self.hist_kernel)
 
-            return jax.jit(fn, donate_argnums=0)
+            return body
 
-        fn = self._cached(("hist", n_static, multi, coarse), build)
         acc = self._acc_zeros(paged, gpair, n_static, multi,
                               nbins=B if coarse else None)
-        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
-        for s, e, page in paged.pages():
-            acc = fn(acc, page, gpair, positions, jnp.int32(s), lo_d, nl_d)
-        return acc
+        return self._drive(
+            paged, ("hist", n_static, multi, coarse), make_body, acc,
+            (gpair, positions, jnp.int32(lo), jnp.int32(n_level)))
 
     def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
                  multi=False, coarse=False):
         """The fused pass: advance rows below the PREVIOUS level's splits,
-        then build THIS level's histogram — one dispatch per page."""
+        then build THIS level's histogram — one page read per level."""
         from ..ops.split import COARSE_B
 
         B = COARSE_B if coarse else self.max_nbins
@@ -498,12 +535,14 @@ class _PageKernels:
         n_arr = len(prev["arrs"])
         W = None if cat is None else int(cat[1].shape[1])
 
-        def build():
+        def make_body():
             builder = self._builder(multi)
 
-            def fn(acc, page, gp, pos, s, lo_prev, nl_prev, lo_d, nl_d,
-                   *rest):
-                arrs, cat_args = rest[:n_arr], rest[n_arr:]
+            def body(carry, page, s, consts):
+                pos, acc = carry
+                gp, lo_prev, nl_prev, lo_d, nl_d = consts[:5]
+                arrs = consts[5:5 + n_arr]
+                cat_args = consts[5 + n_arr:]
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
@@ -518,20 +557,16 @@ class _PageKernels:
                             method=self.hist_kernel)
                 return pos, acc + h
 
-            return jax.jit(fn, donate_argnums=(0, 3))
+            return body
 
-        fn = self._cached(("advhist", kind, n_static, multi, W, coarse),
-                          build)
         acc = self._acc_zeros(paged, gpair, n_static, multi,
                               nbins=B if coarse else None)
         extra = prev["arrs"] + (() if cat is None else tuple(cat))
-        lo_prev = jnp.int32(prev["lo"])
-        nl_prev = jnp.int32(prev["n_level"])
-        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
-        for s, e, page in paged.pages():
-            positions, acc = fn(acc, page, gpair, positions, jnp.int32(s),
-                                lo_prev, nl_prev, lo_d, nl_d, *extra)
-        return positions, acc
+        consts = (gpair, jnp.int32(prev["lo"]), jnp.int32(prev["n_level"]),
+                  jnp.int32(lo), jnp.int32(n_level)) + extra
+        return self._drive(
+            paged, ("advhist", kind, n_static, multi, W, coarse),
+            make_body, (positions, acc), consts)
 
     def refine_hist(self, paged, gpair, positions, span, lo, n_level,
                     n_static):
@@ -541,27 +576,26 @@ class _PageKernels:
         discarded out-of-window pads."""
         from ..ops.split import WINDOW
 
-        def build():
-            def fn(acc, page, gp, pos, s, lo_d, nl_d, span_d):
+        def make_body():
+            def body(acc, page, s, consts):
+                gp, pos, lo_d, nl_d, span_d = consts
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
                 rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
                 rb = _refine_bins(page, rel, span_d, n_static,
                                   self.missing_bin)
-                h = build_hist(rb, gp_pg, rel, n_static, WINDOW + 4,
-                               method=self.hist_kernel)
-                return acc + h
+                return acc + build_hist(rb, gp_pg, rel, n_static,
+                                        WINDOW + 4,
+                                        method=self.hist_kernel)
 
-            return jax.jit(fn, donate_argnums=0)
+            return body
 
-        fn = self._cached(("rhist", n_static), build)
         acc = self._acc_zeros(paged, gpair, n_static, False,
                               nbins=WINDOW + 4)
-        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
-        for s, e, page in paged.pages():
-            acc = fn(acc, page, gpair, positions, jnp.int32(s), lo_d, nl_d,
-                     span)
+        acc = self._drive(
+            paged, ("rhist", n_static), make_body, acc,
+            (gpair, positions, jnp.int32(lo), jnp.int32(n_level), span))
         return acc[:, :, :WINDOW, :]
 
     def final_advance(self, paged, positions, prev, n_static):
@@ -571,9 +605,11 @@ class _PageKernels:
         n_arr = len(prev["arrs"])
         W = None if cat is None else int(cat[1].shape[1])
 
-        def build():
-            def fn(page, pos, s, lo_prev, nl_prev, *rest):
-                arrs, cat_args = rest[:n_arr], rest[n_arr:]
+        def make_body():
+            def body(pos, page, s, consts):
+                lo_prev, nl_prev = consts[:2]
+                arrs = consts[2:2 + n_arr]
+                cat_args = consts[2 + n_arr:]
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 newp = _advance_rows(page, pos_pg, kind, arrs, cat_args,
@@ -581,21 +617,18 @@ class _PageKernels:
                                      self.missing_bin)
                 return jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
 
-            return jax.jit(fn, donate_argnums=1)
+            return body
 
-        fn = self._cached(("adv", kind, n_static, W), build)
         extra = prev["arrs"] + (() if cat is None else tuple(cat))
-        lo_prev = jnp.int32(prev["lo"])
-        nl_prev = jnp.int32(prev["n_level"])
-        for s, e, page in paged.pages():
-            positions = fn(page, positions, jnp.int32(s), lo_prev, nl_prev,
-                           *extra)
-        return positions
+        return self._drive(
+            paged, ("adv", kind, n_static, W), make_body, positions,
+            (jnp.int32(prev["lo"]), jnp.int32(prev["n_level"])) + extra)
 
     def pair_hist(self, paged, gpair, positions, i0, i1):
         """Two-node (lossguide sibling pair) histogram over the pages."""
-        def build():
-            def fn(acc, page, gp, pos, s, i0_d, i1_d):
+        def make_body():
+            def body(acc, page, s, consts):
+                gp, pos, i0_d, i1_d = consts
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
@@ -605,14 +638,12 @@ class _PageKernels:
                 return acc + build_hist(page, gp_pg, rel, 2, self.max_nbins,
                                         method=self.hist_kernel)
 
-            return jax.jit(fn, donate_argnums=0)
+            return body
 
-        fn = self._cached(("hist2",), build)
         acc = self._acc_zeros(paged, gpair, 2, False)
-        i0_d, i1_d = jnp.int32(i0), jnp.int32(i1)
-        for s, e, page in paged.pages():
-            acc = fn(acc, page, gpair, positions, jnp.int32(s), i0_d, i1_d)
-        return acc
+        return self._drive(
+            paged, ("hist2",), make_body, acc,
+            (gpair, positions, jnp.int32(i0), jnp.int32(i1)))
 
     def apply1(self, paged, positions, nid, feat, sbin, dleft, is_cat,
                words, left_id, right_id, missing_bin):
@@ -621,24 +652,22 @@ class _PageKernels:
 
         W = int(np.asarray(words).shape[0])
 
-        def build():
-            def fn(page, pos, s, nid_d, feat_d, sbin_d, dl_d, ic_d,
-                   words_d, li_d, ri_d, mb_d):
+        def make_body():
+            def body(pos, page, s, consts):
+                (nid_d, feat_d, sbin_d, dl_d, ic_d, words_d, li_d, ri_d,
+                 mb_d) = consts
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 newp = _apply1(page, pos_pg, nid_d, feat_d, sbin_d, dl_d,
                                ic_d, words_d, li_d, ri_d, mb_d)
                 return jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
 
-            return jax.jit(fn, donate_argnums=1)
+            return body
 
-        fn = self._cached(("apply1", W), build)
-        words_d = jnp.asarray(words)
-        for s, e, page in paged.pages():
-            positions = fn(page, positions, jnp.int32(s), nid, feat, sbin,
-                           dleft, is_cat, words_d, left_id, right_id,
-                           missing_bin)
-        return positions
+        return self._drive(
+            paged, ("apply1", W), make_body, positions,
+            (nid, feat, sbin, dleft, is_cat, jnp.asarray(words), left_id,
+             right_id, missing_bin))
 
 
 def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
@@ -710,6 +739,49 @@ class _MeshPageKernels:
 
         return self._cached(("zeros", shape), build)()
 
+    def _drive(self, paged, key, make_body, carry, carry_spec, consts,
+               consts_spec):
+        """Mesh twin of ``_PageKernels._drive``: one fused shard_map
+        dispatch over every HBM-cached page, then the prefetch ring for
+        the overflow — the per-page dispatch RTT is the same tax on every
+        tier. ``body(carry, page, s_loc, consts)`` is shard-local."""
+        P = jax.sharding.PartitionSpec
+        page_spec = P(self.axis, None)
+        cached, streamed = paged.cached_split_mesh(self.world)
+        if cached:
+            def build_fused():
+                body = make_body()
+
+                def fn(carry, consts, starts, pages):
+                    for st, page in zip(starts, pages):
+                        carry = body(carry, page, st, consts)
+                    return carry
+
+                return jax.jit(jax.shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(carry_spec, consts_spec, P(), page_spec),
+                    out_specs=carry_spec), donate_argnums=0)
+
+            fused = self._cached(key + ("fused",), build_fused)
+            carry = fused(carry, consts,
+                          tuple(jnp.int32(s) for s, _ in cached),
+                          tuple(p for _, p in cached))
+        if streamed:
+            def build_single():
+                body = make_body()
+                return jax.jit(jax.shard_map(
+                    lambda carry, page, s, consts:
+                    body(carry, page, s, consts),
+                    mesh=self.mesh,
+                    in_specs=(carry_spec, page_spec, P(), consts_spec),
+                    out_specs=carry_spec), donate_argnums=0)
+
+            single = self._cached(key + ("single",), build_single)
+            for s_loc, page in paged.stream_pages_sharded(
+                    streamed, self.mesh, self.axis):
+                carry = single(carry, page, jnp.int32(s_loc), consts)
+        return carry
+
     def _hist_over_pages(self, paged, gpair, positions, rel_fn, n_nodes,
                          multi, key, extra, nbins=None, data_fn=None):
         """Shared page loop: ``rel_fn(pos_page, *extra)`` maps positions to
@@ -722,14 +794,17 @@ class _MeshPageKernels:
         axis = self.axis
         K = gpair.shape[1] if multi else None
         B = nbins or self.max_nbins
+        gspec = P(axis, None, None) if multi else P(axis, None)
+        acc_spec = P(axis, *([None] * (4 + int(multi))))
 
-        def build_acc():
+        def make_body():
             from ..ops.histogram import build_hist_multi
 
             builder = build_hist_multi if multi else build_hist
-            gspec = P(axis, None, None) if multi else P(axis, None)
 
-            def inner(acc, page, gp, pos, s_loc, *extra_d):
+            def body(acc, page, s_loc, consts):
+                gp, pos = consts[:2]
+                extra_d = consts[2:]
                 p = page.shape[0]
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
@@ -740,26 +815,21 @@ class _MeshPageKernels:
                             method=self.hist_kernel)
                 return acc + h[None]
 
-            acc_spec = P(axis, *([None] * (4 + int(multi))))
-            return jax.jit(jax.shard_map(
-                inner, mesh=self.mesh,
-                in_specs=(acc_spec, P(axis, None), gspec, P(axis))
-                + (P(),) * (1 + len(extra)),
-                out_specs=acc_spec), donate_argnums=0)
+            return body
 
         def build_fin():
-            acc_spec = P(axis, *([None] * (4 + int(multi))))
             return jax.jit(jax.shard_map(
                 lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
                 in_specs=(acc_spec,), out_specs=P()))
 
-        fn = self._cached(key + ("acc", K), build_acc)
         fin = self._cached(key + ("fin", K), build_fin)
         shape = ((self.world, n_nodes, paged.n_features, B)
                  + ((K, 2) if multi else (2,)))
         acc = self._acc_zeros(shape)
-        for s_loc, page in paged.pages_sharded(self.mesh, axis):
-            acc = fn(acc, page, gpair, positions, jnp.int32(s_loc), *extra)
+        acc = self._drive(
+            paged, key + ("acc", K), make_body, acc, acc_spec,
+            (gpair, positions) + tuple(extra),
+            (gspec, P(axis)) + (P(),) * len(extra))
         return fin(acc)
 
     def level_hist(self, paged, gpair, positions, lo: int, n_level: int,
@@ -804,9 +874,9 @@ class _MeshPageKernels:
 
     def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
                  multi=False, coarse=False):
-        """Fused advance(previous level) + histogram(this level), one
-        shard_map dispatch per page; shard-local partials accumulate and
-        psum once at level end."""
+        """Fused advance(previous level) + histogram(this level);
+        shard-local partials accumulate across pages and psum once at
+        level end."""
         from ..ops.split import COARSE_B
 
         P = jax.sharding.PartitionSpec
@@ -817,16 +887,19 @@ class _MeshPageKernels:
         W = None if cat is None else int(cat[1].shape[1])
         K = gpair.shape[1] if multi else None
         B = COARSE_B if coarse else self.max_nbins
+        gspec = P(axis, None, None) if multi else P(axis, None)
+        acc_spec = P(axis, *([None] * (4 + int(multi))))
 
-        def build_acc():
+        def make_body():
             from ..ops.histogram import build_hist_multi
 
             builder = build_hist_multi if multi else build_hist
-            gspec = P(axis, None, None) if multi else P(axis, None)
 
-            def inner(acc, page, gp, pos, s_loc, lo_prev, nl_prev, lo_d,
-                      nl_d, *rest):
-                arrs, cat_args = rest[:n_arr], rest[n_arr:]
+            def body(carry, page, s_loc, consts):
+                pos, acc = carry
+                gp, lo_prev, nl_prev, lo_d, nl_d = consts[:5]
+                arrs = consts[5:5 + n_arr]
+                cat_args = consts[5 + n_arr:]
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
@@ -842,35 +915,24 @@ class _MeshPageKernels:
                             method=self.hist_kernel)
                 return pos, acc + h[None]
 
-            acc_spec = P(axis, *([None] * (4 + int(multi))))
-            # scalars: s_loc, lo_prev, nl_prev, lo, n_level
-            n_extra = 5 + n_arr + (0 if W is None else 2)
-            return jax.jit(jax.shard_map(
-                inner, mesh=self.mesh,
-                in_specs=(acc_spec, P(axis, None), gspec, P(axis))
-                + (P(),) * n_extra,
-                out_specs=(P(axis), acc_spec)), donate_argnums=(0, 3))
+            return body
 
         def build_fin():
-            acc_spec = P(axis, *([None] * (4 + int(multi))))
             return jax.jit(jax.shard_map(
                 lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
                 in_specs=(acc_spec,), out_specs=P()))
 
-        fn = self._cached(("advhist", kind, n_static, multi, W, coarse),
-                          build_acc)
         fin = self._cached(("hist", n_static, "fin", K), build_fin)
         shape = ((self.world, n_static, paged.n_features, B)
                  + ((K, 2) if multi else (2,)))
         acc = self._acc_zeros(shape)
         extra = prev["arrs"] + (() if cat is None else tuple(cat))
-        lo_prev = jnp.int32(prev["lo"])
-        nl_prev = jnp.int32(prev["n_level"])
-        lo_d, nl_d = jnp.int32(lo), jnp.int32(n_level)
-        for s_loc, page in paged.pages_sharded(self.mesh, axis):
-            positions, acc = fn(acc, page, gpair, positions,
-                                jnp.int32(s_loc), lo_prev, nl_prev, lo_d,
-                                nl_d, *extra)
+        consts = (gpair, jnp.int32(prev["lo"]), jnp.int32(prev["n_level"]),
+                  jnp.int32(lo), jnp.int32(n_level)) + extra
+        positions, acc = self._drive(
+            paged, ("advhist", kind, n_static, multi, W, coarse),
+            make_body, (positions, acc), (P(axis), acc_spec),
+            consts, (gspec,) + (P(),) * (len(consts) - 1))
         return positions, fin(acc)
 
     def final_advance(self, paged, positions, prev, n_static):
@@ -899,13 +961,13 @@ class _MeshPageKernels:
                       dleft, cs, cat=None):
         """Dense (matmul) one-level advance; per-node arrays replicated."""
         P = jax.sharding.PartitionSpec
-        axis = self.axis
         n_static = int(feat.shape[0])
         W = None if cat is None else int(cat[1].shape[1])
 
-        def build():
-            def inner(page, pos, s_loc, lo_d, n_level_d, feat_d, sbin_d,
-                      dl_d, cs_d, *cat_args):
+        def make_body():
+            def body(pos, page, s_loc, consts):
+                lo_d, n_level_d, feat_d, sbin_d, dl_d, cs_d = consts[:6]
+                cat_args = consts[6:]
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
                 rel = jnp.where(
@@ -919,29 +981,25 @@ class _MeshPageKernels:
                 return jax.lax.dynamic_update_slice_in_dim(
                     pos, newp, s_loc, 0)
 
-            n_cat = 0 if W is None else 2
-            return jax.jit(jax.shard_map(
-                inner, mesh=self.mesh,
-                in_specs=(P(axis, None), P(axis), P(), P(), P(), P(), P(),
-                          P(), P()) + (P(),) * n_cat,
-                out_specs=P(axis)))
+            return body
 
-        fn = self._cached(("adv", n_static, W), build)
         extra = () if cat is None else tuple(cat)
-        for s_loc, page in paged.pages_sharded(self.mesh, axis):
-            positions = fn(page, positions, jnp.int32(s_loc), jnp.int32(lo),
-                           jnp.int32(n_level), feat, sbin, dleft, cs, *extra)
-        return positions
+        consts = (jnp.int32(lo), jnp.int32(n_level), feat, sbin, dleft,
+                  cs) + extra
+        return self._drive(
+            paged, ("adv", n_static, W), make_body, positions, P(self.axis),
+            consts, (P(),) * len(consts))
 
     def walk_advance(self, paged, positions, sf, sb, dl, isf, cat=None):
         """Deep-level per-row gather walk; full tree arrays replicated."""
         P = jax.sharding.PartitionSpec
-        axis = self.axis
         W = None if cat is None else int(cat[1].shape[1])
         max_nodes = int(sf.shape[0])
 
-        def build():
-            def inner(page, pos, s_loc, sf_d, sb_d, dl_d, isf_d, *cat_args):
+        def make_body():
+            def body(pos, page, s_loc, consts):
+                sf_d, sb_d, dl_d, isf_d = consts[:4]
+                cat_args = consts[4:]
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
                 kw = ({} if not cat_args
@@ -952,19 +1010,13 @@ class _MeshPageKernels:
                 return jax.lax.dynamic_update_slice_in_dim(
                     pos, newp, s_loc, 0)
 
-            n_cat = 0 if W is None else 2
-            return jax.jit(jax.shard_map(
-                inner, mesh=self.mesh,
-                in_specs=(P(axis, None), P(axis), P(), P(), P(), P(), P())
-                + (P(),) * n_cat,
-                out_specs=P(axis)))
+            return body
 
-        fn = self._cached(("walk", max_nodes, W), build)
         extra = () if cat is None else tuple(cat)
-        for s_loc, page in paged.pages_sharded(self.mesh, axis):
-            positions = fn(page, positions, jnp.int32(s_loc), sf, sb, dl,
-                           isf, *extra)
-        return positions
+        consts = (sf, sb, dl, isf) + extra
+        return self._drive(
+            paged, ("walk", max_nodes, W), make_body, positions,
+            P(self.axis), consts, (P(),) * len(consts))
 
     def apply1(self, paged, positions, nid, feat, sbin, dleft, is_cat,
                words, left_id, right_id, missing_bin):
@@ -972,12 +1024,12 @@ class _MeshPageKernels:
         from .lossguide import _apply1
 
         P = jax.sharding.PartitionSpec
-        axis = self.axis
         W = int(words.shape[0])
 
-        def build():
-            def inner(page, pos, s_loc, nid_d, feat_d, sbin_d, dl_d, ic_d,
-                      words_d, li_d, ri_d, mb_d):
+        def make_body():
+            def body(pos, page, s_loc, consts):
+                (nid_d, feat_d, sbin_d, dl_d, ic_d, words_d, li_d, ri_d,
+                 mb_d) = consts
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
                 newp = _apply1(page, pos_pg, nid_d, feat_d, sbin_d, dl_d,
@@ -985,17 +1037,13 @@ class _MeshPageKernels:
                 return jax.lax.dynamic_update_slice_in_dim(
                     pos, newp, s_loc, 0)
 
-            return jax.jit(jax.shard_map(
-                inner, mesh=self.mesh,
-                in_specs=(P(axis, None), P(axis)) + (P(),) * 10,
-                out_specs=P(axis)))
+            return body
 
-        fn = self._cached(("apply1", W), build)
-        for s_loc, page in paged.pages_sharded(self.mesh, axis):
-            positions = fn(page, positions, jnp.int32(s_loc), nid, feat,
-                           sbin, dleft, is_cat, jnp.asarray(words), left_id,
-                           right_id, missing_bin)
-        return positions
+        consts = (nid, feat, sbin, dleft, is_cat, jnp.asarray(words),
+                  left_id, right_id, missing_bin)
+        return self._drive(
+            paged, ("apply1", W), make_body, positions, P(self.axis),
+            consts, (P(),) * len(consts))
 
 
 class PagedGrower(TreeGrower):
