@@ -181,6 +181,41 @@ def bench_dart_multiclass():
     return 40.0 / (_time.perf_counter() - t0)
 
 
+def bench_rank_unbiased():
+    """Unbiased LambdaRank at the MSLR shape (BASELINE.md #3): 200k x 136,
+    800 query groups, lambdarank_unbiased=true — the device debias path
+    (objective/ranking.py). Steady rounds/s by the slope method. Skip
+    with BENCH_RANK=0."""
+    import xgboost_tpu as xgb
+
+    n, F, G = 200_000, 136, 800
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, F).astype(np.float32)
+    score = X @ rng.randn(F).astype(np.float32)
+    qs = np.quantile(score, [0.55, 0.75, 0.9, 0.97])
+    y = np.digitize(score, qs).astype(np.float32)
+    qid = np.repeat(np.arange(G), n // G)
+    dm = xgb.DMatrix(X, label=y, qid=qid)
+    p = {"objective": "rank:ndcg", "max_depth": 6, "eta": 0.3,
+         "max_bin": 256, "lambdarank_unbiased": True,
+         "lambdarank_pair_method": "mean"}
+
+    def timed(rounds):
+        import jax
+
+        t0 = time.perf_counter()
+        bst = xgb.train(p, dm, rounds, verbose_eval=False)
+        for st in bst._caches.values():
+            jax.block_until_ready(st["margin"])
+            float(np.asarray(st["margin"][0, 0]))
+        return time.perf_counter() - t0
+
+    timed(2)
+    t4 = min(timed(4) for _ in range(2))
+    t12 = min(timed(12) for _ in range(2))
+    return round(8.0 / (t12 - t4), 3) if t12 > t4 else None
+
+
 def bench_higgs11m():
     """North-star shape (BASELINE.md): 11M x 28, depth 6. Returns cold
     20-round r/s, steady-state r/s (slope between 20 and 100 rounds —
@@ -252,6 +287,8 @@ def main():
     if os.environ.get("BENCH_DART", "1") != "0":
         result["dart_covertype_rounds_per_sec"] = round(
             bench_dart_multiclass(), 3)
+    if os.environ.get("BENCH_RANK", "1") != "0":
+        result["rank_unbiased_rounds_per_sec"] = bench_rank_unbiased()
     print(json.dumps(result))
     print(f"# auc={auc:.4f} baseline(sklearn-hist)={base_rps:.3f} rounds/s",
           file=sys.stderr)

@@ -284,6 +284,12 @@ class GBTree:
             if self._exact_quant is None:
                 from ..tree.exact import ExactQuantization
 
+                if getattr(state["dm"].X, "is_paged", False) \
+                        or np.ndim(state["dm"].X) != 2:
+                    raise NotImplementedError(
+                        "tree_method=exact rank-encodes the raw matrix "
+                        "and does not support external-memory (paged) "
+                        "matrices; use tree_method=hist")
                 self._exact_quant = ExactQuantization(
                     np.asarray(state["dm"].X))
         elif self.tree_method != "approx":
@@ -332,6 +338,13 @@ class GBTree:
                     from ..data.binned import BinnedMatrix
                     from ..data.quantile import sketch_matrix
 
+                    if getattr(dm.X, "is_paged", False) \
+                            or np.ndim(dm.X) != 2:
+                        raise NotImplementedError(
+                            "tree_method=approx re-sketches the raw "
+                            "matrix every iteration and does not support "
+                            "external-memory (paged) matrices; use "
+                            "tree_method=hist")
                     w = np.asarray(gpair[:, k, 1], np.float64)
                     cuts = sketch_matrix(np.asarray(dm.X),
                                          self.tree_param.max_bin, w,

@@ -561,6 +561,10 @@ class Booster:
         if paged or self.learner_params.get(
                 "data_split_mode", "row") != "row":
             return
+        if self.learner_params.get("process_type") == "update":
+            # prune/refresh/sync are rank-local ops on replicated trees
+            # (no histogram build) — documented safe under a communicator
+            return
         from .parallel import collective
 
         comm = collective.get_communicator()
