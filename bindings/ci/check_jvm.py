@@ -1,0 +1,67 @@
+"""JVM-scorer oracle: score a fixture model through the native C ABI via
+ctypes (no Python package, no JAX) and emit the inputs + expected hex
+float bits for ``run_checks.sh`` to diff against the Panama scorer's
+output byte-for-byte — both sides call the identical
+``XGBoosterPredictFromDense`` symbol, so agreement must be exact.
+
+usage: python3 check_jvm.py <libxgboost_tpu_native.so> <model.json> <outdir>
+"""
+
+import ctypes
+import os
+import struct
+import sys
+
+import numpy as np
+
+
+def main():
+    lib_path, model_path, outdir = sys.argv[1:4]
+    lib = ctypes.CDLL(lib_path)
+    lib.XGBGetLastError.restype = ctypes.c_char_p
+    lib.XGBoosterCreate.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+    lib.XGBoosterLoadModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.XGBoosterPredictFromDense.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_float, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.XGBoosterNumGroups.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int)]
+    lib.XGBoosterGetNumFeature.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+
+    h = ctypes.c_void_p()
+    assert lib.XGBoosterCreate(None, 0, ctypes.byref(h)) == 0
+    rc = lib.XGBoosterLoadModel(h, model_path.encode())
+    assert rc == 0, lib.XGBGetLastError().decode()
+    ng, nf = ctypes.c_int(), ctypes.c_uint64()
+    assert lib.XGBoosterNumGroups(h, ctypes.byref(ng)) == 0
+    assert lib.XGBoosterGetNumFeature(h, ctypes.byref(nf)) == 0
+
+    n, f = 64, max(int(nf.value), 1)
+    rng = np.random.RandomState(7)
+    X = rng.randn(n, f).astype(np.float32)
+    X[rng.rand(n, f) < 0.1] = np.nan  # exercise default routing
+    out = np.empty(n * ng.value, np.float32)
+    rc = lib.XGBoosterPredictFromDense(
+        h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, f,
+        ctypes.c_float(np.nan), 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    assert rc == 0, lib.XGBGetLastError().decode()
+
+    with open(os.path.join(outdir, "data.f32"), "wb") as fh:
+        fh.write(X.tobytes())  # little-endian on every CI target
+    with open(os.path.join(outdir, "expected.hex"), "w") as fh:
+        for r in range(n):
+            row = out[r * ng.value:(r + 1) * ng.value]
+            fh.write(" ".join(format_hex(v) for v in row) + "\n")
+    print(n, f, ng.value)
+
+
+def format_hex(v):
+    return format(struct.unpack("<I", struct.pack("<f", v))[0], "x")
+
+
+if __name__ == "__main__":
+    main()
