@@ -31,32 +31,43 @@ x <- readBin(con, "numeric", n = $N * $F, size = 4, endian = "little")
 close(con)
 m <- matrix(x, nrow = $N, ncol = $F, byrow = TRUE)
 p <- xgbt.predict(bst, m)
-writeLines(sprintf("%.6e", as.numeric(t(p))), file.path("$WORK", "r.out"))
+# emit raw f32 bits: double -> float is lossless here (the shim's
+# doubles came from the scorer's floats), so this is a BYTE comparison
+out <- file(file.path("$WORK", "r.f32"), "wb")
+writeBin(as.numeric(t(p)), out, size = 4, endian = "little")
+close(out)
 EOF
 Rscript "$WORK/score.R"
 python3 - "$WORK" <<'EOF'
 import struct, sys, os
 work = sys.argv[1]
-exp = [struct.unpack("<f", struct.pack("<I", int(h, 16)))[0]
-       for line in open(os.path.join(work, "expected.hex"))
-       for h in line.split()]
-got = [float(v) for v in open(os.path.join(work, "r.out"))]
-assert len(exp) == len(got), (len(exp), len(got))
-for e, g in zip(exp, got):
-    assert abs(e - g) <= 1e-6 + 1e-6 * abs(e), (e, g)
-print(f"R scorer matches the C oracle on {len(got)} predictions")
+exp = b"".join(
+    struct.pack("<I", int(h, 16))
+    for line in open(os.path.join(work, "expected.hex"))
+    for h in line.split())
+got = open(os.path.join(work, "r.f32"), "rb").read()
+assert exp == got, "R scorer output differs from the C oracle bytes"
+print(f"R scorer byte-identical to the C oracle "
+      f"({len(got) // 4} predictions)")
 EOF
 
 echo "== R CMD build + check (package hygiene; scoring proof is above) =="
-R CMD build bindings/R/xgboosttpu
-R CMD check --no-manual --no-examples xgboosttpu_*.tar.gz \
+(cd "$WORK" && R CMD build "$REPO/bindings/R/xgboosttpu" \
+    && R CMD check --no-manual --no-examples xgboosttpu_*.tar.gz) \
     || echo "WARNING: R CMD check reported issues (scoring parity already proven)"
 
 echo "== JVM (Panama FFM) scorer: compile + byte-compare =="
-javac --release 21 --enable-preview -d "$WORK/classes" \
-    bindings/jvm/XGBoostTPUScorer.java
+# FFM is preview in JDK 21 and FINAL from 22 — flag accordingly
+# (javac refuses --enable-preview with a --release below its own ver.)
+JAVA_MAJOR="$(javac -version 2>&1 | sed 's/[^0-9]*\([0-9]*\).*/\1/')"
+if [ "$JAVA_MAJOR" -ge 22 ]; then
+    JFLAGS=(); RFLAGS=()
+else
+    JFLAGS=(--release 21 --enable-preview); RFLAGS=(--enable-preview)
+fi
+javac "${JFLAGS[@]}" -d "$WORK/classes" bindings/jvm/XGBoostTPUScorer.java
 run_jvm() {
-    java --enable-preview --enable-native-access=ALL-UNNAMED \
+    java "${RFLAGS[@]}" --enable-native-access=ALL-UNNAMED \
         -Djava.library.path="$REPO/native" -cp "$WORK/classes" \
         XGBoostTPUScorer "$@"
 }
