@@ -29,8 +29,8 @@ COLS = 28
 DEPTH = 6
 MAX_BIN = 256
 REPS = int(os.environ.get("PROFILE_REPS", 5))
-PHASES = set(os.environ.get("PROFILE_PHASES", "hist,eval,adv,grad,full")
-             .split(","))
+PHASES = set(os.environ.get("PROFILE_PHASES",
+                            "hist,coarse,eval,adv,grad,full").split(","))
 
 
 from benchlib import slope_bench  # noqa: E402
@@ -106,6 +106,52 @@ def main():
                if "hist" in PHASES else 0.0)
     if "prehot" in PHASES:
         bench(prehot_body, "hist prehot (6 levels)", oh_pre, gpair, row_iota)
+
+    # ---- phase: two-level coarse->refine histogram, all 6 levels per rep
+    # (the DEFAULT production path at scale since round 5: coarse pass +
+    # window choice + refine pass + assemble — mirrors tree/grow.py)
+    if "coarse" in PHASES:
+        from xgboost_tpu.ops.split import (WINDOW, assemble_two_level,
+                                           choose_refine_window,
+                                           coarse_bin_ids, refine_bin_ids)
+        from xgboost_tpu.ops.split import COARSE_B
+
+        has_missing = binned.has_missing
+        missing_bin = max_nbins - 1 if has_missing else max_nbins
+
+        def coarse_body(i, acc, bt, gpr, iota):
+            gp = gpr * (1.0 + i.astype(jnp.float32) * 1e-7 + acc * 1e-30)
+            cb_t = coarse_bin_ids(bt.astype(jnp.int32), missing_bin)
+            g = jnp.float32(0.0)
+            for d in range(DEPTH):
+                N = 2 ** d
+                rel = iota % N
+                hist_c = build_hist(cb_t.T, gp, rel, N, COARSE_B,
+                                    method="auto", bins_t=cb_t)
+                parent = jnp.sum(hist_c[:, 0], axis=1)
+                span = choose_refine_window(hist_c, parent, n_real, param,
+                                            has_missing)
+                span_pad = jnp.concatenate(
+                    [span.astype(jnp.float32),
+                     jnp.zeros((1, COLS), jnp.float32)]).T
+                oh_rel = (rel[None, :] == jnp.arange(
+                    N + 1, dtype=jnp.int32)[:, None]).astype(jnp.float32)
+                c_row_t = jax.lax.dot_general(
+                    span_pad, oh_rel, (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST)
+                rb_t = refine_bin_ids(bt.astype(jnp.int32),
+                                      c_row_t.astype(jnp.int32),
+                                      missing_bin)
+                hist_r = build_hist(rb_t.T, gp, rel, N, WINDOW + 4,
+                                    method="auto",
+                                    bins_t=rb_t)[:, :, :WINDOW, :]
+                hist, _ = assemble_two_level(hist_c, hist_r, span, n_real,
+                                             has_missing)
+                g = g + jnp.sum(hist).astype(jnp.float32)
+            return g
+
+        bench(coarse_body, "hist two-level coarse (6 levels)",
+              bins_t, gpair, row_iota)
 
     # ---- phase: split evaluation, all 6 levels per rep (args, not
     # closures: a closed-over plane becomes a 7GB program constant).
