@@ -366,9 +366,9 @@ def test_coarse_hist_unsupported_configs_raise():
     rng = np.random.RandomState(0)
     X = rng.randn(500, 4).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float32)
-    for bad in ({"grow_policy": "lossguide", "max_leaves": 8,
-                 "max_depth": 0},
-                {"tree_method": "approx"}):
+    for bad in ({"tree_method": "approx"},
+                {"multi_strategy": "multi_output_tree",
+                 "objective": "reg:squarederror"}):
         with pytest.raises(NotImplementedError):
             xgb.train({"objective": "binary:logistic",
                        "hist_method": "coarse", **bad},

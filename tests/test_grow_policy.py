@@ -148,3 +148,80 @@ def test_lossguide_distributed_mesh():
     p_m = bst.predict(dm)
     p_1 = bst1.predict(dm)
     assert np.abs(p_m - p_1).max() < 2e-4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (virtual) platform")
+    return xgb.make_data_mesh()
+
+
+def test_lossguide_coarse_hist_matches_exact_at_small_max_bin():
+    """Two-level histogram under grow_policy=lossguide (r5): with
+    max_bin <= 32 the refine window covers every bin, so the per-split
+    coarse path is BIT-IDENTICAL to the one-pass kernel."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = (X @ rng.randn(6) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 32,
+              "grow_policy": "lossguide", "max_leaves": 10, "max_depth": 0}
+    b_e = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    assert b_c.get_dump(with_stats=True) == b_e.get_dump(with_stats=True)
+
+
+def test_lossguide_coarse_hist_quality_and_missing():
+    """At max_bin=256 the coarse lossguide path scores every coarse
+    boundary and in-window fine boundary exactly; quality must track the
+    exact kernel closely (same contract as the depthwise promotion)."""
+    rng = np.random.RandomState(6)
+    X = rng.randn(6000, 8).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(8) > 0).astype(np.float32)
+    X[rng.rand(*X.shape) < 0.1] = np.nan
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 256,
+              "grow_policy": "lossguide", "max_leaves": 16, "max_depth": 0,
+              "eval_metric": "auc"}
+    aucs = {}
+    for hm in ("auto", "coarse"):
+        res = {}
+        dm = xgb.DMatrix(X, label=y)
+        xgb.train({**params, "hist_method": hm}, dm, 6, evals=[(dm, "t")],
+                  evals_result=res, verbose_eval=False)
+        aucs[hm] = res["t"]["auc"][-1]
+    assert abs(aucs["coarse"] - aucs["auto"]) < 0.01
+
+
+def test_lossguide_coarse_hist_mesh_matches_single(mesh):
+    """coarse x lossguide x row-split mesh: both passes psum across the
+    data axis per split."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = (X @ rng.randn(6) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 8, "max_depth": 0,
+              "hist_method": "coarse"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh}, xgb.DMatrix(X, label=y), 3,
+                   verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lossguide_coarse_unsupported_configs_raise():
+    rng = np.random.RandomState(8)
+    X = rng.randn(400, 4).astype(np.float32)
+    Xc = X.copy()
+    Xc[:, -1] = rng.randint(0, 4, 400)
+    y = (X[:, 0] > 0).astype(np.float32)
+    base = {"objective": "binary:logistic", "grow_policy": "lossguide",
+            "max_leaves": 6, "max_depth": 0, "hist_method": "coarse"}
+    # categorical features reject
+    dmc = xgb.DMatrix(Xc, label=y, feature_types=["q"] * 3 + ["c"],
+                      enable_categorical=True)
+    with pytest.raises(NotImplementedError):
+        xgb.train(base, dmc, 1, verbose_eval=False)

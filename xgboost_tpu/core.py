@@ -405,12 +405,11 @@ class Booster:
                 "vector-leaf trees)")
         if self.learner_params.get("hist_method") == "coarse" and (
                 tm in ("approx", "exact")
-                or self.tree_param.grow_policy == "lossguide"
                 or ms == "multi_output_tree"):
             raise NotImplementedError(
-                "hist_method='coarse' supports the depthwise hist "
-                "updater (resident or external-memory) with scalar "
-                "trees only")
+                "hist_method='coarse' supports the hist updaters "
+                "(depthwise or lossguide, resident or external-memory "
+                "depthwise) with scalar trees only")
         dsm = self.learner_params.get("data_split_mode", "row")
         if dsm not in ("row", "col"):
             raise ValueError(f"unknown data_split_mode: {dsm}")

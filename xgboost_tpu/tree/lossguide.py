@@ -50,29 +50,74 @@ class LossguideGrown(NamedTuple):
 
 
 def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
-           node_lower, node_upper, n_real_bins, bins_t, monotone, cat, *,
-           param: TrainParam, max_nbins: int, hist_method: str,
-           axis_name: Optional[str], has_missing: bool = True):
+           node_lower, node_upper, n_real_bins, bins_t, cb_t, monotone,
+           cat, *, param: TrainParam, max_nbins: int, hist_method: str,
+           axis_name: Optional[str], has_missing: bool = True,
+           coarse: bool = False):
     """Histogram + split enumeration for (up to) two sibling nodes.
     ``bins_t`` is the loop-invariant [F, n] transpose, computed once per
-    tree so every per-split program skips the relayout."""
+    tree so every per-split program skips the relayout.
+
+    ``coarse``: the two-level coarse->refine histogram (the same scheme
+    the depthwise growers promote at scale — the per-split two-node
+    build pays the full 256-wide one-hot cost exactly like a depthwise
+    level did, so the same ~2.8x kernel win applies). Both passes psum
+    under a mesh; the final enumeration is exact over the assembled
+    synthetic layout and the winning slot decodes to a fine bin."""
     rel = jnp.where(positions == id0, 0,
                     jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
-    hist = build_hist(bins, gpair, rel, 2, max_nbins, method=hist_method,
-                      bins_t=bins_t)
+    if not coarse:
+        hist = build_hist(bins, gpair, rel, 2, max_nbins,
+                          method=hist_method, bins_t=bins_t)
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        return evaluate_splits(hist, parent_sums, n_real_bins, param,
+                               feature_mask=fmask, monotone=monotone,
+                               node_lower=node_lower,
+                               node_upper=node_upper,
+                               cat=cat, has_missing=has_missing)
+    from ..ops.split import (COARSE_B, WINDOW, assemble_two_level,
+                             choose_refine_window, decode_two_level_bin,
+                             refine_bin_ids)
+
+    missing_bin = max_nbins - 1 if has_missing else max_nbins
+    # cb_t is hoisted per TREE by the grower (loop-invariant, like
+    # bins_t); the int32 view feeding refine_bin_ids stays in-jit so XLA
+    # fuses the upcast into the consumer instead of materialising [F,n]i32
+    bt_i32 = bins_t.astype(jnp.int32)
+    hist_c = build_hist(cb_t.T, gpair, rel, 2, COARSE_B, method="auto",
+                        bins_t=cb_t)
     if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
-    return evaluate_splits(hist, parent_sums, n_real_bins, param,
-                           feature_mask=fmask, monotone=monotone,
-                           node_lower=node_lower, node_upper=node_upper,
-                           cat=cat, has_missing=has_missing)
+        hist_c = jax.lax.psum(hist_c, axis_name)
+    span = choose_refine_window(hist_c, parent_sums, n_real_bins, param,
+                                has_missing)                  # [2, F]
+    # per-row window of the row's node (N=2: two selects, no matmul)
+    c_row_t = jnp.where(rel[None, :] == 0, span[0][:, None],
+                        jnp.where(rel[None, :] == 1, span[1][:, None],
+                                  0)).astype(jnp.int32)       # [F, n]
+    rb_t = refine_bin_ids(bt_i32, c_row_t, missing_bin)
+    hist_r = build_hist(rb_t.T, gpair, rel, 2, WINDOW + 4, method="auto",
+                        bins_t=rb_t)[:, :, :WINDOW, :]
+    if axis_name is not None:
+        hist_r = jax.lax.psum(hist_r, axis_name)
+    hist, n_real_eval = assemble_two_level(hist_c, hist_r, span,
+                                           n_real_bins, has_missing)
+    res = evaluate_splits(hist, parent_sums, n_real_eval, param,
+                          feature_mask=fmask, monotone=monotone,
+                          node_lower=node_lower, node_upper=node_upper,
+                          cat=cat, has_missing=has_missing)
+    span_sel = jnp.take_along_axis(
+        span, jnp.maximum(res.feature, 0)[:, None], axis=1)[:, 0]
+    return res._replace(bin=decode_two_level_bin(res.bin, span_sel))
 
 
 def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
-               node_lower, node_upper, n_real_bins, bins_t, monotone, cat, *,
+               node_lower, node_upper, n_real_bins, bins_t, cb_t,
+               monotone, cat, *,
                param: TrainParam, max_nbins: int, hist_method: str,
                axis_name: str, has_missing: bool = True):
-    """Column-split ``_eval2``: this shard's bins hold global features
+    """Column-split ``_eval2`` (``cb_t`` unused — the two-level histogram
+    requires row split): this shard's bins hold global features
     [off, off + F); rows replicate so the two-node histogram needs no
     psum, each shard evaluates ITS features (local slices of the
     replicated global monotone/cat arrays), and the per-shard best goes
@@ -243,6 +288,21 @@ class LossguideGrower:
         else:
             self.cat = None
             self.n_words = 1
+        # two-level coarse->refine per-split histogram: explicit
+        # "coarse", or the "auto" promotion at scale (decided at first
+        # grow, when n is known — see grow()); numeric row split only
+        base_hm = hist_method
+        for _sfx in ("+sub", "+nosub"):
+            if base_hm.endswith(_sfx):
+                base_hm = base_hm[: -len(_sfx)]
+        self._base_hm = base_hm
+        if base_hm == "coarse" and (
+                split_mode == "col" or self.cat is not None
+                or max_nbins > 256 + int(has_missing)):
+            raise NotImplementedError(
+                "hist_method='coarse' with grow_policy=lossguide "
+                "supports numeric features, row split, max_bin <= 256")
+        self._coarse = None
         if split_mode == "col":
             # bins pad the feature axis to a multiple of the mesh width;
             # the replicated GLOBAL constraint/cat arrays must match so
@@ -276,7 +336,8 @@ class LossguideGrower:
                   has_missing=self.has_missing)
         if self.mesh is None:
             ev = functools.partial(_eval2, monotone=self.monotone,
-                                   cat=self.cat, axis_name=None, **kw)
+                                   cat=self.cat, axis_name=None,
+                                   coarse=bool(self._coarse), **kw)
             self._fns = (jax.jit(ev), jax.jit(_apply1),
                          jax.jit(functools.partial(_root_sum,
                                                    axis_name=None)),
@@ -295,7 +356,7 @@ class LossguideGrower:
                 ev, mesh=self.mesh,
                 in_specs=(P(None, DATA_AXIS), P(), P(), P(), P(), P(),
                           P(None, DATA_AXIS), P(), P(), P(DATA_AXIS),
-                          P(DATA_AXIS, None)),
+                          P(DATA_AXIS, None), P()),
                 out_specs=P(), check_vma=False))
             sharded_apply = jax.jit(jax.shard_map(
                 functools.partial(_apply1_col, axis_name=DATA_AXIS),
@@ -314,13 +375,14 @@ class LossguideGrower:
             P = jax.sharding.PartitionSpec
 
             ev = functools.partial(_eval2, monotone=self.monotone,
-                                   cat=self.cat, axis_name=DATA_AXIS, **kw)
+                                   cat=self.cat, axis_name=DATA_AXIS,
+                                   coarse=bool(self._coarse), **kw)
             # SplitResult is a flat NamedTuple of replicated arrays
             sharded_eval = jax.jit(jax.shard_map(
                 ev, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
                           P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(),
-                          P(None, DATA_AXIS)),
+                          P(None, DATA_AXIS), P(None, DATA_AXIS)),
                 out_specs=P()))
             sharded_apply = jax.jit(jax.shard_map(
                 _apply1, mesh=self.mesh,
@@ -377,6 +439,20 @@ class LossguideGrower:
         max_leaves = param.max_leaves if param.max_leaves > 0 else (
             2 ** max(param.max_depth, 1))
         cap = 2 * max_leaves - 1
+        if self._coarse is None:
+            # decided once (n is fixed per DMatrix), before the jitted
+            # per-split programs are built; the threshold is LOCAL rows
+            from ..context import DATA_AXIS
+            from .grow import auto_selects_coarse
+
+            world = (1 if self.mesh is None
+                     else self.mesh.shape.get(DATA_AXIS, 1))
+            n_local = n if self.split_mode == "col" else n // max(world, 1)
+            self._coarse = self._base_hm == "coarse" or (
+                self._base_hm == "auto" and self.split_mode == "row"
+                and auto_selects_coarse(
+                    n_local, self.max_nbins, self.has_missing,
+                    numeric=self.cat is None, col_split=False))
         eval2, apply1, root_sum_fn, gather = self._functions()
         try:
             seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1])
@@ -405,6 +481,15 @@ class LossguideGrower:
         positions = self._init_positions(gpair.shape[0])
         bins_t = (None if getattr(bins, "is_paged", False)
                   else bins.T)  # loop-invariant relayout, once per tree
+        cb_t = None
+        if self._coarse and bins_t is not None:
+            # coarse-pass bin ids are loop-invariant too — one pass per
+            # tree instead of one per split evaluation
+            from ..ops.split import coarse_bin_ids
+
+            mb = (self.max_nbins - 1 if self.has_missing
+                  else self.max_nbins)
+            cb_t = coarse_bin_ids(bins_t.astype(jnp.int32), mb)
         gh[0] = np.asarray(root_sum_fn(gpair), np.float64)
         n_nodes = 1
         n_leaves = 1
@@ -440,7 +525,13 @@ class LossguideGrower:
                         jnp.asarray(np.asarray([upper[i0],
                                                 upper[i1 if i1 >= 0 else 0]],
                                                np.float32)),
-                        n_real_bins, bins_t)
+                        n_real_bins, bins_t, cb_t)
+            # ONE packed device->host pull for the whole SplitResult —
+            # a per-field np.asarray costs 8 blocking round trips per
+            # split against a remote-device tunnel
+            from ..utils.fetch import fetch_struct
+
+            res = fetch_struct(res)
             gain = np.asarray(res.gain)
             feat = np.asarray(res.feature)
             rbin = np.asarray(res.bin)

@@ -768,6 +768,10 @@ class MultiLossguideGrower:
             res = eval2(bins, gpair, positions, np.int32(i0), np.int32(i1),
                         jnp.asarray(psums), jnp.asarray(fm), n_real_bins,
                         bins_t)
+            # one packed pull (see lossguide.py eval_nodes)
+            from ..utils.fetch import fetch_struct
+
+            res = fetch_struct(res)
             gain = np.asarray(res.gain)
             feat = np.asarray(res.feature)
             rbin = np.asarray(res.bin)

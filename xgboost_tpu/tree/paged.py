@@ -1280,6 +1280,12 @@ class PagedLossguideGrower(LossguideGrower):
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing)
+        if self._base_hm == "coarse":
+            raise NotImplementedError(
+                "hist_method='coarse' with grow_policy=lossguide runs on "
+                "resident matrices only (the paged per-split kernels use "
+                "the one-pass build)")
+        self._coarse = False  # page kernels ignore the resident auto rule
         self.mesh = mesh
         self._mk: Optional[_MeshPageKernels] = None
 
@@ -1296,8 +1302,9 @@ class PagedLossguideGrower(LossguideGrower):
         mk = self._mk
 
         def eval2(paged, gpair, positions, i0, i1, psums, fmask,
-                  node_lower, node_upper, n_real_bins, bins_t=None):
-            del bins_t  # pages window in-program inside the kernels
+                  node_lower, node_upper, n_real_bins, bins_t=None,
+                  cb_t=None):
+            del bins_t, cb_t  # pages window in-program inside the kernels
             hist = _host_allreduce(mk.pair_hist(paged, gpair, positions,
                                                 i0, i1))
             return evaluate_splits(hist, psums, n_real_bins, self.param,

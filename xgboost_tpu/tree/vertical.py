@@ -384,6 +384,11 @@ class VerticalLossguideGrower(LossguideGrower):
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing, split_mode="row")
+        if self._base_hm == "coarse":
+            raise NotImplementedError(
+                "hist_method='coarse' requires row split (vertical "
+                "federated is column split)")
+        self._coarse = False  # host eval path uses the one-pass build
         self.split_mode = "col"
         self.comm = collective.get_communicator()
         self._f_offset: Optional[int] = None
@@ -442,7 +447,7 @@ class VerticalLossguideGrower(LossguideGrower):
             return self._bins_np[1]
 
         def eval2(bins, gpair, positions, i0, i1, psums, fm, lo2, hi2,
-                  n_real_bins, bins_t):
+                  n_real_bins, bins_t, cb_t=None):
             rel = np.where(positions == int(i0), 0,
                            np.where(positions == int(i1), 1, 2)
                            ).astype(np.int32)
@@ -455,6 +460,9 @@ class VerticalLossguideGrower(LossguideGrower):
                                   node_lower=lo2, node_upper=hi2,
                                   cat=self.cat,
                                   has_missing=self.has_missing)
+            from ..utils.fetch import fetch_struct
+
+            res = fetch_struct(res)  # one packed pull, not 8
             loc_words = np.asarray(res.cat_words, np.uint32)
             if loc_words.shape[1] < n_words:
                 loc_words = np.pad(
