@@ -56,6 +56,9 @@ FEATURES = [
     ("tree_method=approx", {"tree_method": "approx"}),
     ("tree_method=exact", {"tree_method": "exact"}),
     ("hist_method=coarse", {"hist_method": "coarse"}),
+    ("hist_method=coarse + lossguide", {"hist_method": "coarse",
+                                        "grow_policy": "lossguide",
+                                        "max_leaves": 4, "max_depth": 0}),
     ("categorical", {"categorical": True}),
     ("monotone+interaction", {"monotone_constraints": "(1,-1,0,0)",
                               "interaction_constraints": "[[0,1],[2,3]]"}),
