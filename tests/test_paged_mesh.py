@@ -214,3 +214,30 @@ def test_paged_mesh_monotone_and_categorical(tmp_path, monkeypatch, mesh):
     bst_m = xgb.train({**params}, qdm_m, 4, verbose_eval=False)
     _assert_same_forest(bst_p, bst_m)
     assert any(t.is_cat_split.any() for t in bst_p.gbm.trees)
+
+
+@pytest.mark.slow
+def test_paged_mesh_multi_lossguide(tmp_path, monkeypatch, mesh):
+    """Vector-leaf lossguide x paged x mesh: per split one K-channel
+    shard_map histogram over the sharded pages with one psum. 2401 rows:
+    indivisible by the 8-shard page-aligned layout, so the per-row pad
+    (gradients [n_pad] vs the matrix's unpadded count) is exercised."""
+    rng = np.random.RandomState(17)
+    X = rng.randn(2401, 5).astype(np.float32)
+    y = np.stack([X @ rng.randn(5), X @ rng.randn(5)],
+                 axis=1).astype(np.float32)
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "400")
+    it = BatchIter(X, y, n_batches=3)
+    it.cache_prefix = str(tmp_path / "pml")
+    qdm_p = xgb.QuantileDMatrix(it, max_bin=64)
+    qdm_m = xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3), max_bin=64)
+    params = {"objective": "reg:squarederror", "max_bin": 64,
+              "multi_strategy": "multi_output_tree", "mesh": mesh,
+              "grow_policy": "lossguide", "max_leaves": 6, "max_depth": 0}
+    bst_p = xgb.train(params, qdm_p, 3, verbose_eval=False)
+    bst_m = xgb.train(params, qdm_m, 3, verbose_eval=False)
+    dmx = xgb.DMatrix(X)
+    np.testing.assert_allclose(bst_p.predict(dmx), bst_m.predict(dmx),
+                               rtol=1e-5, atol=1e-6)
+    for t in bst_p.gbm.trees:
+        assert int(np.asarray(t.is_leaf).sum()) <= 6

@@ -447,12 +447,13 @@ class GBTree:
                 param.eta = param.eta / self.num_parallel_tree
             if paged:
                 if param.grow_policy == "lossguide":
-                    raise NotImplementedError(
-                        "multi_output_tree lossguide does not support "
-                        "external-memory (paged) matrices")
-                from ..tree.paged import PagedMultiTargetGrower
+                    from ..tree.paged import PagedMultiLossguideGrower
 
-                cls = PagedMultiTargetGrower
+                    cls = PagedMultiLossguideGrower
+                else:
+                    from ..tree.paged import PagedMultiTargetGrower
+
+                    cls = PagedMultiTargetGrower
             elif param.grow_policy == "lossguide":
                 from ..tree.multi import MultiLossguideGrower
 

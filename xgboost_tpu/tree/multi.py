@@ -705,6 +705,10 @@ class MultiLossguideGrower:
                              sharded_gather)
         return self._fns
 
+    def _init_positions(self, n: int) -> jnp.ndarray:
+        """Root positions [n] — the paged subclass shards this."""
+        return jnp.zeros((n,), jnp.int32)
+
     def grow(self, bins: jnp.ndarray, gpair: jnp.ndarray,
              n_real_bins: jnp.ndarray, key: jax.Array):
         import heapq
@@ -737,8 +741,13 @@ class MultiLossguideGrower:
         paths = np.zeros((cap, F), bool) if cons is not None else None
         _EPS = 1e-6
 
-        positions = jnp.zeros((n,), jnp.int32)
-        bins_t = bins.T  # loop-invariant relayout, once per tree
+        # gpair.shape[0], NOT bins.shape[0]: in mesh x paged mode the
+        # per-row vectors are padded to the page-aligned mesh layout
+        # while the paged matrix reports its unpadded row count (same
+        # convention as the scalar lossguide grower)
+        positions = self._init_positions(gpair.shape[0])
+        bins_t = (None if getattr(bins, "is_paged", False)
+                  else bins.T)  # loop-invariant relayout, once per tree
         gh[0] = np.asarray(root_sum_fn(gpair), np.float64)
         n_nodes = 1
         n_leaves = 1

@@ -44,7 +44,7 @@ from ..utils.fetch import fetch_packed, fetch_struct
 from .grow import (GrownTree, TreeGrower, _sample_features,
                    interaction_allowed_host, monotone_child_bounds_host)
 from .lossguide import LossguideGrower
-from .multi import MultiTargetGrower
+from .multi import MultiLossguideGrower, MultiTargetGrower
 from .param import calc_weight
 
 _EPS = 1e-6
@@ -624,9 +624,12 @@ class _PageKernels:
             paged, ("adv", kind, n_static, W), make_body, positions,
             (jnp.int32(prev["lo"]), jnp.int32(prev["n_level"])) + extra)
 
-    def pair_hist(self, paged, gpair, positions, i0, i1):
-        """Two-node (lossguide sibling pair) histogram over the pages."""
+    def pair_hist(self, paged, gpair, positions, i0, i1, multi=False):
+        """Two-node (lossguide sibling pair) histogram over the pages
+        (K-channel with ``multi`` — the vector-leaf lossguide)."""
         def make_body():
+            builder = self._builder(multi)
+
             def body(acc, page, s, consts):
                 gp, pos, i0_d, i1_d = consts
                 p = page.shape[0]
@@ -635,14 +638,14 @@ class _PageKernels:
                 rel = jnp.where(pos_pg == i0_d, 0,
                                 jnp.where(pos_pg == i1_d, 1, 2)
                                 ).astype(jnp.int32)
-                return acc + build_hist(page, gp_pg, rel, 2, self.max_nbins,
-                                        method=self.hist_kernel)
+                return acc + builder(page, gp_pg, rel, 2, self.max_nbins,
+                                     method=self.hist_kernel)
 
             return body
 
-        acc = self._acc_zeros(paged, gpair, 2, False)
+        acc = self._acc_zeros(paged, gpair, 2, multi)
         return self._drive(
-            paged, ("hist2",), make_body, acc,
+            paged, ("hist2", multi), make_body, acc,
             (gpair, positions, jnp.int32(i0), jnp.int32(i1)))
 
     def apply1(self, paged, positions, nid, feat, sbin, dleft, is_cat,
@@ -945,15 +948,16 @@ class _MeshPageKernels:
         return self.walk_advance(paged, positions, sf, sb, dl, isf,
                                  cat=prev["cat"])
 
-    def pair_hist(self, paged, gpair, positions, i0, i1):
-        """Two-node (lossguide sibling pair) histogram over the pages."""
+    def pair_hist(self, paged, gpair, positions, i0, i1, multi=False):
+        """Two-node (lossguide sibling pair) histogram over the pages
+        (K-channel with ``multi`` — the vector-leaf lossguide)."""
         def rel_fn(pos_pg, i0_d, i1_d):
             return jnp.where(pos_pg == i0_d, 0,
                              jnp.where(pos_pg == i1_d, 1, 2)
                              ).astype(jnp.int32)
 
         return self._hist_over_pages(
-            paged, gpair, positions, rel_fn, 2, False, ("hist2",),
+            paged, gpair, positions, rel_fn, 2, multi, ("hist2",),
             (jnp.int32(i0), jnp.int32(i1)))
 
     # -- position advances ---------------------------------------------------
@@ -1489,3 +1493,71 @@ class PagedMultiTargetGrower(MultiTargetGrower):
         if param.max_leaves > 0:
             g = self._truncate_max_leaves(g)
         return g
+
+
+class PagedMultiLossguideGrower(MultiLossguideGrower):
+    """Vector-leaf loss-guided growth over a ``PagedBinnedMatrix``: the
+    greedy pop loop of ``MultiLossguideGrower`` with the two per-split
+    device kernels streaming over pages — the K-channel two-child
+    histogram (``pair_hist(multi=True)``, one fused dispatch over cached
+    pages + communicator allreduce) and the one-node advance. Reference:
+    the LossGuide Driver schedules ``MultiTargetHistBuilder`` over
+    ``GetBatches<GHistIndexMatrix>`` exactly like the scalar builder
+    (``src/tree/updater_quantile_hist.cc:117-263`` + ``driver.h``)."""
+
+    def __init__(self, param, max_nbins, cuts, hist_method="auto",
+                 mesh=None, has_missing=True, constraint_sets=None,
+                 split_mode="row") -> None:
+        if split_mode != "row":
+            raise NotImplementedError(
+                "external-memory training supports data_split_mode=row "
+                "only")
+        super().__init__(param, max_nbins, cuts, hist_method=hist_method,
+                         mesh=None, has_missing=has_missing,
+                         constraint_sets=constraint_sets)
+        base_hm = hist_method
+        for _sfx in ("+sub", "+nosub"):
+            if base_hm.endswith(_sfx):
+                base_hm = base_hm[: -len(_sfx)]
+        if base_hm == "coarse":
+            # same contract as the scalar PagedLossguideGrower (and the
+            # core guard already rejects coarse for vector leaves)
+            raise NotImplementedError(
+                "hist_method='coarse' with grow_policy=lossguide runs on "
+                "resident matrices only")
+        self.mesh = mesh
+        self._mk = None
+
+    def _init_positions(self, n: int) -> jnp.ndarray:
+        if self._mk is None:
+            self._mk = _make_kernels(self)
+        return self._mk.init_positions(n)
+
+    def _functions(self):
+        if self._fns is not None:
+            return self._fns
+        if self._mk is None:
+            self._mk = _make_kernels(self)
+        mk = self._mk
+        from ..ops.split import evaluate_splits_multi
+
+        def eval2(paged, gpair, positions, i0, i1, psums, fmask,
+                  n_real_bins, bins_t=None):
+            del bins_t  # pages window in-program inside the kernels
+            hist = _host_allreduce(mk.pair_hist(paged, gpair, positions,
+                                                i0, i1, multi=True))
+            return evaluate_splits_multi(hist, psums, n_real_bins,
+                                         self.param, feature_mask=fmask,
+                                         has_missing=self.has_missing)
+
+        def apply1(paged, positions, nid, feat, sbin, dleft, is_cat,
+                   words, left_id, right_id, missing_bin):
+            return mk.apply1(paged, positions, nid, feat, sbin, dleft,
+                             is_cat, words, left_id, right_id, missing_bin)
+
+        def root_sum(gpair):
+            return _host_allreduce(jnp.sum(gpair, axis=0))
+
+        gather = jax.jit(lambda lv, pos: lv[pos])
+        self._fns = (eval2, apply1, root_sum, gather)
+        return self._fns
