@@ -109,7 +109,15 @@ def bench_sklearn(X, y):
 def bench_paged11m():
     """External-memory tier at the north-star shape (BASELINE.md): 11M x 28
     depth 6, 3 x 4M-row pages, HBM page cache on. Steady s/round by the
-    slope method. Skip with BENCH_PAGED=0."""
+    slope method, for BOTH tiers -> (default, streaming):
+
+    - default: the r5 collapse — the matrix fits the HBM budget on a
+      single-rank config, so training swaps it for a resident
+      BinnedMatrix (whole-tree jit; docs/performance.md r5)
+    - streaming (XTPU_PAGED_COLLAPSE=0): the per-level fused-dispatch
+      paged kernels, what a past-budget matrix would measure
+
+    Skip with BENCH_PAGED=0."""
     import tempfile
 
     import xgboost_tpu as xgb
@@ -140,18 +148,31 @@ def bench_paged11m():
     tmp = tempfile.TemporaryDirectory(prefix="bench_paged_")
     it.cache_prefix = os.path.join(tmp.name, "pc")
     dm = None
+    prior = os.environ.get("XTPU_PAGED_COLLAPSE")
     try:
         dm = xgb.QuantileDMatrix(it, max_bin=256)
         del X, y
+        # streaming tier first: warms the page cache, then the default
+        # path collapses over that same warm cache (one device concat)
+        os.environ["XTPU_PAGED_COLLAPSE"] = "0"
         timed_train(dm, 2)  # compiles
+        s5 = min(timed_train(dm, 5)[0] for _ in range(2))
+        s15 = min(timed_train(dm, 15)[0] for _ in range(2))
+        os.environ.pop("XTPU_PAGED_COLLAPSE", None)
+        timed_train(dm, 2)  # collapse + (cached) resident programs
         t5 = min(timed_train(dm, 5)[0] for _ in range(2))
         t15 = min(timed_train(dm, 15)[0] for _ in range(2))
     finally:
+        if prior is None:
+            os.environ.pop("XTPU_PAGED_COLLAPSE", None)
+        else:
+            os.environ["XTPU_PAGED_COLLAPSE"] = prior
         del dm  # release the memmap before the dir is removed
         tmp.cleanup()
     # None (JSON null), never float nan: json.dumps emits bare NaN which
     # strict parsers reject, losing the driver's WHOLE metric line
-    return round((t15 - t5) / 10.0, 3) if t15 > t5 else None
+    return (round((t15 - t5) / 10.0, 3) if t15 > t5 else None,
+            round((s15 - s5) / 10.0, 3) if s15 > s5 else None)
 
 
 def bench_dart_multiclass():
@@ -291,7 +312,9 @@ def main():
             None if steady is None else round(steady, 4))
         result["higgs11m_exact_steady_rounds_per_sec"] = exact
     if os.environ.get("BENCH_PAGED", "1") != "0":
-        result["paged11m_steady_sec_per_round"] = bench_paged11m()
+        paged_default, paged_streaming = bench_paged11m()
+        result["paged11m_steady_sec_per_round"] = paged_default
+        result["paged11m_streaming_sec_per_round"] = paged_streaming
     if os.environ.get("BENCH_DART", "1") != "0":
         result["dart_covertype_rounds_per_sec"] = round(
             bench_dart_multiclass(), 3)

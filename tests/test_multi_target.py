@@ -152,6 +152,7 @@ def test_multi_output_tree_paged_interaction_constraints(tmp_path,
 
     X, Y = _data(n=3000, f=6)
     monkeypatch.setenv("XTPU_PAGE_ROWS", "400")
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")  # keep the paged kernels
     it = BatchIter(X, Y, n_batches=4)
     it.cache_prefix = str(tmp_path / "pc")
     qdm = xgb.QuantileDMatrix(it, max_bin=64)

@@ -175,8 +175,13 @@ def _run_tier(tier, extra):
         elif tier == "paged":
             import tempfile
 
+            # collapse off: the paged row documents the STREAMING tier's
+            # guards — with it on, any matrix under the HBM budget would
+            # take the resident fast path and the row would just repeat
+            # the resident column (docs/distributed.md notes the collapse)
             with tempfile.TemporaryDirectory() as tmp:
-                fit(it=paged_iter()(tmp), env={"XTPU_PAGE_ROWS": "48"})
+                fit(it=paged_iter()(tmp), env={"XTPU_PAGE_ROWS": "48",
+                                               "XTPU_PAGED_COLLAPSE": "0"})
         elif tier == "paged x mesh":
             import tempfile
 

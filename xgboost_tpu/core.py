@@ -528,6 +528,7 @@ class Booster:
                 binned = dm.binned(self.tree_param.max_bin)
                 if self.ctx.mesh is not None:
                     return self._make_sharded_train_state(key, dm, binned)
+                binned = self._collapse_paged_if_fits(binned)
                 self._check_row_comm_sync(
                     paged=getattr(binned, "is_paged", False))
             else:
@@ -542,10 +543,43 @@ class Booster:
                 binned = (dm.binned(self.tree_param.max_bin,
                                     ref_cuts=train_cuts)
                           if train_cuts is not None else None)
+                if binned is not None:
+                    binned = self._collapse_paged_if_fits(binned)
             n = dm.num_row()
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
             self._store_cache(key, binned, margin, is_train, dm, dm.info, n)
+        elif is_train and self.ctx.mesh is None and not getattr(
+                dm, "presharded", False) and tm not in ("approx", "exact"):
+            # a communicator activated AFTER the entry was built (training
+            # continuation on a persistent booster) must still refuse
+            # silently-local resident training — including a matrix the
+            # paged collapse already swapped for a resident one
+            self._check_row_comm_sync(paged=getattr(
+                self._caches[key]["binned"], "is_paged", False))
         return self._caches[key]
+
+    def _collapse_paged_if_fits(self, binned):
+        """External-memory fast path: when a paged matrix fits the HBM
+        page-cache budget on a single-rank, no-mesh config, swap it for a
+        device-resident BinnedMatrix (PagedBinnedMatrix.resident_binned)
+        — downstream the whole-tree-jitted resident growers, margin
+        caches and predictors take over at resident speed. Multi-rank row
+        split keeps the paged tier: its per-level histogram allreduce IS
+        the cross-rank sync (_check_row_comm_sync). Mesh configs keep it
+        too (train and eval alike): collapsing would pull every page onto
+        ONE device of a mesh that exists to split memory — the paged-mesh
+        kernels stream per-shard instead."""
+        if not getattr(binned, "is_paged", False):
+            return binned
+        if self.ctx.mesh is not None:
+            return binned
+        from .parallel import collective
+
+        comm = collective.get_communicator()
+        if comm.is_distributed() and comm.get_world_size() > 1:
+            return binned
+        res = binned.resident_binned()
+        return binned if res is None else res
 
     def _check_row_comm_sync(self, paged: bool) -> None:
         """Refuse silently-local training: with an active world>1

@@ -314,6 +314,7 @@ class PagedBinnedMatrix:
     def __post_init__(self) -> None:
         self._device_cache: dict = {}
         self._mesh_cache: dict = {}
+        self._resident = None  # built by resident_binned() when under budget
         if self.cache_budget_bytes < 0:
             import os
 
@@ -369,6 +370,13 @@ class PagedBinnedMatrix:
         budget."""
         from concurrent.futures import ThreadPoolExecutor
 
+        # streaming re-engaging (mesh train, XTPU_PAGED_COLLAPSE flipped,
+        # budget shrunk) supersedes a previously built resident collapse:
+        # stop pinning it here, or HBM would hold the full resident copy
+        # PLUS the re-warming page cache (boosters that trained on the
+        # collapsed matrix keep their own reference — that stays correct)
+        self._resident = None
+
         max_cached = (self.cache_budget_bytes // page_bytes
                       if page_bytes else 0)
         with ThreadPoolExecutor(1) as ex:
@@ -419,6 +427,48 @@ class PagedBinnedMatrix:
             else:
                 cached.append((s, hit[0], hit[1]))
         return cached, streamed
+
+    def resident_binned(self):
+        """Collapse to a device-resident ``BinnedMatrix`` when the whole
+        quantized matrix fits the HBM page-cache budget, else ``None``.
+
+        With every page inside the budget the fused per-level dispatches
+        already compute purely from HBM — at that point the only gap to
+        the resident tier is dispatch granularity (one program per level
+        + eval round trips vs ONE whole-tree jit). Paging exists to bound
+        device memory, and when the budget admits the full matrix there
+        is nothing left to bound: concatenating the cached pages once
+        hands training to the resident growers at resident speed. The
+        reference approaches the same limit from the other side — its
+        prefetch ring hides page IO behind compute so the paged tier
+        nears in-core speed when compute-bound
+        (``src/data/sparse_page_source.h:180-200``); on TPU the exact
+        equivalence is available, so take it. Streaming (and the fused
+        cached-page path) remains for matrices past the budget and for
+        multi-rank row split, where the per-level histogram allreduce IS
+        the sync protocol (core._check_row_comm_sync).
+
+        Memory: transiently 2x the matrix during the concat; the page
+        cache is dropped right after, so steady state is 1x — the same
+        HBM the page cache held. Opt out with XTPU_PAGED_COLLAPSE=0
+        (keeps the per-level fused-dispatch tier measurable on its own).
+        """
+        import os
+
+        if (self.bins_host.nbytes > self.cache_budget_bytes
+                or os.environ.get("XTPU_PAGED_COLLAPSE") == "0"):
+            return None
+        if self._resident is None:
+            parts = [p for _, _, p in self.pages()]
+            if not parts:
+                return None
+            bins = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                    else parts[0])
+            self._resident = BinnedMatrix(
+                bins=bins, cuts=self.cuts, max_nbins=self.max_nbins,
+                has_missing=self.has_missing)
+            self._device_cache.clear()  # superseded by the resident array
+        return self._resident
 
     def mesh_layout(self, world: int):
         """Row layout for mesh-sharded paging -> ``(n_pad, n_loc, p_loc)``.

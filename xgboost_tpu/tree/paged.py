@@ -1515,11 +1515,7 @@ class PagedMultiLossguideGrower(MultiLossguideGrower):
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, has_missing=has_missing,
                          constraint_sets=constraint_sets)
-        base_hm = hist_method
-        for _sfx in ("+sub", "+nosub"):
-            if base_hm.endswith(_sfx):
-                base_hm = base_hm[: -len(_sfx)]
-        if base_hm == "coarse":
+        if _strip_hist_suffix(hist_method) == "coarse":
             # same contract as the scalar PagedLossguideGrower (and the
             # core guard already rejects coarse for vector leaves)
             raise NotImplementedError(
