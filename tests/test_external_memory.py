@@ -654,3 +654,41 @@ def test_collapse_then_communicator_continuation_refused(tmp_path,
             bst.update(qdm, 2)
     finally:
         set_thread_local_communicator(None)
+
+
+def test_paged_collapse_covers_booster_families(tmp_path, monkeypatch):
+    """The collapse swaps the MATRIX, not a grower: dart, lossguide and
+    vector-leaf training on a collapsed paged matrix must be EXACTLY the
+    resident model (same device array, same whole-tree jit — identical
+    cuts by deterministic sketch, so equality is bitwise)."""
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "500")
+    monkeypatch.delenv("XTPU_PAGED_COLLAPSE", raising=False)
+    monkeypatch.setenv("XTPU_PAGE_CACHE_BYTES", str(4 << 30))
+    rng = np.random.RandomState(31)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = (X @ rng.randn(6) > 0).astype(np.float32)
+    Y2 = np.stack([X @ rng.randn(6), X @ rng.randn(6)], 1).astype(np.float32)
+
+    cases = [
+        ({"objective": "binary:logistic", "booster": "dart",
+          "rate_drop": 0.3, "max_depth": 3, "max_bin": 64}, y),
+        ({"objective": "binary:logistic", "grow_policy": "lossguide",
+          "max_leaves": 8, "max_depth": 0, "max_bin": 64}, y),
+        ({"objective": "reg:squarederror", "max_depth": 3, "max_bin": 64,
+          "multi_strategy": "multi_output_tree"}, Y2),
+    ]
+    for ci, (params, labels) in enumerate(cases):
+        it = BatchIter(X, labels, n_batches=3)
+        it.cache_prefix = str(tmp_path / f"f{ci}")
+        qdm_p = xgb.QuantileDMatrix(it, max_bin=64)
+        qdm_r = xgb.QuantileDMatrix(BatchIter(X, labels, n_batches=3),
+                                    max_bin=64)
+        bst_p = xgb.train(params, qdm_p, 4, verbose_eval=False)
+        bst_r = xgb.train(params, qdm_r, 4, verbose_eval=False)
+        assert qdm_p.binned(64)._resident is not None, params
+        assert len(bst_p.gbm.trees) == len(bst_r.gbm.trees) == 4
+        for tp, tr in zip(bst_p.gbm.trees, bst_r.gbm.trees):
+            np.testing.assert_array_equal(tp.split_feature,
+                                          tr.split_feature)
+            np.testing.assert_array_equal(tp.split_bin, tr.split_bin)
+            np.testing.assert_array_equal(tp.leaf_value, tr.leaf_value)
