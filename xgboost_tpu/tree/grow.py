@@ -255,11 +255,17 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             n, max_nbins, has_missing, numeric=cat is None,
             col_split=col_split)
     if use_coarse:
-        if cat is not None or col_split \
-                or max_nbins > 256 + int(has_missing):
+        if cat is not None or max_nbins > 256 + int(has_missing):
             raise NotImplementedError(
-                "hist_method='coarse' supports numeric features, row "
-                "split, and max_bin <= 256")
+                "hist_method='coarse' supports numeric features and "
+                "max_bin <= 256")
+        # col split composes: the scheme is feature-local end to end
+        # (coarse hist, window choice, refine, assembly all run on this
+        # shard's features over replicated rows; the existing best-split
+        # allgather exchanges the winner after the synthetic eval). The
+        # "auto" rule still skips col split — with F/world features per
+        # shard the two-pass overhead amortises worse, so coarse there
+        # is explicit opt-in.
         from ..ops.split import (assemble_two_level, choose_refine_window,
                                  coarse_bin_ids, decode_two_level_bin,
                                  refine_bin_ids)
