@@ -436,3 +436,28 @@ def test_col_split_coarse_hist_matches_single_device(mesh):
     np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
                                b2.predict(xgb.DMatrix(X)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_col_split_coarse_lossguide_matches_single_device(mesh):
+    """hist_method=coarse x grow_policy=lossguide x data_split_mode=col
+    (r5 grid lift): the per-split two-node coarse scheme runs on each
+    shard's features over replicated rows; the winner exchange is the
+    same as the exact lossguide col path. Includes missing values: the
+    missing mass rides the coarse pass's last slot per local feature."""
+    rng = np.random.RandomState(37)
+    X = rng.randn(3000, 13).astype(np.float32)
+    y = (X @ rng.randn(13) > 0).astype(np.float32)  # labels from dense X
+    X[rng.rand(*X.shape) < 0.15] = np.nan
+    params = {"objective": "binary:logistic", "eta": 0.3,
+              "hist_method": "coarse", "grow_policy": "lossguide",
+              "max_leaves": 10, "max_depth": 0}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+        assert int(t2.is_leaf.sum()) <= 10
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)

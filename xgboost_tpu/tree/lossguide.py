@@ -115,16 +115,22 @@ def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
                node_lower, node_upper, n_real_bins, bins_t, cb_t,
                monotone, cat, *,
                param: TrainParam, max_nbins: int, hist_method: str,
-               axis_name: str, has_missing: bool = True):
-    """Column-split ``_eval2`` (``cb_t`` unused — the two-level histogram
-    requires row split): this shard's bins hold global features
+               axis_name: str, has_missing: bool = True,
+               coarse: bool = False):
+    """Column-split ``_eval2``: this shard's bins hold global features
     [off, off + F); rows replicate so the two-node histogram needs no
     psum, each shard evaluates ITS features (local slices of the
     replicated global monotone/cat arrays), and the per-shard best goes
     through the scalar ``_grow`` best-split exchange — all-gather the
     gains, psum-select the winner's fields with its feature id globalised
     (reference ``HistEvaluator::EvaluateSplits`` column-split all-gather,
-    src/tree/hist/evaluate_splits.h:294-409)."""
+    src/tree/hist/evaluate_splits.h:294-409).
+
+    ``coarse``: the two-level scheme is feature-local end to end (coarse
+    hist, window choice, refine and synthetic assembly all run on this
+    shard's features over the replicated rows), so it composes with col
+    split exactly like the depthwise grower's (tree/grow.py) — the
+    winning slot decodes to a fine bin BEFORE the exchange."""
     F = bins.shape[1]
     my = jax.lax.axis_index(axis_name)
     feat_off = my * F
@@ -133,14 +139,15 @@ def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
     cat_loc = (None if cat is None else CatInfo(
         is_cat=jax.lax.dynamic_slice(cat.is_cat, (feat_off,), (F,)),
         is_onehot=jax.lax.dynamic_slice(cat.is_onehot, (feat_off,), (F,))))
-    rel = jnp.where(positions == id0, 0,
-                    jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
-    hist = build_hist(bins, gpair, rel, 2, max_nbins, method=hist_method,
-                      bins_t=bins_t)
-    res = evaluate_splits(hist, parent_sums, n_real_bins, param,
-                          feature_mask=fmask, monotone=mono_loc,
-                          node_lower=node_lower, node_upper=node_upper,
-                          cat=cat_loc, has_missing=has_missing)
+    # the local evaluation IS _eval2 on this shard's features with the
+    # psums elided (axis_name=None — rows are replicated, nothing to
+    # sum) and the sliced-local monotone/cat arrays; exact and coarse
+    # branches both stay single-sourced there
+    res = _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
+                 node_lower, node_upper, n_real_bins, bins_t, cb_t,
+                 mono_loc, cat_loc, param=param, max_nbins=max_nbins,
+                 hist_method=hist_method, axis_name=None,
+                 has_missing=has_missing, coarse=coarse)
     gains = jax.lax.all_gather(res.gain, axis_name)          # [P, 2]
     mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
 
@@ -297,11 +304,11 @@ class LossguideGrower:
                 base_hm = base_hm[: -len(_sfx)]
         self._base_hm = base_hm
         if base_hm == "coarse" and (
-                split_mode == "col" or self.cat is not None
+                self.cat is not None
                 or max_nbins > 256 + int(has_missing)):
             raise NotImplementedError(
                 "hist_method='coarse' with grow_policy=lossguide "
-                "supports numeric features, row split, max_bin <= 256")
+                "supports numeric features and max_bin <= 256")
         self._coarse = None
         if split_mode == "col":
             # bins pad the feature axis to a multiple of the mesh width;
@@ -347,16 +354,20 @@ class LossguideGrower:
             P = jax.sharding.PartitionSpec
 
             ev = functools.partial(_eval2_col, monotone=self.monotone,
-                                   cat=self.cat, axis_name=DATA_AXIS, **kw)
+                                   cat=self.cat, axis_name=DATA_AXIS,
+                                   coarse=bool(self._coarse), **kw)
             # features sharded, rows replicated; outputs come out
             # replicated through the best-split exchange (the static
             # replication checker can't prove it — check_vma off, as in
-            # the depthwise col grower)
+            # the depthwise col grower). cb_t ([F, n] like bins_t) shards
+            # on features when the coarse scheme is active, else it is
+            # the None placeholder (empty pytree, spec unused).
+            cb_spec = P(DATA_AXIS, None) if self._coarse else P()
             sharded_eval = jax.jit(jax.shard_map(
                 ev, mesh=self.mesh,
                 in_specs=(P(None, DATA_AXIS), P(), P(), P(), P(), P(),
                           P(None, DATA_AXIS), P(), P(), P(DATA_AXIS),
-                          P(DATA_AXIS, None), P()),
+                          P(DATA_AXIS, None), cb_spec),
                 out_specs=P(), check_vma=False))
             sharded_apply = jax.jit(jax.shard_map(
                 functools.partial(_apply1_col, axis_name=DATA_AXIS),
