@@ -322,3 +322,38 @@ def test_multi_output_sharded_ingestion():
             rmse0 = float(np.sqrt(np.mean((Y - Y.mean(0)) ** 2)))
             rmse = float(np.sqrt(np.mean((Y - p) ** 2)))
             assert rmse < rmse0
+
+
+def test_multi_output_lossguide_col_split_matches_single():
+    """Vector-leaf lossguide under mesh column split (r5 grid lift): the
+    K-channel two-node eval runs on each shard's features over
+    replicated rows, the winner crosses the same exchange as the
+    depthwise col branch, and the owner's decision-bit psum advances
+    rows. Interaction constraints exercise the padded-width host paths
+    (13 features pad to 16 over the 8-wide axis)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device platform")
+    mesh8 = xgb.make_data_mesh()
+    rng = np.random.RandomState(41)
+    X = rng.randn(3000, 13).astype(np.float32)
+    Y = np.stack([X[:, 0] + X[:, 1] ** 2,
+                  np.sin(X[:, 2]) + X[:, 3]], 1).astype(np.float32)
+    params = {"objective": "reg:squarederror",
+              "multi_strategy": "multi_output_tree",
+              "grow_policy": "lossguide", "max_leaves": 10, "max_depth": 0,
+              "interaction_constraints":
+                  "[[0,1,2,3,4,5],[6,7,8,9,10,11,12]]"}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=Y), 4, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh8, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=Y), 4, verbose_eval=False)
+    for t1, t2 in zip(b1.gbm.trees, b2.gbm.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+        assert int(t2.is_leaf.sum()) <= 10
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-5)

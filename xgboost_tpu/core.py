@@ -428,11 +428,6 @@ class Booster:
                 # under DataSplitMode::kCol via evaluate_splits.h:294-409)
                 raise NotImplementedError(
                     "data_split_mode=col supports tree_method=hist/approx")
-            if (self.tree_param.grow_policy == "lossguide"
-                    and ms == "multi_output_tree"):
-                raise NotImplementedError(
-                    "multi_output_tree lossguide does not support "
-                    "data_split_mode=col")
             if self.ctx.mesh is None:
                 # vertical federated (communicator ranks, no mesh): the
                 # decision-bit protocol covers scalar trees — depthwise

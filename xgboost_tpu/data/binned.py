@@ -104,6 +104,16 @@ def search_bin_into(X: np.ndarray, cuts: HistogramCuts, missing_bin: int,
     out[:] = np.where(b < 0, missing_bin, b)
 
 
+def feature_pad_for_mesh(F: int, world: int) -> int:
+    """Columns the feature axis pads by under a col-split mesh — every
+    shard must own an equal width. SINGLE definition of the rule:
+    ``pad_features_for_mesh`` below and every grower's host-array
+    padding (monotone / constraint-set / cat arrays must match the
+    padded bins width) call this, so a future change to the layout
+    propagates everywhere at once."""
+    return (-F) % world
+
+
 def pad_features_for_mesh(binned: "BinnedMatrix", mesh, axis_name: str
                           ) -> "BinnedMatrix":
     """Column-split mesh layout for a host-built BinnedMatrix: features pad
@@ -117,7 +127,7 @@ def pad_features_for_mesh(binned: "BinnedMatrix", mesh, axis_name: str
     world = mesh.shape.get(axis_name, 1)
     bins_np = np.asarray(binned.bins)
     n, F = bins_np.shape
-    f_pad = (-F) % world
+    f_pad = feature_pad_for_mesh(F, world)
     n_real = np.asarray(binned.cuts.n_real_bins(), np.int32)
     if f_pad:
         bins_np = np.concatenate(

@@ -100,6 +100,47 @@ def auto_selects_coarse(n_rows: int, max_nbins: int, has_missing: bool, *,
             and n_rows >= AUTO_COARSE_MIN_ROWS)
 
 
+def exchange_best_split(res, axis_name, F: int, *, with_cat: bool = False):
+    """Column-split best-split exchange, shared by every grower family
+    (depthwise scalar, lossguide, and their vector-leaf mirrors):
+    all-gather the per-shard best gains, pick the winning shard per
+    node, and psum-select the winner's split fields with its feature
+    index globalised by the shard offset (equal shard widths are
+    guaranteed by feature padding — ``data/binned.py
+    pad_features_for_mesh``). Mirrors the reference's evaluator
+    allgather (``src/tree/hist/evaluate_splits.h:294-409``). Returns
+    ``(exchanged_res, mine)`` — ``mine`` marks the nodes this shard
+    owns, which the callers' owner-local row advance needs.
+
+    The select mask broadcasts to each field's rank, so scalar [N]
+    ids, [N, 2] sums and [N, K, 2] vector-leaf sums all ride the same
+    closure. ``with_cat``: also exchange the categorical fields; the
+    uint32 bitmask words cross the psum via bitcast (not astype) so
+    the winner's words arrive bit-exactly (only one shard contributes
+    a nonzero term per node)."""
+    my = jax.lax.axis_index(axis_name)
+    gains = jax.lax.all_gather(res.gain, axis_name)          # [P, N]
+    mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
+
+    def sel(x):
+        m = mine.reshape(mine.shape + (1,) * (x.ndim - mine.ndim))
+        return jax.lax.psum(jnp.where(m, x, jnp.zeros_like(x)), axis_name)
+
+    repl = dict(
+        gain=jnp.max(gains, axis=0),
+        feature=sel(res.feature + my * F),
+        bin=sel(res.bin),
+        default_left=sel(res.default_left.astype(jnp.int32)) > 0,
+        left_sum=sel(res.left_sum),
+        right_sum=sel(res.right_sum))
+    if with_cat:
+        repl["is_cat"] = sel(res.is_cat.astype(jnp.int32)) > 0
+        repl["cat_words"] = jax.lax.bitcast_convert_type(
+            sel(jax.lax.bitcast_convert_type(res.cat_words, jnp.int32)),
+            jnp.uint32)
+    return res._replace(**repl), mine
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("param", "max_nbins", "hist_method", "axis_name",
@@ -387,42 +428,11 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                 bin=decode_two_level_bin(res.bin, span_sel))
 
         if col_split:
-            # column-split best-split exchange: all-gather per-shard best
-            # gains, pick the winning shard per node, and psum-select the
-            # winner's split fields (its feature index is globalised by the
-            # shard offset; equal shard widths are guaranteed by padding)
-            my = jax.lax.axis_index(axis_name)
-            gains = jax.lax.all_gather(res.gain, axis_name)      # [P, N]
-            mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
-
-            def _sel(x):
-                return jax.lax.psum(
-                    jnp.where(mine, x, jnp.zeros_like(x)), axis_name)
-
-            def _sel2(x):
-                return jax.lax.psum(
-                    jnp.where(mine[:, None], x, jnp.zeros_like(x)),
-                    axis_name)
-
             local_feat, local_bin = res.feature, res.bin
             local_dl = res.default_left
             local_is_cat, local_words = res.is_cat, res.cat_words
-            repl = dict(
-                gain=jnp.max(gains, axis=0),
-                feature=_sel(res.feature + my * F),
-                bin=_sel(res.bin),
-                default_left=_sel(res.default_left.astype(jnp.int32)) > 0,
-                left_sum=_sel2(res.left_sum),
-                right_sum=_sel2(res.right_sum))
-            if cat is not None:
-                # bitcast (not astype): the winner's uint32 bitmask words
-                # must cross the psum bit-exactly, and only one shard
-                # contributes a nonzero term per node
-                repl["is_cat"] = _sel(res.is_cat.astype(jnp.int32)) > 0
-                repl["cat_words"] = jax.lax.bitcast_convert_type(
-                    _sel2(jax.lax.bitcast_convert_type(
-                        res.cat_words, jnp.int32)), jnp.uint32)
-            res = res._replace(**repl)
+            res, mine = exchange_best_split(res, axis_name, F,
+                                            with_cat=cat is not None)
 
         # a node exists at this level iff its parent split; it expands unless
         # the best gain fails the gamma / kRtEps test (reference prune rule).
@@ -683,7 +693,9 @@ class TreeGrower:
 
             world = mesh.shape.get(DATA_AXIS, 1)
             F = int(np.asarray(is_cat).shape[0])
-            pad = (-F) % world
+            from ..data.binned import feature_pad_for_mesh
+
+            pad = feature_pad_for_mesh(F, world)
             if pad:
                 if self.monotone is not None:
                     self.monotone = jnp.pad(self.monotone, (0, pad))

@@ -148,32 +148,11 @@ def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
                  mono_loc, cat_loc, param=param, max_nbins=max_nbins,
                  hist_method=hist_method, axis_name=None,
                  has_missing=has_missing, coarse=coarse)
-    gains = jax.lax.all_gather(res.gain, axis_name)          # [P, 2]
-    mine = jnp.argmax(gains, axis=0).astype(jnp.int32) == my
+    from .grow import exchange_best_split
 
-    def _sel(x):
-        return jax.lax.psum(jnp.where(mine, x, jnp.zeros_like(x)),
-                            axis_name)
-
-    def _sel2(x):
-        return jax.lax.psum(jnp.where(mine[:, None], x, jnp.zeros_like(x)),
-                            axis_name)
-
-    repl = dict(
-        gain=jnp.max(gains, axis=0),
-        feature=_sel(res.feature + my * F),
-        bin=_sel(res.bin),
-        default_left=_sel(res.default_left.astype(jnp.int32)) > 0,
-        left_sum=_sel2(res.left_sum),
-        right_sum=_sel2(res.right_sum))
-    if cat is not None:
-        # bitcast (not astype): the winner's uint32 bitmask words must
-        # cross the psum bit-exactly (one nonzero term per node)
-        repl["is_cat"] = _sel(res.is_cat.astype(jnp.int32)) > 0
-        repl["cat_words"] = jax.lax.bitcast_convert_type(
-            _sel2(jax.lax.bitcast_convert_type(res.cat_words, jnp.int32)),
-            jnp.uint32)
-    return res._replace(**repl)
+    res, _ = exchange_best_split(res, axis_name, F,
+                                 with_cat=cat is not None)
+    return res
 
 
 def _apply1_col(bins, positions, nid, feat, sbin, dleft, is_cat, words,
@@ -319,7 +298,9 @@ class LossguideGrower:
 
             world = mesh.shape.get(DATA_AXIS, 1)
             F = int(np.asarray(cuts.is_cat()).shape[0])
-            pad = (-F) % world
+            from ..data.binned import feature_pad_for_mesh
+
+            pad = feature_pad_for_mesh(F, world)
             if pad:
                 if self.monotone is not None:
                     self.monotone = jnp.pad(self.monotone, (0, pad))
