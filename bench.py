@@ -148,6 +148,7 @@ def bench_paged11m():
     tmp = tempfile.TemporaryDirectory(prefix="bench_paged_")
     it.cache_prefix = os.path.join(tmp.name, "pc")
     dm = None
+    overlap = None
     prior = os.environ.get("XTPU_PAGED_COLLAPSE")
     try:
         dm = xgb.QuantileDMatrix(it, max_bin=256)
@@ -155,7 +156,12 @@ def bench_paged11m():
         # streaming tier first: warms the page cache, then the default
         # path collapses over that same warm cache (one device concat)
         os.environ["XTPU_PAGED_COLLAPSE"] = "0"
-        timed_train(dm, 2)  # compiles
+        binned = dm.binned(256)
+        binned.reset_ring_stats()
+        timed_train(dm, 2)  # compiles; pages upload during this pass
+        # overlap-% of the cache-warming uploads (VERDICT r5 item 6):
+        # the fraction of H2D wall time hidden behind compute
+        overlap = binned.streaming_overlap()
         s5 = min(timed_train(dm, 5)[0] for _ in range(2))
         s15 = min(timed_train(dm, 15)[0] for _ in range(2))
         os.environ.pop("XTPU_PAGED_COLLAPSE", None)
@@ -172,7 +178,8 @@ def bench_paged11m():
     # None (JSON null), never float nan: json.dumps emits bare NaN which
     # strict parsers reject, losing the driver's WHOLE metric line
     return (round((t15 - t5) / 10.0, 3) if t15 > t5 else None,
-            round((s15 - s5) / 10.0, 3) if s15 > s5 else None)
+            round((s15 - s5) / 10.0, 3) if s15 > s5 else None,
+            None if overlap is None else round(100.0 * overlap, 1))
 
 
 def bench_dart_multiclass():
@@ -248,14 +255,17 @@ def bench_rank_unbiased():
 def bench_higgs11m():
     """North-star shape (BASELINE.md): 11M x 28, depth 6. Returns cold
     20-round r/s, steady-state r/s (slope between 20 and 100 rounds —
-    the only honest per-round number over the axon tunnel), and the
-    steady rate of the exact one-pass kernel (hist_method='pallas';
-    slope 20->60). Since round 5 the DEFAULT (hist_method='auto')
-    routes to the two-level coarse histogram at this scale
-    (tree/grow.py auto_selects_coarse; quality table in
-    docs/performance.md), so the headline number IS the coarse path and
-    the exact kernel is the explicitly measured comparison. Slope
-    endpoints are best-of-3 so tunnel noise (+-30%) hits them evenly."""
+    the only honest per-round number over the axon tunnel), the steady
+    rate of the exact one-pass kernel (hist_method='pallas'; slope
+    20->60), and the steady rate of the TWO-PASS coarse schedule
+    (hist_method='coarse'). Since round 6 the DEFAULT
+    (hist_method='auto') routes to the cross-level FUSED two-level
+    histogram at this scale (tree/grow.py; bit-exact with 'coarse' —
+    tests/test_fused_hist.py), so the headline number IS the fused
+    path; 'coarse' pins the unfused scheduling so the fusion delta
+    stays measurable round over round, and 'pallas' pins the one-pass
+    exact kernel. Slope endpoints are best-of-N so tunnel noise
+    (+-30%) hits them evenly."""
     import xgboost_tpu as xgb
 
     X, y = make_data(11_000_000, COLS)
@@ -266,25 +276,47 @@ def bench_higgs11m():
     t20 = min(timed_train(dm, 20)[0] for _ in range(3))
     t100 = min(timed_train(dm, 100)[0] for _ in range(3))
     steady = 80.0 / (t100 - t20) if t100 > t20 else None
-    exact = None
-    if os.environ.get("BENCH_EXACT", "1") != "0":
-        pe = {**PARAMS, "hist_method": "pallas"}
 
-        def timed_e(rounds):
-            import jax
+    def pinned_steady(hist_method, r_hi=60):
+        import jax
 
+        pp = {**PARAMS, "hist_method": hist_method}
+
+        def timed_p(rounds):
             t0 = time.perf_counter()
-            bst = xgb.train(pe, dm, rounds, verbose_eval=False)
+            bst = xgb.train(pp, dm, rounds, verbose_eval=False)
             for st in bst._caches.values():
                 jax.block_until_ready(st["margin"])
                 float(np.asarray(st["margin"][0, 0]))
             return time.perf_counter() - t0
 
-        timed_e(2)
-        e20 = min(timed_e(20) for _ in range(2))
-        e60 = min(timed_e(60) for _ in range(2))
-        exact = round(40.0 / (e60 - e20), 4) if e60 > e20 else None
-    return 20.0 / t20, steady, exact
+        timed_p(2)
+        p20 = min(timed_p(20) for _ in range(2))
+        p_hi = min(timed_p(r_hi) for _ in range(2))
+        return round((r_hi - 20.0) / (p_hi - p20), 4) if p_hi > p20 else None
+
+    exact = (pinned_steady("pallas")
+             if os.environ.get("BENCH_EXACT", "1") != "0" else None)
+    twopass = (pinned_steady("coarse")
+               if os.environ.get("BENCH_COARSE", "1") != "0" else None)
+    return 20.0 / t20, steady, exact, twopass
+
+
+def bench_shard1375k():
+    """v5e-8 projection input (BASELINE.md; VERDICT r5 item 8): HIGGS-11M
+    sharded 8 ways = 1.375M rows/chip — steady ms/round of that shard
+    size under the DEFAULT hist_method, re-measured each round because
+    the kernel mix changes (coarse r5, fused r6). Skip with
+    BENCH_SHARD=0."""
+    import xgboost_tpu as xgb
+
+    X, y = make_data(1_375_000, COLS)
+    dm = xgb.DMatrix(X, label=y)
+    timed_train(dm, 2)
+    t20 = min(timed_train(dm, 20)[0] for _ in range(2))
+    t100 = min(timed_train(dm, 100)[0] for _ in range(2))
+    return (round((t100 - t20) / 80.0 * 1000.0, 2) if t100 > t20
+            else None)
 
 
 def main():
@@ -299,7 +331,7 @@ def main():
         "vs_baseline": round(ours_rps / base_rps, 4),
     }
     if os.environ.get("BENCH_11M", "1") != "0":
-        cold20, steady, exact = bench_higgs11m()
+        cold20, steady, exact, twopass = bench_higgs11m()
         # gpu_hist-class derived target: BASELINE.md "North star" section
         result["higgs11m_cold20_rounds_per_sec"] = round(cold20, 4)
         result["higgs11m_steady_rounds_per_sec"] = (
@@ -307,16 +339,23 @@ def main():
         result["higgs11m_target_gpu_hist_class"] = 8.0
         result["higgs11m_vs_target"] = (
             None if steady is None else round(steady / 8.0, 4))
-        # the default path IS the two-level coarse histogram at this
-        # scale since round 5 (same key kept for round-over-round
-        # comparability); the exact one-pass kernel rides beside it
+        # the default path IS the two-level histogram at this scale
+        # (coarse since round 5, cross-level FUSED since round 6; same
+        # key kept for round-over-round comparability); the explicitly
+        # pinned two-pass coarse and exact one-pass kernels ride beside
+        # it so both deltas stay measurable
         result["higgs11m_coarse_steady_rounds_per_sec"] = (
             None if steady is None else round(steady, 4))
+        result["higgs11m_twopass_steady_rounds_per_sec"] = twopass
         result["higgs11m_exact_steady_rounds_per_sec"] = exact
+    if os.environ.get("BENCH_SHARD", "1") != "0":
+        # v5e-8 projection input (1.375M rows/chip; VERDICT r5 item 8)
+        result["shard1375k_ms_per_round"] = bench_shard1375k()
     if os.environ.get("BENCH_PAGED", "1") != "0":
-        paged_default, paged_streaming = bench_paged11m()
+        paged_default, paged_streaming, overlap = bench_paged11m()
         result["paged11m_steady_sec_per_round"] = paged_default
         result["paged11m_streaming_sec_per_round"] = paged_streaming
+        result["paged11m_streaming_overlap_pct"] = overlap
     if os.environ.get("BENCH_DART", "1") != "0":
         result["dart_covertype_rounds_per_sec"] = round(
             bench_dart_multiclass(), 3)

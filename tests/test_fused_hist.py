@@ -1,0 +1,329 @@
+"""Cross-level fused histogram sweep parity (hist_method="fused", round 6).
+
+The fused scheme reschedules the two-level coarse->refine histogram: at
+each level boundary the row advance below level L's decoded splits and
+level L+1's coarse accumulation share one sweep over the bin matrix
+(``ops/histogram.py fused_advance_coarse``; the Pallas kernel in
+``ops/pallas/histogram.py`` reads the [F, R] tile once for both). The
+contract is BIT-EXACTNESS with the two-pass ``hist_method="coarse"``
+schedule — same search space, same numerics, fewer HBM streams — and
+these tests pin it at three altitudes:
+
+- kernel:   ``fused_advance_coarse_pallas(interpret=True)`` against the
+            sequential ``advance_positions_level`` + int8x2 coarse build
+            (bit-identical) and the segment ground truth (tolerance);
+- op:       the XLA ``fused_advance_coarse`` body against the sequential
+            composition, dense and walk kinds (bit-identical);
+- model:    trains with hist_method 'fused' vs 'coarse' — resident
+            depthwise, lossguide, paged external memory, and the mesh
+            column-split composition — identical dumps/predictions.
+
+Plus the ADVICE r5 #2 satellite: colsample draws seeded from real columns
+only, so padded mesh-col-split feature axes keep sampling parity.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import xgboost_tpu as xgb
+from xgboost_tpu.ops.histogram import build_hist_segment, fused_advance_coarse
+from xgboost_tpu.ops.pallas.histogram import (build_hist_pallas,
+                                              fused_advance_coarse_pallas)
+from xgboost_tpu.ops.partition import advance_positions_level, update_positions
+from xgboost_tpu.ops.split import COARSE_B, coarse_bin_ids
+
+
+def _level_data(n, F, max_nbins, lo_prev, n_prev, seed=0):
+    """Rows parked at level ``lo_prev..lo_prev+n_prev`` plus strays, and a
+    random (partially non-splitting) split payload for that level."""
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, max_nbins, (n, F)).astype(np.uint8)
+    gpair = rng.randn(n, 2).astype(np.float32)
+    gpair[:, 1] = np.abs(gpair[:, 1])
+    positions = rng.randint(lo_prev, lo_prev + n_prev, n).astype(np.int32)
+    positions[rng.rand(n) < 0.1] = 0  # strays above the level stay put
+    feat = rng.randint(0, F, n_prev).astype(np.int32)
+    thr = rng.randint(0, max_nbins - 1, n_prev).astype(np.int32)
+    dleft = rng.rand(n_prev) < 0.5
+    can_split = rng.rand(n_prev) < 0.8
+    feat = np.where(can_split, feat, -1).astype(np.int32)
+    thr = np.where(can_split, thr, 0).astype(np.int32)
+    dleft = dleft & can_split
+    return (jnp.asarray(bins), jnp.asarray(gpair), jnp.asarray(positions),
+            jnp.asarray(feat), jnp.asarray(thr), jnp.asarray(dleft),
+            jnp.asarray(can_split))
+
+
+def _sequential(bins, gpair, positions, feat, thr, dleft, can_split,
+                lo_prev, n_prev, lo, n_level, missing_bin, coarse_kernel):
+    """The two-pass ground truth: advance below the previous level's
+    splits, then the new level's coarse histogram as a separate pass."""
+    rel_prev = jnp.where(
+        (positions >= lo_prev) & (positions < lo_prev + n_prev),
+        positions - lo_prev, n_prev).astype(jnp.int32)
+    new_pos = advance_positions_level(
+        bins.astype(jnp.float32), positions, rel_prev, feat, thr, dleft,
+        can_split, missing_bin)
+    rel = jnp.where((new_pos >= lo) & (new_pos < lo + n_level),
+                    new_pos - lo, n_level).astype(jnp.int32)
+    cb = coarse_bin_ids(bins.astype(jnp.int32), missing_bin)
+    return new_pos, coarse_kernel(cb, gpair, rel, n_level)
+
+
+@pytest.mark.parametrize("n,n_prev,n_level", [(700, 2, 4), (1500, 4, 8)])
+def test_fused_pallas_interpret_matches_sequential(n, n_prev, n_level):
+    F, max_nbins = 5, 64
+    missing_bin = max_nbins - 1
+    lo_prev, lo = n_prev - 1, 2 * n_prev - 1
+    data = _level_data(n, F, max_nbins, lo_prev, n_prev, seed=n)
+    bins, gpair = data[0], data[1]
+
+    pos_f, hist_f = fused_advance_coarse_pallas(
+        bins.T, gpair, *data[2:], lo_prev=lo_prev, n_prev=n_prev, lo=lo,
+        n_level=n_level, missing_bin=missing_bin, block_rows=256,
+        interpret=True)
+
+    # positions: pure integer routing — bit-exact with the matmul advance
+    pos_ref, hist_q = _sequential(
+        *data, lo_prev, n_prev, lo, n_level, missing_bin,
+        lambda cb, gp, rel, nl: build_hist_pallas(
+            cb.T, gp, rel, nl, COARSE_B, precision="int8x2",
+            block_rows=256, interpret=True))
+    np.testing.assert_array_equal(np.asarray(pos_f), np.asarray(pos_ref))
+    # histogram: BIT-identical to the unfused int8x2 kernel (same
+    # quantisation, same packed SWAR one-hot, same accumulation order)
+    np.testing.assert_array_equal(np.asarray(hist_f), np.asarray(hist_q))
+    assert hist_f.shape == (n_level, F, COARSE_B, 2)
+
+    # and within fixed-point tolerance of the exact segment ground truth
+    _, hist_ref = _sequential(
+        *data, lo_prev, n_prev, lo, n_level, missing_bin,
+        lambda cb, gp, rel, nl: build_hist_segment(cb, gp, rel, nl,
+                                                   COARSE_B))
+    scale = max(float(np.abs(np.asarray(hist_ref)).max()), 1.0)
+    np.testing.assert_allclose(np.asarray(hist_f) / scale,
+                               np.asarray(hist_ref) / scale,
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_fused_op_xla_dense_matches_sequential():
+    """The XLA body of fused_advance_coarse (the non-Pallas path every
+    backend gets) composes the exact sequential ops — bit-identical."""
+    n, F, max_nbins, n_prev, n_level = 900, 6, 32, 2, 4
+    missing_bin = max_nbins - 1
+    lo_prev, lo = 1, 3
+    data = _level_data(n, F, max_nbins, lo_prev, n_prev, seed=7)
+    bins, gpair = data[0], data[1]
+    feat, thr, dleft, can_split = data[3:]
+    prev = {"kind": "dense", "lo": lo_prev, "n_level": n_prev,
+            "arrs": (feat, thr, dleft, can_split)}
+    pos_f, hist_f = fused_advance_coarse(
+        bins, gpair, data[2], prev, lo, n_level, missing_bin,
+        bins_t=bins.T, method="auto")
+    pos_ref, hist_ref = _sequential(
+        *data, lo_prev, n_prev, lo, n_level, missing_bin,
+        lambda cb, gp, rel, nl: build_hist_segment(cb, gp, rel, nl,
+                                                   COARSE_B))
+    np.testing.assert_array_equal(np.asarray(pos_f), np.asarray(pos_ref))
+    np.testing.assert_array_equal(np.asarray(hist_f), np.asarray(hist_ref))
+
+
+def test_fused_op_walk_kind_matches_update_positions():
+    """Deep levels route through the per-row gather walk: the fused
+    boundary sweep must produce the same positions + coarse histogram."""
+    n, F, max_nbins = 800, 4, 32
+    missing_bin = max_nbins - 1
+    n_prev, lo_prev = 4, 3
+    n_level, lo = 8, 7
+    max_nodes = 15
+    rng = np.random.RandomState(3)
+    bins = jnp.asarray(rng.randint(0, max_nbins, (n, F)).astype(np.uint8))
+    gpair = jnp.asarray(np.abs(rng.randn(n, 2)).astype(np.float32))
+    positions = jnp.asarray(
+        rng.randint(lo_prev, lo_prev + n_prev, n).astype(np.int32))
+    sf = np.full(max_nodes, -1, np.int32)
+    sb = np.zeros(max_nodes, np.int32)
+    dl = np.zeros(max_nodes, bool)
+    isf = np.zeros(max_nodes, bool)
+    for nid in range(lo_prev, lo_prev + n_prev):
+        if rng.rand() < 0.75:
+            sf[nid] = rng.randint(0, F)
+            sb[nid] = rng.randint(0, max_nbins - 1)
+            dl[nid] = rng.rand() < 0.5
+            isf[nid] = True
+    arrs = (jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(dl),
+            jnp.asarray(isf))
+    prev = {"kind": "walk", "lo": lo_prev, "n_level": n_prev, "arrs": arrs}
+    pos_f, hist_f = fused_advance_coarse(
+        bins, gpair, positions, prev, lo, n_level, missing_bin,
+        bins_t=bins.T, method="auto")
+    pos_ref = update_positions(bins, positions, *arrs, missing_bin)
+    rel = jnp.where((pos_ref >= lo) & (pos_ref < lo + n_level),
+                    pos_ref - lo, n_level).astype(jnp.int32)
+    cb = coarse_bin_ids(bins.astype(jnp.int32), missing_bin)
+    hist_ref = build_hist_segment(cb, gpair, rel, n_level, COARSE_B)
+    np.testing.assert_array_equal(np.asarray(pos_f), np.asarray(pos_ref))
+    np.testing.assert_array_equal(np.asarray(hist_f), np.asarray(hist_ref))
+
+
+def _binary_data(n=4000, F=8, missing=False, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(F) > 0).astype(np.float32)
+    if missing:
+        X[rng.rand(n, F) < 0.1] = np.nan
+    return X, y
+
+
+@pytest.mark.parametrize("missing", [False, True])
+def test_fused_train_depthwise_matches_coarse(missing):
+    """Resident depthwise: 'fused' is the coarse scheme rescheduled —
+    identical trees, stats included."""
+    X, y = _binary_data(missing=missing)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 256,
+              "max_depth": 5}
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    assert b_f.get_dump(with_stats=True) == b_c.get_dump(with_stats=True)
+
+
+def test_fused_train_lossguide_matches_coarse():
+    """Lossguide: the fused one-dispatch apply+eval schedule is the
+    sequential apply1 -> eval2 composition, op for op."""
+    X, y = _binary_data(n=3000, F=6, seed=12)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 10, "max_depth": 0}
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert b_f.get_dump(with_stats=True) == b_c.get_dump(with_stats=True)
+
+
+def test_fused_train_paged_matches_coarse(tmp_path, monkeypatch):
+    """Paged external memory: 'fused' selects the same two-level scheme
+    whose advance + coarse page pass has been one fused body since r5."""
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    X, y = _binary_data(n=3000, F=5, seed=13)
+
+    def make_dm():
+        class It(DataIter):
+            def __init__(self):
+                super().__init__()
+                self.parts = np.array_split(np.arange(len(X)), 3)
+                self.i = 0
+
+            def next(self, input_data):
+                if self.i >= len(self.parts):
+                    return 0
+                idx = self.parts[self.i]
+                input_data(data=X[idx], label=y[idx])
+                self.i += 1
+                return 1
+
+            def reset(self):
+                self.i = 0
+
+        it = It()
+        it.cache_prefix = str(tmp_path / "pc")
+        return xgb.QuantileDMatrix(it, max_bin=64)
+
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "1024")
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")  # stay on page kernels
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "max_depth": 4}
+    b_c = xgb.train({**params, "hist_method": "coarse"}, make_dm(), 3,
+                    verbose_eval=False)
+    b_f = xgb.train({**params, "hist_method": "fused"}, make_dm(), 3,
+                    verbose_eval=False)
+    assert b_f.get_dump(with_stats=True) == b_c.get_dump(with_stats=True)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (virtual) platform")
+    return xgb.make_data_mesh()
+
+
+def test_fused_mesh_row_split_matches_coarse(mesh):
+    """Row-split mesh depthwise: the fused boundary sweep psums the same
+    coarse histogram the two-pass schedule does."""
+    X, y = _binary_data(n=4096, F=6, seed=14)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 256,
+              "max_depth": 4, "mesh": mesh}
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert b_f.get_dump(with_stats=True) == b_c.get_dump(with_stats=True)
+
+
+def test_fused_mesh_col_split_lossguide_matches_coarse(mesh):
+    """Mesh column split x lossguide: owner-decision advance + feature-
+    local eval fused into one program must match the two-dispatch coarse
+    schedule."""
+    X, y = _binary_data(n=3000, F=6, seed=15)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 8, "max_depth": 0,
+              "mesh": mesh, "data_split_mode": "col"}
+    b_c = xgb.train({**params, "hist_method": "coarse"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert b_f.get_dump(with_stats=True) == b_c.get_dump(with_stats=True)
+
+
+def test_fused_rejected_outside_hist_scalar():
+    X, y = _binary_data(n=400, F=4, seed=16)
+    dm = xgb.DMatrix(X, label=y)
+    with pytest.raises(NotImplementedError):
+        xgb.train({"objective": "binary:logistic", "tree_method": "approx",
+                   "hist_method": "fused"}, dm, 1, verbose_eval=False)
+
+
+# ---- ADVICE r5 #2: colsample draws come from REAL columns only ----------
+
+def test_col_masks_padded_columns_keep_sampling_parity():
+    """col_masks seeded with a base mask of the real columns draws the
+    SAME features as the unpadded run — padded mesh-col-split columns no
+    longer consume colsample draws."""
+    from xgboost_tpu.tree.lossguide import col_masks
+    from xgboost_tpu.tree.param import TrainParam
+
+    param = TrainParam(colsample_bytree=0.5, colsample_bylevel=0.7,
+                       colsample_bynode=0.7, max_depth=4)
+    F, F_pad = 6, 8
+    base = np.zeros(F_pad, bool)
+    base[:F] = True
+    m_ref = col_masks(param, 123, F)
+    m_pad = col_masks(param, 123, F_pad, base)
+    for depth in range(3):
+        ref = m_ref(depth)
+        pad = m_pad(depth)
+        np.testing.assert_array_equal(pad[:F], ref)
+        assert not pad[F:].any()
+
+
+def test_lossguide_col_split_colsample_matches_single_device(mesh):
+    """End to end: F=6 pads to 8 under the 8-way col-split mesh; with
+    colsample active the mesh model must still equal the single-device
+    model (pre-fix, the padded columns consumed draws and diverged)."""
+    X, y = _binary_data(n=3000, F=6, seed=17)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 8, "max_depth": 0,
+              "colsample_bytree": 0.5, "seed": 9}
+    b1 = xgb.train(params, xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b2 = xgb.train({**params, "mesh": mesh, "data_split_mode": "col"},
+                   xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    np.testing.assert_allclose(b1.predict(xgb.DMatrix(X)),
+                               b2.predict(xgb.DMatrix(X)),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,91 @@
+"""Promotion gate for hist_method='fused' vs the two-pass 'coarse' path.
+
+Round 6 mirrors the round-5 promotion protocol (tools/validate_coarse.py):
+before 'auto' routes to the cross-level fused sweep, the SAME 3-task x
+3-seed grid trains both schedules and checks quality. The fused scheme is
+a RESCHEDULING of the coarse search (one sweep carries the advance and
+the next level's coarse pass; ops/histogram.py fused_advance_coarse), so
+unlike the r5 coarse-vs-exact study — which traded search exhaustiveness
+and needed eval-set generalisation evidence — the bar here is strict
+EQUALITY: per-round eval metrics must be bit-identical (the unit parity
+suite, tests/test_fused_hist.py, additionally pins dump-level identity).
+Any nonzero gap printed below is a correctness bug, not a quality trade.
+
+Run from the repo root on the TPU: ``python tools/validate_fused.py``.
+Shrink for a smoke run: VALIDATE_FUSED_SCALE=0.05 (fraction of rows).
+"""
+
+import json
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))  # repo root (xgboost_tpu)
+sys.path.insert(0, _here)                   # tools/ (validate_coarse)
+
+from validate_coarse import SHAPES  # noqa: E402
+
+SEEDS = (0, 1, 2)
+SCALE = float(os.environ.get("VALIDATE_FUSED_SCALE", "1.0"))
+
+
+def run_cell(maker, params, rounds, metric, seed, hist_method):
+    import xgboost_tpu as xgb
+
+    (Xtr, ytr, qtr), (Xev, yev, qev) = maker(seed)
+    if SCALE < 1.0:
+        ktr, kev = int(len(ytr) * SCALE), int(len(yev) * SCALE)
+        Xtr, ytr = Xtr[:ktr], ytr[:ktr]
+        Xev, yev = Xev[:kev], yev[:kev]
+        qtr = None if qtr is None else qtr[:ktr]
+        qev = None if qev is None else qev[:kev]
+    dtr = xgb.DMatrix(Xtr, label=ytr, qid=qtr)
+    dev = xgb.DMatrix(Xev, label=yev, qid=qev)
+    p = {**params, "seed": seed, "hist_method": hist_method}
+    res = {}
+    xgb.train(p, dtr, rounds, evals=[(dev, "eval")], evals_result=res,
+              verbose_eval=False)
+    return [float(v) for v in res["eval"][metric]]
+
+
+def main():
+    rows = []
+    exact_parity = True
+    # fused supports the scalar hist growers only — the multiclass shape
+    # trains K scalar trees per round through the same growers, so all
+    # three r5 shapes apply unchanged
+    for name, maker, params, rounds, metric, _ in SHAPES:
+        rounds = max(2, int(rounds * (SCALE if SCALE < 1 else 1)))
+        for seed in SEEDS:
+            coarse = run_cell(maker, params, rounds, metric, seed, "coarse")
+            fused = run_cell(maker, params, rounds, metric, seed, "fused")
+            gaps = [abs(f - c) for f, c in zip(fused, coarse)]
+            worst = max(gaps)
+            exact_parity &= worst == 0.0
+            rows.append({"shape": name, "seed": seed, "metric": metric,
+                         "rounds": rounds,
+                         "coarse_final": round(coarse[-1], 6),
+                         "fused_final": round(fused[-1], 6),
+                         "worst_round_gap": worst})
+            r = rows[-1]
+            print(f"{name} seed={seed} {metric}: coarse={r['coarse_final']}"
+                  f" fused={r['fused_final']} worst_gap={worst:g}",
+                  flush=True)
+
+    print("\n| shape | metric | seed | coarse (final) | fused (final) | "
+          "worst per-round gap |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['shape']} | {r['metric']} | {r['seed']} | "
+              f"{r['coarse_final']:.6f} | {r['fused_final']:.6f} | "
+              f"{r['worst_round_gap']:g} |")
+    verdict = "PASS — bit-identical, auto promotion justified" \
+        if exact_parity else "FAIL — fused diverges from coarse (bug)"
+    print(f"\n{verdict}")
+    print(json.dumps({"cells": rows, "exact_parity": exact_parity}))
+    if not exact_parity:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

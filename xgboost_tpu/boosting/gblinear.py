@@ -22,13 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..registry import BOOSTERS
+from ..registry import BOOSTERS, LINEAR_UPDATERS
 
 
 def _soft_threshold(x, alpha):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - alpha, 0.0)
 
 
+@LINEAR_UPDATERS.register("shotgun")
 @functools.partial(jax.jit, static_argnames=("eta", "lam", "alpha"))
 def _shotgun_round(X, gpair, W, bias, *, eta, lam, alpha):
     """One parallel coordinate round. X: [n,F] (0 = missing), gpair: [n,K,2],
@@ -49,6 +50,7 @@ def _shotgun_round(X, gpair, W, bias, *, eta, lam, alpha):
     return W + dW, bias + dbias, delta
 
 
+@LINEAR_UPDATERS.register("coord_descent")
 @functools.partial(jax.jit, static_argnames=("eta", "lam", "alpha"))
 def _coord_round(X, gpair, W, bias, *, eta, lam, alpha):
     """Sequential (exact) coordinate descent via lax.scan over features."""
@@ -123,8 +125,10 @@ class GBLinear:
         if self.W is None:
             self.W = jnp.zeros((X.shape[1], self.n_groups), jnp.float32)
             self.bias = jnp.zeros((self.n_groups,), jnp.float32)
-        fn = _coord_round if self.updater == "coord_descent" \
-            else _shotgun_round
+        # the registry is the dispatch point (plugin linear updaters
+        # register alongside shotgun/coord_descent); unknown names keep
+        # the historical shotgun default
+        fn = LINEAR_UPDATERS.get(self.updater) or _shotgun_round
         self.W, self.bias, delta = fn(
             X, gpair, self.W, self.bias, eta=self.eta, lam=self.reg_lambda,
             alpha=self.reg_alpha)

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..registry import PREDICTORS
+
 
 def _bit_is_left(code: jnp.ndarray, words_flat: jnp.ndarray,
                  gi: jnp.ndarray, n_words: int) -> jnp.ndarray:
@@ -132,6 +134,8 @@ def _predict_margin_binned(split_feature: jnp.ndarray, split_bin: jnp.ndarray,
     return margin, pos
 
 
+@PREDICTORS.register("tpu_predictor", "cpu_predictor", "gpu_predictor",
+                     "auto")
 class ForestPredictor:
     """Holds the stacked device forest and dispatches prediction variants.
 

@@ -403,11 +403,11 @@ class Booster:
                 "multi_output_tree does not support monotone constraints "
                 "or the dart booster (the reference rejects both for "
                 "vector-leaf trees)")
-        if self.learner_params.get("hist_method") == "coarse" and (
-                tm in ("approx", "exact")
-                or ms == "multi_output_tree"):
+        if self.learner_params.get("hist_method") in ("coarse", "fused") \
+                and (tm in ("approx", "exact")
+                     or ms == "multi_output_tree"):
             raise NotImplementedError(
-                "hist_method='coarse' supports the hist updaters "
+                "hist_method='coarse'/'fused' supports the hist updaters "
                 "(depthwise or lossguide, resident or external-memory "
                 "depthwise) with scalar trees only")
         dsm = self.learner_params.get("data_split_mode", "row")
@@ -544,11 +544,15 @@ class Booster:
             margin = jnp.asarray(self._broadcast_base_margin(dm, n))
             self._store_cache(key, binned, margin, is_train, dm, dm.info, n)
         elif is_train and self.ctx.mesh is None and not getattr(
-                dm, "presharded", False) and tm not in ("approx", "exact"):
+                dm, "presharded", False):
             # a communicator activated AFTER the entry was built (training
             # continuation on a persistent booster) must still refuse
             # silently-local resident training — including a matrix the
-            # paged collapse already swapped for a resident one
+            # paged collapse already swapped for a resident one. approx/
+            # exact entries carry binned=None, so is_paged resolves False
+            # and the same check refuses them too (the build-time path at
+            # the approx/exact branch above already did — the re-check
+            # must protect the same set of methods)
             self._check_row_comm_sync(paged=getattr(
                 self._caches[key]["binned"], "is_paged", False))
         return self._caches[key]

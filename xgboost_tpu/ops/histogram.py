@@ -163,9 +163,9 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                n_nodes: int, max_nbins: int, method: str = "auto",
                block_rows: int = 1 << 16,
                bins_t: jnp.ndarray = None, axis_name=None) -> jnp.ndarray:
-    if method == "coarse":
+    if method in ("coarse", "fused"):
         raise ValueError(
-            "hist_method='coarse' runs inside the depthwise scalar "
+            f"hist_method='{method}' runs inside the depthwise scalar "
             "growers only (tree/grow.py resident, tree/paged.py external "
             "memory); this code path (lossguide / vector-leaf / vertical) "
             "does not support it")
@@ -232,6 +232,97 @@ def build_hist_multi(bins: jnp.ndarray, gpair3: jnp.ndarray,
         [build_hist(bins, gpair3[:, k], rel_pos, n_nodes, max_nbins,
                     method=method, bins_t=bins_t) for k in range(K)],
         axis=3)
+
+
+# ---- cross-level fused sweep (hist_method="fused") -------------------------
+# The two-level coarse->refine scheme has a hard dependency chain
+# (coarse_L -> window_L -> refine_L -> splits_L -> positions_{L+1} ->
+# coarse_{L+1}), so its bit-exact floor is TWO data sweeps per level:
+# {refine_L} and {advance past splits_L + coarse_{L+1}}. The unfused
+# resident path pays THREE streams (a [n, F] u8 coarse-id copy, the bin
+# matrix for the refine, and a persistent 4-byte [n, F] f32 copy for the
+# advance matmul); this op collapses the advance and the next level's
+# coarse accumulation into ONE read of the bin tile — the same fusion the
+# paged tier's adv_hist body has used since round 5 — and computes both
+# the f32 advance operand and the coarse ids in-trace, so neither copy is
+# ever materialised in HBM.
+
+def fused_advance_coarse(bins: jnp.ndarray, gpair: jnp.ndarray,
+                         positions: jnp.ndarray, prev: dict, lo: int,
+                         n_level: int, missing_bin: int, *,
+                         bins_t: jnp.ndarray = None, method: str = "auto",
+                         axis_name=None, decision_axis=None,
+                         interpret: bool = False):
+    """One sweep at the level boundary: advance rows below the PREVIOUS
+    level's decoded splits, then accumulate the NEW level's coarse
+    histogram from the same tile read.
+
+    ``prev``: the previous level's split payload — ``kind`` ("dense" for
+    the matmul advance over per-level vectors, "walk" for the deep-level
+    per-row gather walk over full tree arrays), ``lo``, ``n_level``,
+    ``arrs``, and optionally ``feat_offset`` (column split walk) — the
+    same convention as ``tree/paged.py``. Returns
+    ``(new_positions, coarse_hist [n_level, F, COARSE_B, 2])``.
+
+    Bit-exactness with the two-pass coarse path: the advance is pure
+    integer routing (identical ops to ``advance_positions_level`` /
+    ``update_positions``), and the coarse build runs the same kernel on
+    the same quantities — the fused Pallas variant keeps the unfused
+    kernel's block shapes and accumulation order, so the histograms are
+    bit-identical, level by level.
+    """
+    from .partition import advance_positions_level, update_positions
+    from .split import COARSE_B, coarse_bin_ids
+
+    kind = prev["kind"]
+    lo_prev, nl_prev = prev["lo"], prev["n_level"]
+    # The single-HBM-read Pallas kernel: TPU, dense advance, no cross-shard
+    # decision exchange (col split routes through the XLA body's psum), and
+    # the whole-F [F, COARSE_B, 2N] accumulator must fit the VMEM budget
+    # the unfused int8x2 kernel uses — outside these bounds the XLA body
+    # below is the fused path (one jit: XLA still elides the f32/coarse-id
+    # copies, it just cannot guarantee the single tile read).
+    F = bins.shape[1]
+    use_pallas = (jax.default_backend() == "tpu"
+                  and method in ("auto", "pallas")
+                  and decision_axis is None and kind == "dense"
+                  and nl_prev <= 64 and n_level <= 128
+                  and F * COARSE_B * 2 * n_level * 4 <= 8 * 2 ** 20)
+    if use_pallas or interpret:
+        from .pallas.histogram import fused_advance_coarse_pallas
+
+        feat, thr, dleft, cs = prev["arrs"]
+        if bins_t is None:
+            bins_t = bins.T
+        return fused_advance_coarse_pallas(
+            bins_t, gpair, positions, feat, thr, dleft, cs,
+            lo_prev=lo_prev, n_prev=nl_prev, lo=lo, n_level=n_level,
+            missing_bin=missing_bin, axis_name=axis_name,
+            interpret=interpret)
+    if kind == "dense":
+        feat, thr, dleft, cs = prev["arrs"]
+        rel_prev = jnp.where(
+            (positions >= lo_prev) & (positions < lo_prev + nl_prev),
+            positions - lo_prev, nl_prev).astype(jnp.int32)
+        # f32 operand computed IN the trace: XLA fuses the upcast into the
+        # matmul read — no materialised [n, F] f32 copy
+        positions = advance_positions_level(
+            bins.astype(jnp.float32), positions, rel_prev, feat, thr,
+            dleft, cs, missing_bin, decision_axis=decision_axis)
+    else:
+        sf, sb, dl, isf = prev["arrs"]
+        positions = update_positions(
+            bins, positions, sf, sb, dl, isf, missing_bin,
+            decision_axis=decision_axis,
+            feat_offset=prev.get("feat_offset"))
+    rel = jnp.where((positions >= lo) & (positions < lo + n_level),
+                    positions - lo, n_level).astype(jnp.int32)
+    cb = coarse_bin_ids(bins.astype(jnp.int32), missing_bin)
+    cb_t = (None if bins_t is None
+            else coarse_bin_ids(bins_t.astype(jnp.int32), missing_bin))
+    hist = build_hist(cb, gpair, rel, n_level, COARSE_B, method=method,
+                      bins_t=cb_t, axis_name=axis_name)
+    return positions, hist
 
 
 def subtract_siblings(parent_hist: jnp.ndarray, child_hist: jnp.ndarray,

@@ -194,6 +194,165 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
     return kernel
 
 
+def _make_fused_kernel(n_feat: int, n_prev: int, n_nodes: int,
+                       block_rows: int, lo_prev: int, lo: int,
+                       missing_bin: int, coarse_b: int, shift: int):
+    """Cross-level fused sweep (hist_method="fused"): ONE read of the
+    ``[F, R]`` bin tile per row block drives (a) the row-position advance
+    below the previous level's decoded splits, (b) the coarse-id remap
+    ``bins >> shift`` for the NEW level, and (c) the packed-SWAR one-hot +
+    int8 MXU contraction of the new level's coarse histogram. The unfused
+    two-pass path reads the tile once for the advance and once (as a
+    materialised coarse-id copy) for the coarse build; here both consumers
+    share the VMEM-resident tile, halving the boundary's HBM traffic.
+
+    The previous level's split payload arrives as a ``[4, n_prev]`` int32
+    SMEM block (safe feature id, threshold bin, default_left, can_split);
+    each previous node's split-feature row is pulled from the tile with
+    one dynamic sublane slice — n_prev <= 64, so this is a short scalar
+    loop, not a gather.
+
+    Histogram math is IDENTICAL to ``_make_int8_kernel(packed=True)`` at
+    ``B = coarse_b``: same per-feature loop, same PT4 node-scatter, same
+    per-row-block f32 accumulation order — the fused coarse histogram is
+    bit-identical to the unfused one."""
+    B, N, R, F = coarse_b, n_nodes, block_rows, n_feat
+
+    def kernel(split_ref, bins_ref, q_ref, pos_ref, hist_ref, pos_out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            hist_ref[:] = jnp.zeros_like(hist_ref)
+
+        # ---- advance: route rows below the previous level's splits ----
+        pos_row = pos_ref[:]                               # [1, R] i32
+        rel_prev = jnp.where(
+            (pos_row >= lo_prev) & (pos_row < lo_prev + n_prev),
+            pos_row - lo_prev, n_prev)
+        new_pos = pos_row
+        for j in range(n_prev):
+            fj = split_ref[0, j]
+            tj = split_ref[1, j]
+            dj = split_ref[2, j]
+            cj = split_ref[3, j]
+            bj = bins_ref[pl.ds(fj, 1), :].astype(jnp.int32)   # [1, R]
+            gr = jnp.where(bj == missing_bin, dj == 0, bj > tj)
+            child = 2 * pos_row + 1 + gr.astype(jnp.int32)
+            new_pos = jnp.where((rel_prev == j) & (cj > 0), child, new_pos)
+        pos_out_ref[:] = new_pos
+        rel = jnp.where((new_pos >= lo) & (new_pos < lo + N),
+                        new_pos - lo, N)                   # [1, R]
+
+        # ---- coarse histogram of the NEW level from the same tile ----
+        node_iota = jax.lax.broadcasted_iota(jnp.int32, (N, R), 0)
+        on_node = rel == node_iota                         # [N, R] bool
+        zero = jnp.zeros((N, R), jnp.int32)
+
+        def planes(row):                                   # [1, R] i32
+            PTq = jnp.where(on_node, jnp.broadcast_to(row, (N, R)), zero)
+            hi = (PTq + 128) >> 8                          # round-to-nearest
+            lo_b = PTq - hi * 256                          # in [-128, 127]
+            return hi.astype(jnp.int8), lo_b.astype(jnp.int8)
+
+        g_hi, g_lo = planes(q_ref[0:1, :])
+        h_hi, h_lo = planes(q_ref[1:2, :])
+        PT4 = jnp.concatenate([g_hi, h_hi, g_lo, h_lo], axis=0)  # [4N, R]
+
+        w_iota = jax.lax.broadcasted_iota(jnp.uint32, (B // 4, R), 0)
+        K4 = (w_iota * jnp.uint32(4) * jnp.uint32(0x01010101)
+              + jnp.uint32(0x03020100))
+        M7F = jnp.uint32(0x7F7F7F7F)
+        for f in range(F):
+            row = bins_ref[f:f + 1, :].astype(jnp.int32)   # [1, R]
+            cb = jnp.where(row == missing_bin, B - 1, row >> shift)
+            x = K4 ^ (cb.astype(jnp.uint32) * jnp.uint32(0x01010101))
+            y = (~(((x & M7F) + M7F) | x | M7F)) >> jnp.uint32(7)
+            oh = pltpu.bitcast(y, jnp.int8)                # [B, R]
+            acc4 = jax.lax.dot_general(
+                oh, PT4, _CONTRACT_LAST,
+                preferred_element_type=jnp.int32)          # [B, 4N]
+            acc = (acc4[:, : 2 * N].astype(jnp.float32) * 256.0
+                   + acc4[:, 2 * N:].astype(jnp.float32))
+            hist_ref[f] += acc
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lo_prev", "n_prev", "lo", "n_level", "missing_bin",
+                     "block_rows", "interpret", "axis_name"))
+def fused_advance_coarse_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
+                                positions: jnp.ndarray, feat: jnp.ndarray,
+                                thr: jnp.ndarray, dleft: jnp.ndarray,
+                                can_split: jnp.ndarray, *, lo_prev: int,
+                                n_prev: int, lo: int, n_level: int,
+                                missing_bin: int, block_rows: int = 2048,
+                                axis_name=None, interpret: bool = False):
+    """Single-HBM-read advance + coarse build (see ``_make_fused_kernel``).
+
+    bins_t: [F, n] fine bin ids; gpair: [n, 2] f32; positions: [n] heap
+    node ids; feat/thr/dleft/can_split: [n_prev] previous-level split
+    vectors (feat == -1 on non-split slots).
+    -> (new_positions [n] int32, hist [n_level, F, COARSE_B, 2] f32)
+    """
+    from ..split import COARSE_B, COARSE_SPAN
+
+    F, n = bins_t.shape
+    B, N = COARSE_B, n_level
+    shift = COARSE_SPAN.bit_length() - 1
+
+    R = min(block_rows, max(_round_up(n, 128), 128))
+    n_pad = _round_up(max(n, R), R)
+    if n_pad != n:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad - n)))
+        gpair = jnp.pad(gpair, ((0, n_pad - n), (0, 0)))
+        # pad positions OUTSIDE every level: inactive for both the advance
+        # and the new level's histogram (their quantised gpair is 0 anyway)
+        positions = jnp.pad(positions, (0, n_pad - n), constant_values=-1)
+
+    # identical 15-bit fixed-point quantisation to build_hist_pallas's
+    # int8x2 path (global per-component scale, pmax'd across row shards)
+    gpair_t = gpair.T                                    # [2, n]
+    max_abs = jnp.max(jnp.abs(gpair_t), axis=1)
+    if axis_name is not None:
+        max_abs = jax.lax.pmax(max_abs, axis_name)
+    scale = 32512.0 / jnp.maximum(max_abs, 1e-30)
+    q = jnp.round(gpair_t * scale[:, None]).astype(jnp.int32)
+    pos_t = positions.astype(jnp.int32)[None, :]         # [1, n]
+    splits = jnp.stack([jnp.maximum(feat, 0).astype(jnp.int32),
+                        thr.astype(jnp.int32),
+                        dleft.astype(jnp.int32),
+                        can_split.astype(jnp.int32)])    # [4, n_prev]
+
+    grid = (n_pad // R,)
+    hist, pos_out = pl.pallas_call(
+        _make_fused_kernel(F, n_prev, N, R, lo_prev, lo, missing_bin, B,
+                           shift),
+        out_shape=[jax.ShapeDtypeStruct((F, B, 2 * N), jnp.float32),
+                   jax.ShapeDtypeStruct((1, n_pad), jnp.int32)],
+        grid=grid,
+        in_specs=[pl.BlockSpec((4, n_prev), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((F, R), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((2, R), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, R), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((F, B, 2 * N), lambda i: (0, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, R), lambda i: (0, i),
+                                memory_space=pltpu.VMEM)],
+        interpret=interpret,
+    )(splits, bins_t, q, pos_t)
+    inv = jnp.repeat(1.0 / scale, N)[None, None, :]      # [1, 1, 2N]
+    hist = hist * inv
+    gh = hist.reshape(F, B, 2, N)
+    return pos_out[0, :n], gh.transpose(3, 0, 1, 2)      # [N, F, B, 2]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("n_nodes", "max_nbins", "precision", "block_rows",

@@ -6,6 +6,25 @@ extensible component (``XGBOOST_REGISTER_OBJECTIVE`` et al.; see reference
 Here a registry is a plain dict from name -> factory, populated by decorators, so
 objectives / metrics / updaters / boosters / predictors stay pluggable by string
 name exactly like the reference's ``dmlc::Registry``.
+
+Default population (``import xgboost_tpu`` guarantees all of it — the package
+``__init__`` imports every registering module):
+
+- ``OBJECTIVES`` / ``METRICS`` — ``objective/``, ``metric/`` modules.
+- ``BOOSTERS`` — ``gbtree``, ``dart``, ``gblinear`` (``boosting/``).
+- ``TREE_UPDATERS`` — ``grow_quantile_histmaker`` (aliases ``grow_gpu_hist``,
+  ``grow_histmaker`` — approx re-sketches then drives the same histmaker) ->
+  ``tree.grow.TreeGrower``; ``grow_colmaker`` (alias ``exact``) ->
+  ``tree.exact.ExactGrower``; ``prune`` / ``refresh`` / ``sync`` ->
+  ``tree.updaters``. The lossguide/paged/multi growers are selected by
+  ``grow_policy`` / matrix type off these same entry points, mirroring the
+  reference where one updater name serves several drivers.
+- ``PREDICTORS`` — ``tpu_predictor`` (aliases ``cpu_predictor``,
+  ``gpu_predictor``, ``auto``) -> ``boosting.predict.ForestPredictor``.
+- ``LINEAR_UPDATERS`` — ``shotgun`` / ``coord_descent``
+  (``boosting.gblinear``); ``GBLinear.do_boost`` dispatches through this
+  registry, so registering a new name makes it reachable via the
+  ``updater`` param.
 """
 
 from __future__ import annotations

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.partition import update_positions
+from ..registry import TREE_UPDATERS
 from .param import TrainParam, calc_gain, calc_weight
 from .grow import GrownTree
 
@@ -178,6 +179,7 @@ def _grow_exact(ranks: jnp.ndarray, gpair: jnp.ndarray,
                      base_weight=base_weight)
 
 
+@TREE_UPDATERS.register("grow_colmaker", "exact")
 class ExactGrower:
     """Drop-in grower for ``tree_method=exact`` (numerical features only)."""
 

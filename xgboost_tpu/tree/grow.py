@@ -25,10 +25,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..context import shard_map as _shard_map
 from ..ops.histogram import (build_hist, build_hist_prehot,
-                             build_onehot_plane, subtract_siblings)
+                             build_onehot_plane, fused_advance_coarse,
+                             subtract_siblings)
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import CatInfo, evaluate_splits
+from ..registry import TREE_UPDATERS
 from .param import TrainParam, calc_weight
 from .tree import TreeModel
 
@@ -290,16 +293,28 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     # it measured faster (TPU, numeric, wide bins, enough rows) — the
     # eval-set validation table in docs/performance.md is the quality
     # justification. All sizes below the thresholds keep the exact kernel.
-    use_coarse = hist_kernel == "coarse"
+    use_coarse = hist_kernel in ("coarse", "fused")
     if hist_kernel == "auto":
         use_coarse = auto_selects_coarse(
             n, max_nbins, has_missing, numeric=cat is None,
             col_split=col_split)
+    # Round 6: the cross-level FUSED sweep is a rescheduling of the coarse
+    # scheme, not a new search space — per level boundary the row advance
+    # below level L's decoded splits and level L+1's coarse accumulation
+    # share one read of the bin tile (ops/histogram.py
+    # fused_advance_coarse), where the unfused path streams a persistent
+    # [n, F] f32 copy for the advance matmul plus the coarse-id copy.
+    # Bit-exact with "coarse" (tests/test_fused_hist.py), so "auto"
+    # promotes straight to the fused scheduling wherever it promoted to
+    # coarse; explicit "coarse" keeps the two-pass scheduling so the A/B
+    # stays measurable.
+    use_fused = hist_kernel == "fused" or (hist_kernel == "auto"
+                                           and use_coarse)
     if use_coarse:
         if cat is not None or max_nbins > 256 + int(has_missing):
             raise NotImplementedError(
-                "hist_method='coarse' supports numeric features and "
-                "max_bin <= 256")
+                f"hist_method='{hist_kernel}' supports numeric features "
+                "and max_bin <= 256")
         # col split composes: the scheme is feature-local end to end
         # (coarse hist, window choice, refine, assembly all run on this
         # shard's features over replicated rows; the existing best-split
@@ -313,19 +328,35 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         cb_t = coarse_bin_ids(bins_t.astype(jnp.int32), missing_bin)
         cb = cb_t.T
 
+    pending_adv = None  # fused: splits awaiting the next boundary sweep
     for depth in range(max_depth):
         lo = 2 ** depth - 1
         n_level = 2 ** depth
         idx = lo + jnp.arange(n_level)
+
+        hist_c = None
+        if use_fused and pending_adv is not None:
+            # cross-level fused sweep: advance rows below the previous
+            # level's decoded splits AND build this level's coarse
+            # histogram from the same bin-tile read
+            row_axis = axis_name if not col_split else None
+            positions, hist_c = fused_advance_coarse(
+                bins, gpair, positions, pending_adv, lo, n_level,
+                missing_bin, bins_t=bins_t, method="auto",
+                axis_name=row_axis,
+                decision_axis=axis_name if col_split else None)
+            hist_c = allreduce(hist_c)
+            pending_adv = None
 
         in_level = (positions >= lo) & (positions < lo + n_level)
         rel = jnp.where(in_level, positions - lo, n_level).astype(jnp.int32)
         span = None
         if use_coarse:
             row_axis = axis_name if not col_split else None
-            hist_c = allreduce(build_hist(cb, gpair, rel, n_level, 20,
-                                          method="auto", bins_t=cb_t,
-                                          axis_name=row_axis))
+            if hist_c is None:
+                hist_c = allreduce(build_hist(cb, gpair, rel, n_level, 20,
+                                              method="auto", bins_t=cb_t,
+                                              axis_name=row_axis))
             span = choose_refine_window(hist_c,
                                         node_sum[lo:lo + n_level],
                                         n_real_bins, param,
@@ -497,7 +528,30 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             delta = delta + jnp.sum(
                 jnp.where(rel_oh, w_level[None, :], 0.0), axis=1)
 
-        if col_split and n_level <= DENSE_LEVEL_MAX:
+        if use_fused:
+            # defer this level's advance to the NEXT boundary's fused
+            # sweep; categorical args never arise (coarse is numeric-only)
+            if col_split and n_level <= DENSE_LEVEL_MAX:
+                pending_adv = {
+                    "kind": "dense", "lo": lo, "n_level": n_level,
+                    "arrs": (jnp.where(can_split & mine, local_feat, -1),
+                             jnp.where(can_split & mine, local_bin, 0),
+                             can_split & mine & local_dl, can_split)}
+            elif n_level <= DENSE_LEVEL_MAX:
+                pending_adv = {
+                    "kind": "dense", "lo": lo, "n_level": n_level,
+                    "arrs": (jnp.where(can_split, res.feature, -1),
+                             jnp.where(can_split, res.bin, 0),
+                             can_split & res.default_left, can_split)}
+            else:  # deep level: the boundary sweep runs the gather walk
+                is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(
+                    can_split)
+                pending_adv = {
+                    "kind": "walk", "lo": lo, "n_level": n_level,
+                    "arrs": (split_feature, split_bin, default_left,
+                             is_split_full),
+                    "feat_offset": feat_off}
+        elif col_split and n_level <= DENSE_LEVEL_MAX:
             # only the owning shard can route rows at each node; its local
             # decisions reach every shard through one boolean psum (the
             # reference's partition-bitvector broadcast). Categorical
@@ -547,6 +601,25 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                 valid.astype(jnp.int32), jnp.where(valid, cn, n_next),
                 num_segments=n_next + 1)[:n_next]
             built_is_left = counts[0::2] <= counts[1::2]
+
+    if use_fused and pending_adv is not None:
+        # epilogue: route rows below the deepest level's splits — advance
+        # only, there is no next coarse pass left to fuse with
+        if pending_adv["kind"] == "dense":
+            lo_p, nl_p = pending_adv["lo"], pending_adv["n_level"]
+            feat_v, bin_v, dl_v, cs_v = pending_adv["arrs"]
+            rel_p = jnp.where(
+                (positions >= lo_p) & (positions < lo_p + nl_p),
+                positions - lo_p, nl_p).astype(jnp.int32)
+            positions = advance_positions_level(
+                bins.astype(jnp.float32), positions, rel_p, feat_v, bin_v,
+                dl_v, cs_v, missing_bin,
+                decision_axis=axis_name if col_split else None)
+        else:
+            positions = update_positions(
+                bins, positions, *pending_adv["arrs"], missing_bin,
+                decision_axis=axis_name if col_split else None,
+                feat_offset=feat_off)
 
     w = calc_weight(node_sum[:, 0], node_sum[:, 1], param)
     if monotone is not None:
@@ -648,6 +721,8 @@ def monotone_child_bounds_host(ls: np.ndarray, rs: np.ndarray,
     return (l_lo, l_hi), (r_lo, r_hi)
 
 
+@TREE_UPDATERS.register("grow_quantile_histmaker", "grow_gpu_hist",
+                        "grow_histmaker")
 class TreeGrower:
     """Host-side wrapper: sampling keys, colsample_bytree, device->TreeModel.
 
@@ -802,7 +877,7 @@ class TreeGrower:
             # col mode: outputs ARE replicated (every split field passes
             # through a psum / all_gather), but the static replication
             # checker cannot prove it through the owner-shard select chain
-            self._sharded_fn = jax.jit(jax.shard_map(
+            self._sharded_fn = jax.jit(_shard_map(
                 inner, mesh=self.mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..context import shard_map as _shard_map
 from ..ops.histogram import build_hist
 from ..ops.partition import cat_goes_right
 from ..ops.split import CatInfo, evaluate_splits
@@ -155,6 +156,51 @@ def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
     return res
 
 
+def _apply_eval2(bins, gpair, positions, nid, feat_a, sbin_a, dleft_a,
+                 iscat_a, words_a, left_id, right_id, mb, parent_sums,
+                 fmask, node_lower, node_upper, n_real_bins, bins_t, cb_t,
+                 monotone, cat, *, param: TrainParam, max_nbins: int,
+                 hist_method: str, axis_name: Optional[str],
+                 has_missing: bool = True, coarse: bool = False):
+    """Cross-level fusion, lossguide form (hist_method="fused"): the popped
+    node's one-column row advance and its fresh children's histogram +
+    enumeration run as ONE jitted program — the greedy loop's two
+    dispatches per split become one. Against a remote device the per-split
+    dispatch RTT is the lossguide tier's dominant fixed cost
+    (docs/performance.md round 5), and XLA additionally fuses the advance's
+    column read into the same program as the coarse pass. Numerics are the
+    sequential apply1 -> eval2 composition, op for op — bit-exact."""
+    positions = _apply1(bins, positions, nid, feat_a, sbin_a, dleft_a,
+                        iscat_a, words_a, left_id, right_id, mb)
+    res = _eval2(bins, gpair, positions, left_id, right_id, parent_sums,
+                 fmask, node_lower, node_upper, n_real_bins, bins_t, cb_t,
+                 monotone, cat, param=param, max_nbins=max_nbins,
+                 hist_method=hist_method, axis_name=axis_name,
+                 has_missing=has_missing, coarse=coarse)
+    return positions, res
+
+
+def _apply_eval2_col(bins, gpair, positions, nid, feat_a, sbin_a, dleft_a,
+                     iscat_a, words_a, left_id, right_id, mb, parent_sums,
+                     fmask, node_lower, node_upper, n_real_bins, bins_t,
+                     cb_t, monotone, cat, *, param: TrainParam,
+                     max_nbins: int, hist_method: str, axis_name: str,
+                     has_missing: bool = True, coarse: bool = False):
+    """Column-split ``_apply_eval2``: the owner-decision advance
+    (``_apply1_col``) and the feature-local eval + winner exchange
+    (``_eval2_col``) composed into one program."""
+    positions = _apply1_col(bins, positions, nid, feat_a, sbin_a, dleft_a,
+                            iscat_a, words_a, left_id, right_id, mb,
+                            axis_name=axis_name)
+    res = _eval2_col(bins, gpair, positions, left_id, right_id,
+                     parent_sums, fmask, node_lower, node_upper,
+                     n_real_bins, bins_t, cb_t, monotone, cat, param=param,
+                     max_nbins=max_nbins, hist_method=hist_method,
+                     axis_name=axis_name, has_missing=has_missing,
+                     coarse=coarse)
+    return positions, res
+
+
 def _apply1_col(bins, positions, nid, feat, sbin, dleft, is_cat, words,
                 left_id, right_id, missing_bin, *, axis_name: str):
     """One-node advance under column split: only the shard owning the
@@ -208,11 +254,18 @@ def _root_sum(gpair, axis_name: Optional[str]):
     return jax.lax.psum(s, axis_name) if axis_name is not None else s
 
 
-def col_masks(param: TrainParam, seed: int, F: int):
+def col_masks(param: TrainParam, seed: int, F: int,
+              base: Optional[np.ndarray] = None):
     """bytree mask + per-depth / per-node draw helpers (reference
     ColumnSampler nesting, src/common/random.h:123; same seed on every
     rank like the broadcast at updater_gpu_hist.cu:786-789). Shared by the
-    scalar and vector-leaf lossguide growers."""
+    scalar and vector-leaf lossguide growers.
+
+    ``base``: bool [F] of sampleable columns (``n_real_bins > 0``). Under
+    mesh column split the feature axis pads to a multiple of the mesh
+    width; padding columns must not consume colsample draws, or sampling
+    diverges from the single-device run whenever F % world != 0 (the
+    depthwise TreeGrower already excludes them — ADVICE r5 #2)."""
     rng = np.random.RandomState(seed & 0x7FFFFFFF)
 
     def draw(base: np.ndarray, frac: float) -> np.ndarray:
@@ -225,7 +278,8 @@ def col_masks(param: TrainParam, seed: int, F: int):
         out[keep] = True
         return out
 
-    tree_mask = draw(np.ones(F, bool), param.colsample_bytree)
+    tree_mask = draw(np.ones(F, bool) if base is None
+                     else np.asarray(base, bool), param.colsample_bytree)
     level_cache = {}
 
     def node_mask(depth: int) -> np.ndarray:
@@ -282,13 +336,18 @@ class LossguideGrower:
             if base_hm.endswith(_sfx):
                 base_hm = base_hm[: -len(_sfx)]
         self._base_hm = base_hm
-        if base_hm == "coarse" and (
+        if base_hm in ("coarse", "fused") and (
                 self.cat is not None
                 or max_nbins > 256 + int(has_missing)):
             raise NotImplementedError(
-                "hist_method='coarse' with grow_policy=lossguide "
+                f"hist_method='{base_hm}' with grow_policy=lossguide "
                 "supports numeric features and max_bin <= 256")
         self._coarse = None
+        # cross-level fused dispatch (apply + child eval as ONE program):
+        # decided with _coarse at first grow — "fused" forces it, "auto"
+        # promotes it alongside the coarse promotion (bit-exact with the
+        # two-dispatch schedule; tests/test_fused_hist.py)
+        self._fused = None
         if split_mode == "col":
             # bins pad the feature axis to a multiple of the mesh width;
             # the replicated GLOBAL constraint/cat arrays must match so
@@ -326,10 +385,14 @@ class LossguideGrower:
             ev = functools.partial(_eval2, monotone=self.monotone,
                                    cat=self.cat, axis_name=None,
                                    coarse=bool(self._coarse), **kw)
+            ae = functools.partial(_apply_eval2, monotone=self.monotone,
+                                   cat=self.cat, axis_name=None,
+                                   coarse=bool(self._coarse), **kw)
             self._fns = (jax.jit(ev), jax.jit(_apply1),
                          jax.jit(functools.partial(_root_sum,
                                                    axis_name=None)),
-                         jax.jit(lambda lv, pos: lv[pos]))
+                         jax.jit(lambda lv, pos: lv[pos]),
+                         jax.jit(ae) if self._fused else None)
         elif self.split_mode == "col":
             from ..context import DATA_AXIS
             P = jax.sharding.PartitionSpec
@@ -344,23 +407,36 @@ class LossguideGrower:
             # on features when the coarse scheme is active, else it is
             # the None placeholder (empty pytree, spec unused).
             cb_spec = P(DATA_AXIS, None) if self._coarse else P()
-            sharded_eval = jax.jit(jax.shard_map(
+            sharded_eval = jax.jit(_shard_map(
                 ev, mesh=self.mesh,
                 in_specs=(P(None, DATA_AXIS), P(), P(), P(), P(), P(),
                           P(None, DATA_AXIS), P(), P(), P(DATA_AXIS),
                           P(DATA_AXIS, None), cb_spec),
                 out_specs=P(), check_vma=False))
-            sharded_apply = jax.jit(jax.shard_map(
+            sharded_apply = jax.jit(_shard_map(
                 functools.partial(_apply1_col, axis_name=DATA_AXIS),
                 mesh=self.mesh,
                 in_specs=(P(None, DATA_AXIS), P()) + (P(),) * 9,
                 out_specs=P(), check_vma=False))
+            sharded_ae = None
+            if self._fused:
+                ae = functools.partial(_apply_eval2_col,
+                                       monotone=self.monotone,
+                                       cat=self.cat, axis_name=DATA_AXIS,
+                                       coarse=bool(self._coarse), **kw)
+                sharded_ae = jax.jit(_shard_map(
+                    ae, mesh=self.mesh,
+                    in_specs=(P(None, DATA_AXIS), P(), P())
+                    + (P(),) * 9
+                    + (P(), P(None, DATA_AXIS), P(), P(), P(DATA_AXIS),
+                       P(DATA_AXIS, None), cb_spec),
+                    out_specs=(P(), P()), check_vma=False))
             # rows replicate: a local sum IS the global root sum, and the
             # leaf gather runs on replicated arrays
             sharded_root = jax.jit(lambda g: jnp.sum(g, axis=0))
             sharded_gather = jax.jit(lambda lv, pos: lv[pos])
             self._fns = (sharded_eval, sharded_apply, sharded_root,
-                         sharded_gather)
+                         sharded_gather, sharded_ae)
             return self._fns
         else:
             from ..context import DATA_AXIS
@@ -370,26 +446,38 @@ class LossguideGrower:
                                    cat=self.cat, axis_name=DATA_AXIS,
                                    coarse=bool(self._coarse), **kw)
             # SplitResult is a flat NamedTuple of replicated arrays
-            sharded_eval = jax.jit(jax.shard_map(
+            sharded_eval = jax.jit(_shard_map(
                 ev, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
                           P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(),
                           P(None, DATA_AXIS), P(None, DATA_AXIS)),
                 out_specs=P()))
-            sharded_apply = jax.jit(jax.shard_map(
+            sharded_apply = jax.jit(_shard_map(
                 _apply1, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P(), P(),
                           P(), P(), P(), P(), P(), P()),
                 out_specs=P(DATA_AXIS)))
-            sharded_root = jax.jit(jax.shard_map(
+            sharded_ae = None
+            if self._fused:
+                ae = functools.partial(_apply_eval2, monotone=self.monotone,
+                                       cat=self.cat, axis_name=DATA_AXIS,
+                                       coarse=bool(self._coarse), **kw)
+                sharded_ae = jax.jit(_shard_map(
+                    ae, mesh=self.mesh,
+                    in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
+                              P(DATA_AXIS)) + (P(),) * 9
+                    + (P(), P(), P(), P(), P(), P(None, DATA_AXIS),
+                       P(None, DATA_AXIS)),
+                    out_specs=(P(DATA_AXIS), P())))
+            sharded_root = jax.jit(_shard_map(
                 functools.partial(_root_sum, axis_name=DATA_AXIS),
                 mesh=self.mesh, in_specs=(P(DATA_AXIS, None),),
                 out_specs=P()))
-            sharded_gather = jax.jit(jax.shard_map(
+            sharded_gather = jax.jit(_shard_map(
                 lambda lv, pos: lv[pos], mesh=self.mesh,
                 in_specs=(P(), P(DATA_AXIS)), out_specs=P(DATA_AXIS)))
             self._fns = (sharded_eval, sharded_apply, sharded_root,
-                         sharded_gather)
+                         sharded_gather, sharded_ae)
         return self._fns
 
     def _init_positions(self, n: int) -> jnp.ndarray:
@@ -409,8 +497,9 @@ class LossguideGrower:
         return self.cuts.split_values(sf, sb)
 
     # ------------------------------------------------------------- sampling
-    def _col_masks(self, seed: int, F: int):
-        return col_masks(self.param, seed, F)
+    def _col_masks(self, seed: int, F: int,
+                   base: Optional[np.ndarray] = None):
+        return col_masks(self.param, seed, F, base)
 
     def _allowed(self, path: np.ndarray) -> np.ndarray:
         """Interaction-constraint feature mask for a node with feature-path
@@ -440,18 +529,32 @@ class LossguideGrower:
             world = (1 if self.mesh is None
                      else self.mesh.shape.get(DATA_AXIS, 1))
             n_local = n if self.split_mode == "col" else n // max(world, 1)
-            self._coarse = self._base_hm == "coarse" or (
+            self._coarse = self._base_hm in ("coarse", "fused") or (
                 self._base_hm == "auto" and self.split_mode == "row"
                 and auto_selects_coarse(
                     n_local, self.max_nbins, self.has_missing,
                     numeric=self.cat is None, col_split=False))
-        eval2, apply1, root_sum_fn, gather = self._functions()
+            # the fused (one-dispatch apply+eval) schedule rides with the
+            # coarse promotion — bit-exact, so "auto" takes it wherever
+            # it took coarse; explicit "coarse" keeps the two-dispatch
+            # schedule measurable on its own
+            self._fused = self._base_hm == "fused" or (
+                self._base_hm == "auto" and self._coarse)
+        fns = self._functions()
+        eval2, apply1, root_sum_fn, gather = fns[:4]
+        apply_eval = fns[4] if len(fns) > 4 else None
         try:
             seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1])
         except (TypeError, ValueError):
             seed = int(np.asarray(key).ravel()[-1])
         F = self._feature_width(F)  # global width under vertical federated
-        node_mask = self._col_masks(seed, F)
+        # colsample draws come from REAL columns only (padded mesh-col-split
+        # columns have n_real == 0); the vertical-federated subclass widens
+        # F past the local n_real_bins — its padding-free layout keeps the
+        # all-ones base
+        nr = np.asarray(n_real_bins)
+        node_mask = self._col_masks(
+            seed, F, (nr > 0) if nr.shape[0] == F else None)
 
         # host-side node arrays (compact ids in allocation order)
         sf = np.full(cap, -1, np.int32)
@@ -488,17 +591,22 @@ class LossguideGrower:
         counter = 0
         pq: list = []   # (-gain, timestamp, nid, split payload)
 
-        def eval_nodes(id0: int, id1: int) -> None:
+        def eval_nodes(id0: int, id1: int, apply_args=None) -> None:
             """Evaluate candidate splits of one or two sibling nodes and
-            push the valid ones onto the priority queue."""
-            nonlocal counter
+            push the valid ones onto the priority queue. ``apply_args``:
+            the just-popped parent's split payload — under the fused
+            schedule its one-node row advance runs in the SAME dispatch as
+            the children's evaluation (the children are the advance's own
+            outputs), falling back to a separate apply1 dispatch when the
+            children are depth-filtered out of evaluation."""
+            nonlocal counter, positions
             ids = [i for i in (id0, id1) if i >= 0]
-            if not ids:
-                return
             if param.max_depth > 0:
                 ids = [i for i in ids if depth_of[i] < param.max_depth]
-                if not ids:
-                    return
+            if not ids:
+                if apply_args is not None:
+                    positions = apply1(bins, positions, *apply_args)
+                return
             i0 = ids[0]
             i1 = ids[1] if len(ids) > 1 else -1
             fm = np.stack([node_mask(int(depth_of[i])) if i >= 0
@@ -509,15 +617,24 @@ class LossguideGrower:
                     fm[1] &= self._allowed(paths[i1])
             psums = np.stack([gh[i0], gh[i1] if i1 >= 0
                               else np.zeros(2)]).astype(np.float32)
-            res = eval2(bins, gpair, positions, np.int32(i0), np.int32(i1),
-                        jnp.asarray(psums), jnp.asarray(fm),
-                        jnp.asarray(np.asarray([lower[i0],
-                                                lower[i1 if i1 >= 0 else 0]],
-                                               np.float32)),
-                        jnp.asarray(np.asarray([upper[i0],
-                                                upper[i1 if i1 >= 0 else 0]],
-                                               np.float32)),
-                        n_real_bins, bins_t, cb_t)
+            lowers = jnp.asarray(np.asarray(
+                [lower[i0], lower[i1 if i1 >= 0 else 0]], np.float32))
+            uppers = jnp.asarray(np.asarray(
+                [upper[i0], upper[i1 if i1 >= 0 else 0]], np.float32))
+            if apply_args is not None and apply_eval is not None:
+                # siblings share a depth, so the filter kept both: i0/i1
+                # ARE the advance's fresh children
+                positions, res = apply_eval(
+                    bins, gpair, positions, *apply_args,
+                    jnp.asarray(psums), jnp.asarray(fm), lowers, uppers,
+                    n_real_bins, bins_t, cb_t)
+            else:
+                if apply_args is not None:
+                    positions = apply1(bins, positions, *apply_args)
+                res = eval2(bins, gpair, positions, np.int32(i0),
+                            np.int32(i1), jnp.asarray(psums),
+                            jnp.asarray(fm), lowers, uppers,
+                            n_real_bins, bins_t, cb_t)
             # ONE packed device->host pull for the whole SplitResult —
             # a per-field np.asarray costs 8 blocking round trips per
             # split against a remote-device tunnel
@@ -580,13 +697,12 @@ class LossguideGrower:
                 child_path = paths[nid].copy()
                 child_path[feat] = True
                 paths[li] = paths[ri] = child_path
-            positions = apply1(
-                bins, positions, np.int32(nid), np.int32(feat),
-                np.int32(rbin), np.bool_(rdl), np.bool_(ric),
-                jnp.asarray(cwords[nid]), np.int32(li), np.int32(ri),
+            eval_nodes(li, ri, apply_args=(
+                np.int32(nid), np.int32(feat), np.int32(rbin),
+                np.bool_(rdl), np.bool_(ric), jnp.asarray(cwords[nid]),
+                np.int32(li), np.int32(ri),
                 np.int32(self.max_nbins - 1 if self.has_missing
-                         else self.max_nbins))
-            eval_nodes(li, ri)
+                         else self.max_nbins)))
 
         # ---- finalize: weights, leaf values, TreeModel -----------------
         w = calc_weight(gh[:n_nodes, 0].astype(np.float32),

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..context import shard_map as _shard_map
 from ..ops.histogram import build_hist_multi
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits_multi
@@ -526,7 +527,7 @@ class MultiTargetGrower:
                 pos = jax.lax.fori_loop(0, max_depth, body, pos)
                 return pos, lv[pos]
 
-            self._repark_fn = jax.jit(jax.shard_map(
+            self._repark_fn = jax.jit(_shard_map(
                 repark, mesh=self.mesh,
                 in_specs=(P(DATA_AXIS), P(), P()),
                 out_specs=(P(DATA_AXIS), P(DATA_AXIS, None))))
@@ -568,7 +569,7 @@ class MultiTargetGrower:
                     gain=P(), positions=P(DATA_AXIS),
                     delta=P(DATA_AXIS, None), base_weight=P())
                 check_vma = True
-            self._sharded_fn = jax.jit(jax.shard_map(
+            self._sharded_fn = jax.jit(_shard_map(
                 inner, mesh=self.mesh,
                 in_specs=in_specs, out_specs=out_specs,
                 check_vma=check_vma))
@@ -705,13 +706,13 @@ class MultiLossguideGrower:
 
                 ev = functools.partial(_eval2_multi_col,
                                        axis_name=DATA_AXIS, **kw)
-                sharded_eval = jax.jit(jax.shard_map(
+                sharded_eval = jax.jit(_shard_map(
                     ev, mesh=self.mesh,
                     in_specs=(P(None, DATA_AXIS), P(), P(), P(), P(),
                               P(), P(None, DATA_AXIS), P(DATA_AXIS),
                               P(DATA_AXIS, None)),
                     out_specs=P(), check_vma=False))
-                sharded_apply = jax.jit(jax.shard_map(
+                sharded_apply = jax.jit(_shard_map(
                     functools.partial(_apply1_col, axis_name=DATA_AXIS),
                     mesh=self.mesh,
                     in_specs=(P(None, DATA_AXIS), P()) + (P(),) * 9,
@@ -731,22 +732,22 @@ class MultiLossguideGrower:
 
                 ev = functools.partial(_eval2_multi, axis_name=DATA_AXIS,
                                        **kw)
-                sharded_eval = jax.jit(jax.shard_map(
+                sharded_eval = jax.jit(_shard_map(
                     ev, mesh=self.mesh,
                     in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None, None),
                               P(DATA_AXIS), P(), P(), P(), P(), P(),
                               P(None, DATA_AXIS)),
                     out_specs=P()))
-                sharded_apply = jax.jit(jax.shard_map(
+                sharded_apply = jax.jit(_shard_map(
                     _apply1, mesh=self.mesh,
                     in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
                               P(), P(), P(), P(), P(), P(), P()),
                     out_specs=P(DATA_AXIS)))
-                sharded_root = jax.jit(jax.shard_map(
+                sharded_root = jax.jit(_shard_map(
                     functools.partial(_root_sum, axis_name=DATA_AXIS),
                     mesh=self.mesh,
                     in_specs=(P(DATA_AXIS, None, None),), out_specs=P()))
-                sharded_gather = jax.jit(jax.shard_map(
+                sharded_gather = jax.jit(_shard_map(
                     lambda lv, pos: lv[pos], mesh=self.mesh,
                     in_specs=(P(), P(DATA_AXIS)),
                     out_specs=P(DATA_AXIS, None)))
@@ -775,7 +776,11 @@ class MultiLossguideGrower:
             seed = int(np.asarray(jax.random.key_data(key)).ravel()[-1])
         except (TypeError, ValueError):
             seed = int(np.asarray(key).ravel()[-1])
-        node_mask = col_masks(param, seed, F)
+        # seed colsample draws from real columns only — padded mesh-col-split
+        # columns (n_real == 0) must not consume draws (ADVICE r5 #2)
+        nr = np.asarray(n_real_bins)
+        node_mask = col_masks(param, seed, F,
+                              (nr > 0) if nr.shape[0] == F else None)
 
         sf = np.full(cap, -1, np.int32)
         sb = np.zeros(cap, np.int32)

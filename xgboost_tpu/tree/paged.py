@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..context import shard_map as _shard_map
 from ..ops.histogram import build_hist
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits
@@ -66,10 +67,12 @@ def _make_kernels(grower):
     missing_bin = (grower.max_nbins - 1 if grower.has_missing
                    else grower.max_nbins)
     method = _strip_hist_suffix(grower.hist_method)
-    if method == "coarse" or getattr(grower, "_coarse", False):
+    if method in ("coarse", "fused") or getattr(grower, "_coarse", False):
         # two-level scheme: the coarse/refine page passes are plain
         # narrow-width builds — let the per-backend auto selection pick
-        # their kernel
+        # their kernel. "fused" names the cross-level fused sweep, which
+        # the paged tier's adv_hist body has been structurally since r5
+        # (advance + next coarse in one page read) — same machinery.
         method = "auto"
     if grower.mesh is not None:
         return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
@@ -747,8 +750,17 @@ class _MeshPageKernels:
         """Mesh twin of ``_PageKernels._drive``: one fused shard_map
         dispatch over every HBM-cached page, then the prefetch ring for
         the overflow — the per-page dispatch RTT is the same tax on every
-        tier. ``body(carry, page, s_loc, consts)`` is shard-local."""
+        tier. ``body(carry, page, s_loc, consts)`` is shard-local.
+
+        Carry donation is skipped on the CPU backend: XLA:CPU aborts
+        executing donated shard_map programs under the 8-virtual-device
+        test platform (jax 0.4.x; deterministic — the page loop of the
+        uneven-rows paged-mesh test dies inside the runtime, not in
+        trace/compile). Donation only saves an HBM copy of the carry on
+        real accelerators, so CPU keeps the copy and its stability."""
         P = jax.sharding.PartitionSpec
+        donate = ({} if jax.default_backend() == "cpu"
+                  else {"donate_argnums": 0})
         page_spec = P(self.axis, None)
         cached, streamed = paged.cached_split_mesh(self.world)
         if cached:
@@ -760,10 +772,10 @@ class _MeshPageKernels:
                         carry = body(carry, page, st, consts)
                     return carry
 
-                return jax.jit(jax.shard_map(
+                return jax.jit(_shard_map(
                     fn, mesh=self.mesh,
                     in_specs=(carry_spec, consts_spec, P(), page_spec),
-                    out_specs=carry_spec), donate_argnums=0)
+                    out_specs=carry_spec), **donate)
 
             fused = self._cached(key + ("fused",), build_fused)
             carry = fused(carry, consts,
@@ -772,12 +784,12 @@ class _MeshPageKernels:
         if streamed:
             def build_single():
                 body = make_body()
-                return jax.jit(jax.shard_map(
+                return jax.jit(_shard_map(
                     lambda carry, page, s, consts:
                     body(carry, page, s, consts),
                     mesh=self.mesh,
                     in_specs=(carry_spec, page_spec, P(), consts_spec),
-                    out_specs=carry_spec), donate_argnums=0)
+                    out_specs=carry_spec), **donate)
 
             single = self._cached(key + ("single",), build_single)
             for s_loc, page in paged.stream_pages_sharded(
@@ -821,7 +833,7 @@ class _MeshPageKernels:
             return body
 
         def build_fin():
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
                 in_specs=(acc_spec,), out_specs=P()))
 
@@ -921,7 +933,7 @@ class _MeshPageKernels:
             return body
 
         def build_fin():
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
                 in_specs=(acc_spec,), out_specs=P()))
 
@@ -1086,11 +1098,11 @@ class PagedGrower(TreeGrower):
             from .grow import auto_selects_coarse
 
             base = _strip_hist_suffix(self.hist_method)
-            if base == "coarse" and (
+            if base in ("coarse", "fused") and (
                     self.cat is not None
                     or self.max_nbins > 256 + int(self.has_missing)):
                 raise NotImplementedError(
-                    "hist_method='coarse' supports numeric features and "
+                    f"hist_method='{base}' supports numeric features and "
                     "max_bin <= 256")
             # the promotion threshold is LOCAL rows per shard (the
             # measured crossover is per-device work); on the mesh tier
@@ -1101,7 +1113,9 @@ class PagedGrower(TreeGrower):
                 n_local = n // self.mesh.shape.get(DATA_AXIS, 1)
             else:
                 n_local = n
-            self._coarse = base == "coarse" or (
+            # "fused" selects the same two-level scheme: the advance +
+            # coarse page pass has been one fused body here since r5
+            self._coarse = base in ("coarse", "fused") or (
                 base == "auto" and auto_selects_coarse(
                     n_local, self.max_nbins, self.has_missing,
                     numeric=self.cat is None, col_split=False))
@@ -1284,12 +1298,13 @@ class PagedLossguideGrower(LossguideGrower):
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing)
-        if self._base_hm == "coarse":
+        if self._base_hm in ("coarse", "fused"):
             raise NotImplementedError(
-                "hist_method='coarse' with grow_policy=lossguide runs on "
-                "resident matrices only (the paged per-split kernels use "
-                "the one-pass build)")
+                f"hist_method='{self._base_hm}' with grow_policy="
+                "lossguide runs on resident matrices only (the paged "
+                "per-split kernels use the one-pass build)")
         self._coarse = False  # page kernels ignore the resident auto rule
+        self._fused = False   # per-split page loops stay two-dispatch
         self.mesh = mesh
         self._mk: Optional[_MeshPageKernels] = None
 
@@ -1515,12 +1530,12 @@ class PagedMultiLossguideGrower(MultiLossguideGrower):
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, has_missing=has_missing,
                          constraint_sets=constraint_sets)
-        if _strip_hist_suffix(hist_method) == "coarse":
+        if _strip_hist_suffix(hist_method) in ("coarse", "fused"):
             # same contract as the scalar PagedLossguideGrower (and the
-            # core guard already rejects coarse for vector leaves)
+            # core guard already rejects coarse/fused for vector leaves)
             raise NotImplementedError(
-                "hist_method='coarse' with grow_policy=lossguide runs on "
-                "resident matrices only")
+                "hist_method='coarse'/'fused' with grow_policy=lossguide "
+                "runs on resident matrices only")
         self.mesh = mesh
         self._mk = None
 

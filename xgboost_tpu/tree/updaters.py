@@ -13,10 +13,12 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..registry import TREE_UPDATERS
 from .param import TrainParam
 from .tree import TreeModel
 
 
+@TREE_UPDATERS.register("prune")
 def prune_tree(tree: TreeModel, param: TrainParam) -> TreeModel:
     """Recursively turn split nodes with ``gain < min_split_loss`` (and only
     leaf children) into leaves — the reference's ``TreePruner::DoPrune``.
@@ -86,6 +88,7 @@ def route_rows(tree: TreeModel, X: np.ndarray) -> np.ndarray:
     return pos
 
 
+@TREE_UPDATERS.register("refresh")
 def refresh_tree(tree: TreeModel, X: np.ndarray, gpair: np.ndarray,
                  param: TrainParam, refresh_leaf: bool = True) -> TreeModel:
     """Recompute node stats (cover) and optionally leaf values of an existing
@@ -110,6 +113,7 @@ def refresh_tree(tree: TreeModel, X: np.ndarray, gpair: np.ndarray,
     return tree
 
 
+@TREE_UPDATERS.register("sync")
 def sync_trees(trees: List[TreeModel], communicator=None) -> List[TreeModel]:
     """Broadcast trees from rank 0 (reference ``TreeSyncher``). Under the
     single-controller JAX model all hosts hold identical trees by

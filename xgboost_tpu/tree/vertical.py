@@ -384,11 +384,12 @@ class VerticalLossguideGrower(LossguideGrower):
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing, split_mode="row")
-        if self._base_hm == "coarse":
+        if self._base_hm in ("coarse", "fused"):
             raise NotImplementedError(
-                "hist_method='coarse' requires row split (vertical "
-                "federated is column split)")
+                f"hist_method='{self._base_hm}' requires row split "
+                "(vertical federated is column split)")
         self._coarse = False  # host eval path uses the one-pass build
+        self._fused = False   # federated apply/eval exchange per step
         self.split_mode = "col"
         self.comm = collective.get_communicator()
         self._f_offset: Optional[int] = None
