@@ -361,6 +361,20 @@ def main():
             bench_dart_multiclass(), 3)
     if os.environ.get("BENCH_RANK", "1") != "0":
         result["rank_unbiased_rounds_per_sec"] = bench_rank_unbiased()
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        # inference-serving SLOs (tools/bench_serve.py): open-loop mixed
+        # 1/8/64/512-row workload through the micro-batcher; the four
+        # serve_* headline keys ride in the same scored JSON line
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from bench_serve import run_bench as _serve_bench
+
+        for k, v in _serve_bench(
+                n_requests=int(os.environ.get("BENCH_SERVE_REQS", 400)),
+                target_qps=float(os.environ.get("BENCH_SERVE_QPS", 200)),
+        ).items():
+            if k.startswith("serve_"):
+                result[k] = v
     print(json.dumps(result))
     print(f"# auc={auc:.4f} baseline(sklearn-hist)={base_rps:.3f} rounds/s",
           file=sys.stderr)

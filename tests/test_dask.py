@@ -100,3 +100,91 @@ def test_real_dask_local_cluster():
     np.testing.assert_allclose(preds,
                                single.predict(xgb.DMatrix(X)),
                                rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------ ranking
+
+def _make_rank_data(n=1200, f=6, groups=24, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    score = X @ rng.randn(f).astype(np.float32)
+    y = np.digitize(score, np.quantile(score, [0.6, 0.85, 0.95])
+                    ).astype(np.float32)
+    qid = np.repeat(np.arange(groups), n // groups)
+    return X, y, qid
+
+
+def test_ranker_qid_partition_alignment():
+    X, y, qid = _make_rank_data()
+    # 5 parts x 240 rows over 50-row groups: groups straddle partitions
+    qparts = np.array_split(qid, 5)
+    with pytest.raises(ValueError, match="spans partitions"):
+        dxgb._check_qid_partition_alignment(qparts)
+    parts, (yparts, wparts), q2 = dxgb._repartition_by_group(
+        np.array_split(X, 5), [np.array_split(y, 5), None], qparts, 5)
+    dxgb._check_qid_partition_alignment(q2)  # aligned now
+    assert sum(len(p) for p in parts) == len(X)
+    assert wparts is None and len(parts) == len(q2) == len(yparts) == 5
+    # every group is whole within exactly one partition
+    for q in q2:
+        assert np.all(q[1:] >= q[:-1])
+    # unsorted qid rejected (the reference DaskXGBRanker contract)
+    with pytest.raises(ValueError, match="sorted"):
+        dxgb._repartition_by_group(
+            np.array_split(X, 2), [None], np.array_split(qid[::-1], 2), 2)
+
+
+@pytest.mark.slow
+def test_dask_ranker_two_workers_matches_single_ndcg():
+    """Two real worker processes train rank:ndcg on group-aligned shards;
+    the lambda gradient is group-local, so whole-group placement makes
+    the distributed model match single-process training — asserted on
+    predictions and on the eval ndcg (VERDICT 'Next round' #10)."""
+    X, y, qid = _make_rank_data()
+    params = {"max_depth": 3, "eta": 0.3, "max_bin": 64}
+    client = dxgb.LocalProcessClient(n_workers=2)
+    rk = dxgb.DaskXGBRanker(client=client, n_estimators=3, **params)
+    # deliberately misaligned 4-way split: fit() must repartition
+    rk.fit(np.array_split(X, 4), np.array_split(y, 4),
+           qid=np.array_split(qid, 4))
+    single = xgb.train({"objective": "rank:ndcg", **params},
+                       xgb.DMatrix(X, label=y, qid=qid), 3,
+                       verbose_eval=False)
+    dm = xgb.DMatrix(X, label=y, qid=qid)
+    np.testing.assert_allclose(rk.predict([X]), single.predict(dm),
+                               rtol=1e-5, atol=1e-6)
+
+    def ndcg_of(bst):
+        line = bst.eval(dm)
+        return float(line.split("ndcg:")[-1].split()[0])
+
+    assert abs(ndcg_of(rk.get_booster()) - ndcg_of(single)) < 1e-6
+
+
+def test_sharded_qid_local_gradient_matches_single():
+    """The multi-process ranking plumbing in-process: a ShardedDMatrix
+    built WITH qid routes gradients through the shard-local group path
+    (ShardedDMatrix.local_gradient — the core.update branch the 2-worker
+    test exercises across real processes) and must reproduce plain
+    DMatrix training exactly."""
+    import jax
+
+    from xgboost_tpu.parallel.launch import ShardedDMatrix
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (virtual) platform")
+    X, y, qid = _make_rank_data(n=800, groups=16)
+    mesh = xgb.make_data_mesh()
+    params = {"objective": "rank:ndcg", "max_depth": 3, "eta": 0.3,
+              "max_bin": 64}
+    sdm = ShardedDMatrix(X, label=y, qid=qid, mesh=mesh, max_bin=64)
+    assert sdm.local_group_ptr is not None
+    b_sh = xgb.train({**params, "mesh": mesh}, sdm, 3, verbose_eval=False)
+    b_1p = xgb.train(params, xgb.DMatrix(X, label=y, qid=qid), 3,
+                     verbose_eval=False)
+    dm = xgb.DMatrix(X)
+    np.testing.assert_allclose(b_sh.predict(dm), b_1p.predict(dm),
+                               rtol=1e-5, atol=1e-6)
+    # unsorted / misaligned qid is rejected at ingestion
+    with pytest.raises(ValueError, match="sorted"):
+        ShardedDMatrix(X, label=y, qid=qid[::-1], mesh=mesh, max_bin=64)

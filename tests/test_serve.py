@@ -1,0 +1,404 @@
+"""Inference-serving subsystem (xgboost_tpu/serve): bit-exact parity with
+Booster.predict across every bucket shape (padding never leaks), zero XLA
+recompiles after warmup, deadline/backpressure robustness under an
+injected slow predictor, atomic model hot-swap mid-stream, graceful
+drain, and the CLI/HTTP frontends."""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.serve import (BucketLadder, DeadlineExceeded, ServeClient,
+                               ServeConfig, Server, ServerClosed,
+                               ServerOverloaded, UnknownModel)
+
+BUCKETS = (1, 4, 16, 64)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(500, 8).astype(np.float32)
+    X[rng.rand(500, 8) < 0.1] = np.nan  # missing rows exercise default dirs
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    return xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                      "eta": 0.3}, xgb.DMatrix(X, label=y), 6,
+                     verbose_eval=False)
+
+
+def _server(booster, **kw):
+    cfg = dict(max_batch=64, buckets=BUCKETS, max_delay_ms=1.0)
+    cfg.update(kw)
+    srv = Server(models={"m": booster}, config=ServeConfig(**cfg))
+    srv.warmup()
+    return srv
+
+
+def _slow_model(srv, name="m", delay=0.25):
+    """Inject latency into the served model's device step (fault
+    injection for deadline/backpressure tests)."""
+    sm = srv.registry.get(name)
+    orig = sm.margin_padded
+
+    def slow(Xd):
+        time.sleep(delay)
+        return orig(Xd)
+
+    sm.margin_padded = slow
+    return sm
+
+
+# ------------------------------------------------------------------ ladder
+
+def test_bucket_ladder():
+    lad = BucketLadder.pow2(512)
+    assert lad.sizes == (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+    assert lad.bucket_for(3) == 4 and lad.bucket_for(512) == 512
+    assert lad.bucket_for(9000) == 512
+    assert lad.chunks(1100) == [512, 512, 76]
+    assert BucketLadder((64, 1, 8)).sizes == (1, 8, 64)
+    padded = lad.pad(np.ones((3, 2), np.float32), 8)
+    assert padded.shape == (8, 2) and padded[3:].sum() == 0
+    with pytest.raises(ValueError):
+        lad.pad(np.ones((9, 2), np.float32), 8)
+    with pytest.raises(ValueError):
+        BucketLadder(())
+
+
+# ------------------------------------------------------------------ parity
+
+def test_served_parity_bit_exact_all_buckets(data, booster):
+    """Served scores must be BIT-identical to Booster.predict() for every
+    bucket — including sizes that pad (2, 3, 5, ...) and oversize
+    requests that chunk across several dispatches."""
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X))
+    oracle_m = booster.predict(xgb.DMatrix(X), output_margin=True)
+    srv = _server(booster)
+    try:
+        sizes = [1, 2, 3, 4, 5, 15, 16, 17, 63, 64, 65, 200, 500]
+        for n in sizes:
+            got = srv.predict(X[:n])
+            np.testing.assert_array_equal(np.asarray(got), oracle[:n])
+            gm = srv.predict(X[:n], output="margin")
+            np.testing.assert_array_equal(np.asarray(gm), oracle_m[:n])
+        # identity rides on the result
+        r = srv.predict(X[:2])
+        assert (r.model, r.version) == ("m", 1)
+    finally:
+        srv.close()
+
+
+def test_served_parity_multiclass(data):
+    """Softprob transform is row-wise: pad rows cannot leak through the
+    [n, K] output either."""
+    X, _ = data
+    rng = np.random.RandomState(0)
+    yk = rng.randint(0, 3, len(X)).astype(np.float32)
+    bst = xgb.train({"objective": "multi:softprob", "num_class": 3,
+                     "max_depth": 3}, xgb.DMatrix(X, label=yk), 3,
+                    verbose_eval=False)
+    oracle = bst.predict(xgb.DMatrix(X))
+    srv = _server(bst)
+    try:
+        for n in (1, 3, 17, 64, 100):
+            np.testing.assert_array_equal(
+                np.asarray(srv.predict(X[:n])), oracle[:n])
+    finally:
+        srv.close()
+
+
+def test_micro_batch_coalescing_parity(data, booster):
+    """Concurrent submits coalesce into shared device batches; every
+    request still gets exactly its own rows back."""
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X))
+    srv = _server(booster, max_delay_ms=5.0)
+    client = ServeClient(srv)
+    try:
+        chunks = [X[i:i + w] for i, w in
+                  zip(range(0, 400, 40), (1, 3, 7, 12, 5, 2, 9, 40, 1, 6))]
+        outs = client.predict_many(chunks)
+        for (i, w), out in zip(zip(range(0, 400, 40),
+                                   (1, 3, 7, 12, 5, 2, 9, 40, 1, 6)), outs):
+            np.testing.assert_array_equal(np.asarray(out), oracle[i:i + w])
+        assert srv.metrics.counters["batches"] <= len(chunks)
+    finally:
+        srv.close()
+
+
+# -------------------------------------------------------------- recompiles
+
+def test_zero_recompiles_after_warmup(data, booster):
+    X, _ = data
+    srv = _server(booster)
+    try:
+        # warmup compiled something, and the SLO counter starts clean
+        assert srv.metrics.counters["warmup_batches"] >= len(BUCKETS)
+        assert srv.recompiles_after_warmup == 0
+        for n in (1, 2, 3, 5, 8, 13, 16, 21, 34, 55, 64, 64, 100, 300):
+            srv.predict(X[:n])
+        assert srv.recompiles_after_warmup == 0, \
+            "mixed-size workload recompiled after warmup"
+        snap = srv.metrics_snapshot()
+        assert snap["recompiles_after_warmup"] == 0
+        # every dispatch landed on a ladder bucket
+        assert set(map(int, snap["bucket_hits"])) <= set(BUCKETS)
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------- robustness
+
+def test_deadline_exceeded_under_slow_predictor(data, booster):
+    X, _ = data
+    srv = _server(booster, max_delay_ms=0.5)
+    try:
+        _slow_model(srv, delay=0.3)
+        f_a = srv.submit(X[:4])          # occupies the dispatch thread
+        time.sleep(0.05)
+        f_b = srv.submit(X[:4], timeout_ms=50)   # expires while A runs
+        f_c = srv.submit(X[:4], timeout_ms=5000)  # survives
+        np.testing.assert_array_equal(
+            np.asarray(f_a.result(timeout=30)),
+            np.asarray(f_c.result(timeout=30)))
+        with pytest.raises(DeadlineExceeded):
+            f_b.result(timeout=30)
+        assert srv.metrics.counters["deadline_exceeded"] == 1
+    finally:
+        srv.close()
+
+
+def test_backpressure_sheds_not_oom(data, booster):
+    """With queue depth capped, excess submits raise ServerOverloaded
+    synchronously while admitted requests complete fine."""
+    X, _ = data
+    srv = _server(booster, max_delay_ms=0.5, max_queue_rows=24)
+    try:
+        _slow_model(srv, delay=0.25)
+        futures, sheds = [], 0
+        for _ in range(30):
+            try:
+                futures.append(srv.submit(X[:8]))
+            except ServerOverloaded:
+                sheds += 1
+        assert sheds > 0 and futures
+        oracle = None
+        for f in futures:
+            out = np.asarray(f.result(timeout=60))
+            oracle = out if oracle is None else oracle
+            np.testing.assert_array_equal(out, oracle)
+        assert srv.metrics.counters["sheds"] == sheds
+    finally:
+        srv.close()
+
+
+def test_graceful_drain_loses_no_requests(data, booster):
+    X, _ = data
+    srv = _server(booster, max_delay_ms=0.5, max_queue_rows=1 << 14)
+    _slow_model(srv, delay=0.05)
+    futures = [srv.submit(X[:3]) for _ in range(12)]
+    srv.close(drain=True)
+    assert all(f.done() for f in futures)
+    assert all(f.exception() is None for f in futures)
+    with pytest.raises(ServerClosed):
+        srv.submit(X[:1])
+
+
+def test_close_without_drain_fails_queued_typed(data, booster):
+    X, _ = data
+    srv = _server(booster, max_delay_ms=5.0)
+    _slow_model(srv, delay=0.2)
+    futures = [srv.submit(X[:2]) for _ in range(6)]
+    srv.close(drain=False)
+    # nothing hangs: every future resolves, each either served (was
+    # in-flight) or typed-failed — never silently dropped
+    states = [f.exception() for f in futures]
+    assert all(e is None or isinstance(e, ServerClosed) for e in states)
+    assert any(isinstance(e, ServerClosed) for e in states)
+
+
+def test_unknown_model_and_bad_input(data, booster):
+    X, _ = data
+    srv = _server(booster)
+    try:
+        with pytest.raises(UnknownModel):
+            srv.predict(X[:2], model="nope")
+        with pytest.raises(ValueError):
+            srv.predict(np.zeros((0, 8), np.float32))
+        with pytest.raises(ValueError):
+            srv.predict(X[:2], output="leaf")
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------- hot swap
+
+def test_model_hot_swap_mid_stream(data, booster):
+    """Swap under live traffic: every response must match the version it
+    reports, the swap is atomic (no half-loaded model), and post-swap
+    traffic serves v2."""
+    X, y = data
+    b2 = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                    "eta": 0.3}, xgb.DMatrix(X, label=y), 12,
+                   verbose_eval=False)
+    oracles = {1: booster.predict(xgb.DMatrix(X)),
+               2: b2.predict(xgb.DMatrix(X))}
+    srv = _server(booster)
+    errors = []
+    stop = threading.Event()
+
+    def stream():
+        rng = np.random.RandomState(0)
+        while not stop.is_set():
+            n = int(rng.randint(1, 30))
+            r = srv.predict(X[:n])
+            if r.version not in oracles or \
+                    not np.array_equal(np.asarray(r), oracles[r.version][:n]):
+                errors.append((r.version, n))
+
+    t = threading.Thread(target=stream)
+    t.start()
+    try:
+        time.sleep(0.15)
+        srv.swap_model("m", b2)
+        time.sleep(0.15)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    r = srv.predict(X[:5])
+    assert r.version == 2
+    np.testing.assert_array_equal(np.asarray(r), oracles[2][:5])
+    # planned swap warmup compiles don't count against the SLO
+    assert srv.recompiles_after_warmup == 0
+    assert srv.metrics.counters["swaps"] == 1
+    srv.close()
+
+
+def test_registry_load_unload(data, booster):
+    X, _ = data
+    srv = _server(booster)
+    try:
+        with pytest.raises(ValueError, match="already served"):
+            srv.load_model("m", booster)
+        srv.load_model("m2", booster)
+        with pytest.raises(UnknownModel):  # two models: name required
+            srv.predict(X[:2])
+        assert srv.predict(X[:2], model="m2").model == "m2"
+        srv.unload_model("m2")
+        np.testing.assert_array_equal(np.asarray(srv.predict(X[:2])),
+                                      np.asarray(srv.predict(X[:2],
+                                                             model="m")))
+    finally:
+        srv.close()
+
+
+# -------------------------------------------------------------- frontends
+
+def test_model_file_roundtrip_and_jsonl_frontend(tmp_path, data, booster):
+    """`xgboost_tpu serve model=...` end to end in-process: build from a
+    saved model file, score a jsonl stream, typed errors per line."""
+    from xgboost_tpu.serve.frontend import build_server, jsonl_loop
+
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X))
+    path = str(tmp_path / "m.json")
+    booster.save_model(path)
+    srv, front = build_server([f"model={path}", "max_batch=16",
+                               "buckets=1,4,16", "max_delay_ms=1"])
+    assert front == {}
+    try:
+        lines = [
+            json.dumps({"id": 1, "data": X[:3].tolist()}),
+            json.dumps({"id": 2, "data": X[:1].tolist(),
+                        "output": "margin"}),
+            json.dumps({"id": 3, "data": [[0.0] * 8], "model": "absent"}),
+            "not json at all",
+        ]
+        out = io.StringIO()
+        n = jsonl_loop(srv, io.StringIO("\n".join(lines) + "\n"), out)
+        recs = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert n == len(recs) == 4
+        np.testing.assert_allclose(recs[0]["predictions"], oracle[:3],
+                                   rtol=0, atol=0)
+        assert recs[0]["model"] == "default" and recs[0]["version"] == 1
+        assert recs[1]["id"] == 2
+        assert recs[2]["error_type"] == "UnknownModel"
+        assert recs[3]["error_type"] == "JSONDecodeError"
+    finally:
+        srv.close()
+
+
+def test_http_frontend(data, booster):
+    import urllib.error
+    import urllib.request
+
+    from xgboost_tpu.serve.frontend import make_http_server
+
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X))
+    srv = _server(booster)
+    httpd = make_http_server(srv, 0)  # ephemeral port
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/predict",
+            data=json.dumps({"data": X[:5].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        np.testing.assert_allclose(resp["predictions"], oracle[:5],
+                                   rtol=0, atol=0)
+        models = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models").read())
+        assert models[0]["name"] == "m"
+        met = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/metrics").read())
+        assert met["counters"]["requests"] >= 1
+        # typed error -> HTTP status mapping
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/predict",
+            data=json.dumps({"data": X[:1].tolist(),
+                             "model": "absent"}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad)
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+
+def test_cli_serve_dispatch(tmp_path, data, booster, monkeypatch):
+    """`python -m xgboost_tpu serve ...` routes through cli.main."""
+    from xgboost_tpu.cli import main as cli_main
+
+    X, _ = data
+    path = str(tmp_path / "m.ubj")
+    booster.save_model(path)
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO(json.dumps({"data": X[:2].tolist()})
+                                    + "\n"))
+    out = io.StringIO()
+    monkeypatch.setattr("sys.stdout", out)
+    assert cli_main(["serve", f"model={path}", "max_batch=4", "buckets=1,4",
+                     "silent=1"]) == 0
+    rec = json.loads(out.getvalue().splitlines()[0])
+    np.testing.assert_allclose(
+        rec["predictions"], booster.predict(xgb.DMatrix(X[:2])),
+        rtol=0, atol=0)
+    # bad config is a clean exit code, not a traceback
+    assert cli_main(["serve", "max_batch=4"]) == 1

@@ -1,0 +1,117 @@
+"""Serving-path benchmark: open-loop mixed-size workload against
+``xgboost_tpu.serve.Server``.
+
+Drives the micro-batcher the way production traffic would: request
+sizes drawn from a mixed distribution (1 / 8 / 64 / 512 rows —
+single-user lookups through bulk scoring), arrivals scheduled on a
+fixed OPEN-LOOP clock (submission times never wait for completions, so
+queueing delay is measured honestly instead of being absorbed by a
+closed loop's self-throttling). Emits ONE JSON line with the
+driver-scored keys:
+
+    serve_p50_ms, serve_p99_ms           e2e request latency
+    serve_qps                            completed requests / wall s
+    serve_recompiles_after_warmup        the zero-recompile SLO
+
+plus context keys (rows/s, shed/deadline counts, per-stage p99s).
+Runs on the CPU backend in-container; on the TPU the same script
+measures the real chip. Env knobs: BENCH_SERVE_REQS (default 400),
+BENCH_SERVE_QPS (target arrival rate, default 200), BENCH_SERVE_ROWS /
+BENCH_SERVE_COLS (train shape), BENCH_SERVE_MAX_BATCH (default 512).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+MIX = (1, 8, 64, 512)  # request sizes, drawn uniformly
+
+
+def run_bench(n_requests: int = 400, target_qps: float = 200.0,
+              train_rows: int = 20_000, n_cols: int = 16,
+              max_batch: int = 512, seed: int = 0) -> dict:
+    import xgboost_tpu as xgb
+    from xgboost_tpu.serve import ServeConfig, Server
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(train_rows, n_cols).astype(np.float32)
+    y = (X @ rng.randn(n_cols) > 0).astype(np.float32)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 6,
+                     "eta": 0.3}, xgb.DMatrix(X, label=y), 20,
+                    verbose_eval=False)
+
+    pool = rng.randn(max(MIX), n_cols).astype(np.float32)
+    sizes = rng.choice(MIX, size=n_requests)
+    server = Server(models={"bench": bst},
+                    config=ServeConfig(max_batch=max_batch,
+                                       max_delay_ms=2.0,
+                                       max_queue_rows=1 << 16))
+    server.warmup()
+
+    # open loop: request i is DUE at t0 + i/qps; latency runs from the
+    # due time, so schedule slip (a stalled server) is charged as latency
+    futures = []
+    t0 = time.perf_counter()
+    due = t0
+    shed = 0
+    for i, n in enumerate(sizes):
+        due = t0 + i / target_qps
+        now = time.perf_counter()
+        if due > now:
+            time.sleep(due - now)
+        try:
+            futures.append(server.submit(pool[: int(n)]))
+        except Exception:
+            shed += 1
+            futures.append(None)
+    done = 0
+    for f in futures:
+        if f is None:
+            continue
+        try:
+            f.result(timeout=120)
+            done += 1
+        except Exception:
+            pass
+    wall = time.perf_counter() - t0
+    server.close(drain=True)
+
+    snap = server.metrics_snapshot()
+    e2e = snap["stages"].get("e2e", {})
+    stages_p99 = {f"serve_{s}_p99_ms": v["p99_ms"]
+                  for s, v in snap["stages"].items() if s != "e2e"}
+    return {
+        "serve_p50_ms": e2e.get("p50_ms"),
+        "serve_p99_ms": e2e.get("p99_ms"),
+        "serve_qps": round(done / wall, 2),
+        "serve_recompiles_after_warmup": snap["recompiles_after_warmup"],
+        "serve_rows_per_sec": round(
+            snap["counters"].get("rows", 0) / wall, 1),
+        "serve_completed": done,
+        "serve_shed": shed + snap["counters"].get("sheds", 0),
+        "serve_deadline_exceeded": snap["counters"].get(
+            "deadline_exceeded", 0),
+        "serve_batches": snap["counters"].get("batches", 0),
+        **stages_p99,
+    }
+
+
+def main() -> None:
+    result = run_bench(
+        n_requests=int(os.environ.get("BENCH_SERVE_REQS", 400)),
+        target_qps=float(os.environ.get("BENCH_SERVE_QPS", 200)),
+        train_rows=int(os.environ.get("BENCH_SERVE_ROWS", 20_000)),
+        n_cols=int(os.environ.get("BENCH_SERVE_COLS", 16)),
+        max_batch=int(os.environ.get("BENCH_SERVE_MAX_BATCH", 512)))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

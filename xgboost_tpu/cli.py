@@ -12,6 +12,11 @@ booster parameters): ``task`` (train|dump|pred), ``data``, ``test:data``,
 ``save_period``, ``name_dump``, ``name_pred``, ``dump_format``,
 ``dump_stats``, ``fmap``, ``pred_margin``, ``iteration_begin``,
 ``iteration_end``, ``silent``.
+
+Beyond the reference tasks there is an inference-serving mode (no config
+file — key=value args only; see ``serve/frontend.py`` / docs/serving.md):
+
+    python -m xgboost_tpu serve model=PATH [http_port=8080] [key=value ...]
 """
 
 from __future__ import annotations
@@ -146,6 +151,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0 if argv else 1
+    if argv[0] == "serve":
+        from .serve.frontend import serve_main
+
+        return serve_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:  # command-line key=value overrides, last wins
         if "=" not in extra:

@@ -754,6 +754,16 @@ class Booster:
                     gpair = jnp.asarray(apply_with_labels(
                         lambda: np.asarray(self.obj.get_gradient(
                             margin, state["info"], iteration), np.float32)))
+                elif (getattr(state["dm"], "presharded", False)
+                      and getattr(state["dm"], "local_group_ptr", None)
+                      is not None):
+                    # sharded ingestion with ranking groups: the global
+                    # device_info carries no group structure; groups are
+                    # whole per process (train_per_host contract), so the
+                    # gradient is computed shard-locally and re-assembled
+                    # mesh-sharded (ShardedDMatrix.local_gradient)
+                    gpair = state["dm"].local_gradient(self.obj, margin,
+                                                       iteration)
                 else:
                     gpair = self.obj.get_gradient(margin, state["info"],
                                                   iteration)

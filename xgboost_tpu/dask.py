@@ -30,7 +30,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["DaskDMatrix", "DaskQuantileDMatrix", "LocalProcessClient",
-           "train", "predict", "DaskXGBRegressor", "DaskXGBClassifier"]
+           "train", "predict", "DaskXGBRegressor", "DaskXGBClassifier",
+           "DaskXGBRanker"]
 
 
 def _to_partitions(data: Any) -> List[Any]:
@@ -235,11 +236,30 @@ def _dispatched_train(params: Dict[str, Any], shard: Dict[str, list],
          if shard["label"] else None)
     w = (np.concatenate([np.asarray(p).reshape(-1) for p in shard["weight"]])
          if shard["weight"] else None)
+    q = (np.concatenate([np.asarray(p).reshape(-1) for p in shard["qid"]])
+         if shard["qid"] else None)
 
     with collective.CommunicatorContext():
         bst = launch.train_per_host(params, X, y, num_boost_round,
-                                    weight_local=w, **kwargs)
+                                    weight_local=w, qid_local=q, **kwargs)
     return bytes(bst.save_raw("json"))
+
+
+def _check_qid_partition_alignment(qid_parts: Sequence[Any]) -> None:
+    """Ranking shards must keep query groups WHOLE per worker: a group
+    split across partitions lands on different ranks under round-robin
+    placement and its lambda gradients silently lose pairs. qid is
+    globally sorted, so only ADJACENT partitions can share a group —
+    check the boundaries (``DaskXGBRanker`` repartitions on group
+    boundaries so its users never trip this)."""
+    for i in range(len(qid_parts) - 1):
+        a = np.asarray(qid_parts[i]).reshape(-1)
+        b = np.asarray(qid_parts[i + 1]).reshape(-1)
+        if a.size and b.size and a[-1] == b[0]:
+            raise ValueError(
+                f"query group {a[-1]!r} spans partitions {i} and {i + 1}; "
+                "repartition on group boundaries (DaskXGBRanker.fit does "
+                "this automatically)")
 
 
 def train(client: Any, params: Dict[str, Any], dtrain: DaskDMatrix,
@@ -249,6 +269,8 @@ def train(client: Any, params: Dict[str, Any], dtrain: DaskDMatrix,
     returns ``{"booster": Booster, "history": {}}``."""
     from .core import Booster
 
+    if dtrain.qid_parts:
+        _check_qid_partition_alignment(dtrain.qid_parts)
     addrs = _worker_addresses(client)
     world = min(max(len(addrs), 1), max(dtrain.num_partitions(), 1))
     shards = dtrain._worker_shards(world)
@@ -341,3 +363,66 @@ class DaskXGBClassifier(_DaskModelBase):
 
     def predict(self, X: Any) -> np.ndarray:
         return self.predict_proba(X).argmax(axis=1).astype(np.int32)
+
+
+def _repartition_by_group(parts: List[Any], aligned: List[List[Any]],
+                          qid_parts: List[Any],
+                          n_parts: int) -> Tuple[List[Any], List[List[Any]],
+                                                 List[Any]]:
+    """Re-split row partitions ON QUERY-GROUP BOUNDARIES: concatenate,
+    verify qid is globally sorted (the reference DaskXGBRanker demands
+    sorted qid too), then split GROUPS evenly across ``n_parts`` so no
+    group ever spans a partition — the alignment contract of the
+    distributed lambda gradient (train_per_host docstring).
+
+    ``aligned`` is a list of optional row-aligned companions (labels,
+    weights) re-split the same way."""
+    q = np.concatenate([np.asarray(p).reshape(-1) for p in qid_parts])
+    if np.any(q[1:] < q[:-1]):
+        raise ValueError("DaskXGBRanker requires globally sorted qid")
+    from .data.adapters import to_dense
+
+    X = np.concatenate([to_dense(p, np.nan)[0] for p in parts])
+    comp = [None if c is None else
+            np.concatenate([np.asarray(p).reshape(-1) for p in c])
+            for c in aligned]
+    starts = np.flatnonzero(np.r_[True, q[1:] != q[:-1]])   # group starts
+    n_parts = max(1, min(n_parts, len(starts)))
+    cut_groups = np.array_split(np.arange(len(starts)), n_parts)
+    bounds = [starts[g[0]] for g in cut_groups] + [len(q)]
+    slices = [slice(bounds[i], bounds[i + 1]) for i in range(n_parts)]
+    return ([X[s] for s in slices],
+            [None if c is None else [c[s] for s in slices] for c in comp],
+            [q[s] for s in slices])
+
+
+class DaskXGBRanker(_DaskModelBase):
+    """Learning-to-rank façade (reference ``DaskXGBRanker``,
+    dask.py:2051): qid-aware ``fit`` with automatic group-boundary
+    repartitioning, ``predict`` returns raw ranking scores."""
+
+    _objective = "rank:ndcg"
+
+    def __init__(self, *, client: Any = None, n_estimators: int = 100,
+                 objective: str = "rank:ndcg", **params: Any) -> None:
+        super().__init__(client=client, n_estimators=n_estimators, **params)
+        self._objective = objective
+
+    def fit(self, X: Any, y: Any, *, qid: Any,
+            sample_weight: Any = None) -> "DaskXGBRanker":
+        parts = _to_partitions(X)
+        yparts = _to_partitions(y)
+        wparts = _to_partitions(sample_weight) or None
+        qparts = _to_partitions(qid)
+        if len(qparts) != len(parts):
+            raise ValueError(
+                f"qid has {len(qparts)} partitions, data has {len(parts)}")
+        parts, (yparts, wparts), qparts = _repartition_by_group(
+            parts, [yparts, wparts], qparts, len(parts))
+        dtrain = DaskDMatrix(self.client, parts, yparts, weight=wparts,
+                             qid=qparts)
+        params = {"objective": self._objective, **self.params}
+        out = train(self.client, params, dtrain,
+                    num_boost_round=self.n_estimators)
+        self._booster = out["booster"]
+        return self

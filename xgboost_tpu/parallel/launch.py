@@ -106,12 +106,19 @@ def global_data_mesh():
 def train_per_host(params: Dict[str, Any], X_local: np.ndarray,
                    y_local: np.ndarray, num_boost_round: int = 10,
                    *, weight_local: Optional[np.ndarray] = None,
+                   qid_local: Optional[np.ndarray] = None,
                    mesh=None, **train_kwargs):
     """SPMD entry: every process passes its host-local row shard; rows are
     laid out onto the global mesh, and one model comes back on every process.
 
     For the single-process case this is exactly ``xgb.train`` on a mesh over
-    the local devices (which is what the driver's dry-run exercises)."""
+    the local devices (which is what the driver's dry-run exercises).
+
+    ``qid_local``: ranking query ids of the local rows. Query groups must
+    be WHOLE within a process (dask.py's ranker repartitions on group
+    boundaries to guarantee it) — lambda gradients couple only rows of
+    the same group, so group-local shards make the per-rank gradient
+    computation exact."""
     import jax
 
     from ..core import train
@@ -119,7 +126,8 @@ def train_per_host(params: Dict[str, Any], X_local: np.ndarray,
 
     mesh = mesh if mesh is not None else global_data_mesh()
     if jax.process_count() == 1:
-        dm = DMatrix(X_local, label=y_local, weight=weight_local)
+        dm = DMatrix(X_local, label=y_local, weight=weight_local,
+                     qid=qid_local)
         return train({**params, "mesh": mesh}, dm, num_boost_round,
                      **train_kwargs)
 
@@ -131,7 +139,7 @@ def train_per_host(params: Dict[str, Any], X_local: np.ndarray,
     # jax.make_array_from_process_local_data. No process ever materialises
     # the global feature matrix.
     dm = ShardedDMatrix(X_local, label=y_local, weight=weight_local,
-                        mesh=mesh,
+                        qid=qid_local, mesh=mesh,
                         max_bin=int(params.get("max_bin", 256)))
     return train({**params, "mesh": mesh}, dm, num_boost_round,
                  **train_kwargs)
@@ -153,7 +161,8 @@ class ShardedDMatrix(DMatrix):
     presharded = True
 
     def __init__(self, data: Any, label: Any = None, *,
-                 weight: Optional[np.ndarray] = None, mesh=None,
+                 weight: Optional[np.ndarray] = None,
+                 qid: Optional[np.ndarray] = None, mesh=None,
                  max_bin: int = 256,
                  comm: Optional[collective.Communicator] = None) -> None:
         import jax
@@ -173,6 +182,18 @@ class ShardedDMatrix(DMatrix):
         # host-local view: metrics/predict see only this shard
         self.X = X_local
         self.info = MetaInfo(labels=y, weights=w, data_split_mode="row")
+        if qid is not None:
+            # local ranking groups (whole per process — the caller's
+            # contract; train_per_host docstring). Metrics see local
+            # groups; gradients go through local_gradient() below.
+            qid = np.asarray(qid).reshape(-1)
+            if qid.shape[0] != n_local:
+                raise ValueError(
+                    f"qid has {qid.shape[0]} entries, expected {n_local}")
+            if np.any(qid[1:] < qid[:-1]):
+                raise ValueError("qid must be sorted within the shard")
+            _, counts = np.unique(qid, return_counts=True)
+            self.info.set_group(counts)
         self.info.validate(n_local)
         self.missing = np.nan
         self._n_local = n_local
@@ -259,9 +280,46 @@ class ShardedDMatrix(DMatrix):
     # device-side training views ------------------------------------------
     def device_info(self) -> MetaInfo:
         """MetaInfo whose label/weight leaves are global mesh-sharded
-        arrays (weight 0 on padded rows)."""
+        arrays (weight 0 on padded rows). Ranking group structure stays
+        HOST-LOCAL (``local_group_ptr``): groups are whole per process,
+        so group-coupled gradients are computed shard-locally
+        (``local_gradient``) instead of against this global view."""
         return MetaInfo(labels=self._labels_g, weights=self._weights_g,
                         data_split_mode="row")
+
+    @property
+    def local_group_ptr(self) -> Optional[np.ndarray]:
+        return self.info.group_ptr
+
+    def local_gradient(self, obj, margin, iteration: int):
+        """Global sharded gpair [n_global, K, 2] computed from LOCAL rows.
+
+        Objectives whose gradient couples rows only within a query group
+        (every ``rank:*`` lambda objective) are exact on group-whole
+        shards: pull this process's valid margin rows, run the
+        objective's own ``get_gradient`` against the local labels/
+        weights/group_ptr, zero-pad to the equal block, and re-assemble
+        the mesh-sharded global gradient. Padded rows carry zero
+        gradient, exactly like their zero weight in the histogram path.
+        The one device round trip per iteration is the cost of the
+        reference's per-worker gradient locality (dask.py keeps labels
+        and qids worker-local for the same reason)."""
+        import jax
+        import jax.numpy as jnp
+        import jax.sharding as jsh
+
+        from ..context import DATA_AXIS
+
+        local = np.asarray(self.local_rows(margin), np.float32)
+        gp = np.asarray(obj.get_gradient(jnp.asarray(local), self.info,
+                                         iteration), np.float32)
+        if gp.ndim == 2:
+            gp = gp[:, None, :]
+        block = np.zeros((self._n_block,) + gp.shape[1:], np.float32)
+        block[: self._n_local] = gp
+        sh = jsh.NamedSharding(
+            self._mesh, jsh.PartitionSpec(DATA_AXIS, *([None] * (gp.ndim - 1))))
+        return jax.make_array_from_process_local_data(sh, block)
 
     def global_binned(self):
         return self._binned_g

@@ -1,0 +1,35 @@
+"""``xgboost_tpu.serve`` — production inference serving.
+
+Turns the library predictor (``boosting/predict.py``) into a servable
+system: micro-batched request coalescing, bucketed-shape jit warmth
+(zero recompiles after warmup), a multi-model registry with atomic
+hot-swap, deadline/backpressure robustness, and per-stage latency SLO
+metrics. See docs/serving.md for the architecture and tuning guide.
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.serve import Server
+
+    with Server(models={"m": booster}, max_batch=512) as srv:
+        srv.warmup()
+        preds = srv.predict(X)          # == booster.predict(DMatrix(X))
+
+Frontends: ``python -m xgboost_tpu serve model=... [http_port=...]``
+(``serve.frontend``) and the in-process :class:`ServeClient`.
+"""
+
+from .buckets import BucketLadder, RecompileCounter
+from .client import ServeClient
+from .errors import (DeadlineExceeded, ServeError, ServerClosed,
+                     ServerOverloaded, UnknownModel)
+from .metrics import LatencyHistogram, ServeMetrics
+from .registry import ModelRegistry, ServedModel
+from .server import ServeConfig, Server
+
+__all__ = [
+    "Server", "ServeConfig", "ServeClient",
+    "BucketLadder", "RecompileCounter",
+    "ModelRegistry", "ServedModel",
+    "ServeMetrics", "LatencyHistogram",
+    "ServeError", "ServerOverloaded", "DeadlineExceeded",
+    "ServerClosed", "UnknownModel",
+]

@@ -1,0 +1,60 @@
+"""In-process serving client.
+
+The thin typed handle tests, ``tools/bench_serve.py`` and embedding
+applications use to talk to a :class:`~.server.Server` without going
+through a wire protocol: it pins a default model/output/timeout and
+exposes sync (``predict``), async (``submit`` -> Future) and batch
+(``predict_many``) calls. Concurrent submits from any number of
+threads coalesce in the server's micro-batcher — that is the whole
+point of submitting before waiting.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class ServeClient:
+    def __init__(self, server, model: Optional[str] = None, *,
+                 output: str = "value",
+                 timeout_ms: Optional[float] = None) -> None:
+        self.server = server
+        self.model = model
+        self.output = output
+        self.timeout_ms = timeout_ms
+
+    def _kw(self, output: Optional[str], timeout_ms) -> Dict[str, object]:
+        kw: Dict[str, object] = {"output": output or self.output}
+        if timeout_ms is not None:
+            kw["timeout_ms"] = timeout_ms
+        elif self.timeout_ms is not None:
+            kw["timeout_ms"] = self.timeout_ms
+        return kw
+
+    def submit(self, X, *, model: Optional[str] = None,
+               output: Optional[str] = None,
+               timeout_ms: Optional[float] = None) -> Future:
+        return self.server.submit(X, model or self.model,
+                                  **self._kw(output, timeout_ms))
+
+    def predict(self, X, *, model: Optional[str] = None,
+                output: Optional[str] = None,
+                timeout_ms: Optional[float] = None) -> np.ndarray:
+        return self.submit(X, model=model, output=output,
+                           timeout_ms=timeout_ms).result()
+
+    def predict_many(self, batches: Iterable, *,
+                     model: Optional[str] = None,
+                     output: Optional[str] = None,
+                     timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+        """Submit every batch BEFORE waiting on any result, so they can
+        coalesce into shared device dispatches."""
+        futures = [self.submit(X, model=model, output=output,
+                               timeout_ms=timeout_ms) for X in batches]
+        return [f.result() for f in futures]
+
+    def metrics(self) -> Dict[str, object]:
+        return self.server.metrics_snapshot()

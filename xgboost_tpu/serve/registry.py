@@ -1,0 +1,170 @@
+"""Multi-model registry: load once, pin on device, route by name.
+
+A :class:`ServedModel` is the device-resident form of a Booster: the
+stacked forest tensors are uploaded ONCE at load (``ForestPredictor``
+chunk pinning) instead of re-stacked per predict call, the objective's
+prediction transform and base margin are resolved up front, and the
+padded-batch margin entry point works on pre-bucketed device arrays.
+
+The :class:`ModelRegistry` maps ``name -> ServedModel`` under a lock
+with ATOMIC replacement: a hot swap fully constructs (and the server
+warms) the incoming model before the one dict assignment that makes it
+visible, so concurrent dispatches see either the old or the new model,
+never a half-loaded one. In-flight batches keep serving the
+ServedModel object they resolved — eviction never aborts them.
+
+Model sources: an in-process ``Booster``, a path to a native
+``save_model`` file (JSON / UBJ), raw model ``bytes``, or a reference
+xgboost model file (routed through ``interop.load_xgboost_model`` when
+the native loader rejects it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .errors import UnknownModel
+
+
+def _load_booster(source):
+    from ..core import Booster
+
+    if isinstance(source, Booster):
+        return source
+    try:
+        return Booster(model_file=source)
+    except Exception as native_err:
+        # not our schema — reference xgboost JSON/UBJ via interop
+        try:
+            from ..interop import load_xgboost_model
+
+            return load_xgboost_model(source)
+        except Exception:
+            raise native_err from None
+
+
+class ServedModel:
+    """A Booster prepared for the serving hot path."""
+
+    def __init__(self, name: str, booster, version: int = 1) -> None:
+        self.name = name
+        self.version = int(version)
+        self.booster = booster
+        booster._configure(None)
+        self.n_groups = int(booster.n_groups)
+        self.base = np.asarray(booster._base_np(), np.float32)
+        self.n_features = int(booster.num_features())
+        self._obj = booster.obj
+        gbm = booster.gbm
+        self._gbm = gbm
+        # pin: one stacked upload now, reused by every dispatch (GBTree /
+        # dart / vector-leaf all expose _predictor; gblinear's margin is
+        # a plain matmul with nothing to pin)
+        self._predictor = (gbm._predictor(0, len(gbm.trees))
+                           if hasattr(gbm, "_predictor") else None)
+
+    def key(self) -> str:
+        return f"{self.name}@v{self.version}"
+
+    def margin_padded(self, X_dev) -> jnp.ndarray:
+        """Margin [R, n_groups] of a bucket-padded device batch. Rows are
+        independent through the whole walk + leaf matmul, so pad rows
+        never influence real rows (tests/test_serve.py pins this
+        bit-exactly against ``Booster.predict``)."""
+        if self._predictor is not None:
+            m, _ = self._predictor.margin(X_dev, self.base)
+            return m
+        m, _, _ = self._gbm.predict_margin(X_dev, self.base)
+        return jnp.asarray(m)
+
+    def transform(self, margin: jnp.ndarray) -> jnp.ndarray:
+        """Objective prediction transform (sigmoid/softmax/identity) —
+        elementwise or row-wise, so it commutes with row slicing."""
+        return self._obj.pred_transform(margin)
+
+    def warm_batch(self, n_rows: int) -> np.ndarray:
+        """An all-zeros batch of this model's feature width."""
+        if self.n_features <= 0:
+            raise ValueError(
+                f"model {self.key()} has unknown feature count; pass "
+                "n_features= to warmup() or serve one real request first")
+        return np.zeros((n_rows, self.n_features), np.float32)
+
+
+class ModelRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._models: Dict[str, ServedModel] = {}
+        self._versions: Dict[str, int] = {}
+
+    def load(self, name: str, source, *, version: Optional[int] = None,
+             replace: bool = False) -> ServedModel:
+        """Construct and publish a model. ``replace=False`` refuses to
+        shadow an existing name (use :meth:`swap`)."""
+        booster = _load_booster(source)
+        with self._lock:
+            if not replace and name in self._models:
+                raise ValueError(
+                    f"model '{name}' is already served; use swap")
+            v = (int(version) if version is not None
+                 else self._versions.get(name, 0) + 1)
+            sm = ServedModel(name, booster, version=v)
+            self._publish(sm)
+            return sm
+
+    def prepare(self, name: str, source,
+                version: Optional[int] = None) -> ServedModel:
+        """Build a ServedModel WITHOUT publishing it (the server warms it
+        first, then calls :meth:`publish` — the atomic half of a swap)."""
+        booster = _load_booster(source)
+        with self._lock:
+            v = (int(version) if version is not None
+                 else self._versions.get(name, 0) + 1)
+        return ServedModel(name, booster, version=v)
+
+    def publish(self, sm: ServedModel) -> ServedModel:
+        with self._lock:
+            self._publish(sm)
+        return sm
+
+    def _publish(self, sm: ServedModel) -> None:
+        self._models[sm.name] = sm  # one assignment = the atomic swap
+        self._versions[sm.name] = max(
+            self._versions.get(sm.name, 0), sm.version)
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            if self._models.pop(name, None) is None:
+                raise UnknownModel(f"no served model named '{name}'")
+
+    def get(self, name: Optional[str] = None) -> ServedModel:
+        with self._lock:
+            if name is None:
+                if len(self._models) == 1:
+                    return next(iter(self._models.values()))
+                raise UnknownModel(
+                    "model name required: "
+                    f"{len(self._models)} models are served "
+                    f"({sorted(self._models)})")
+            sm = self._models.get(name)
+            if sm is None:
+                raise UnknownModel(f"no served model named '{name}'")
+            return sm
+
+    def resolve_name(self, name: Optional[str]) -> str:
+        return self.get(name).name
+
+    def models(self) -> List[ServedModel]:
+        with self._lock:
+            return list(self._models.values())
+
+    def describe(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return [{"name": m.name, "version": m.version,
+                     "n_features": m.n_features, "n_groups": m.n_groups,
+                     "n_trees": len(getattr(m._gbm, "trees", []) or [])}
+                    for m in self._models.values()]

@@ -1,0 +1,327 @@
+"""The serving engine: config, dispatch pipeline, SLO accounting.
+
+``Server`` wires the pieces together: requests enter through
+``submit``/``predict``, the :class:`~.batcher.MicroBatcher` coalesces
+them per model, and ``_dispatch`` runs the measured pipeline —
+bucket-pad (host) -> H2D -> jitted forest walk + transform -> D2H ->
+host slice back to per-request results. Every device batch is padded to
+a :class:`~.buckets.BucketLadder` shape, so after ``warmup()`` the
+executable cache is complete and the
+:class:`~.buckets.RecompileCounter` stays flat — the
+``recompiles_after_warmup`` SLO both tests and ``tools/bench_serve.py``
+assert on.
+
+Results are BIT-IDENTICAL to ``Booster.predict()``: the walk and the
+prediction transform are row-independent, pad rows are sliced off
+host-side, and the base margin is folded in the same float32 order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..logging_utils import logger
+from .batcher import MicroBatcher, PredictRequest
+from .buckets import BucketLadder, RecompileCounter
+from .errors import ServeError, ServerOverloaded
+from .metrics import ServeMetrics
+from .registry import ModelRegistry, ServedModel
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (docs/serving.md has the tuning guide).
+
+    max_batch:       rows per device dispatch; also the ladder top.
+    max_delay_ms:    longest a lone request waits for batch company.
+    max_queue_rows:  admission bound; past it submits shed with
+                     ServerOverloaded.
+    timeout_ms:      default per-request deadline (None = no deadline).
+    buckets:         explicit ladder sizes; default pow2(max_batch).
+    pad_value:       fill for pad rows (results never see it).
+    log_every_s:     >0 emits a periodic metrics line via the
+                     xgboost_tpu logger.
+    """
+
+    max_batch: int = 512
+    max_delay_ms: float = 2.0
+    max_queue_rows: int = 8192
+    timeout_ms: Optional[float] = None
+    buckets: Optional[Sequence[int]] = None
+    pad_value: float = 0.0
+    log_every_s: float = 0.0
+
+    def ladder(self) -> BucketLadder:
+        if self.buckets is not None:
+            lad = BucketLadder(self.buckets)
+            if lad.max_batch < self.max_batch:
+                lad = BucketLadder(lad.sizes + (self.max_batch,))
+            return lad
+        return BucketLadder.pow2(self.max_batch)
+
+
+_UNSET = object()
+
+
+class Server:
+    """In-process inference server over a multi-model registry."""
+
+    def __init__(self, models: Optional[Dict[str, object]] = None,
+                 config: Optional[ServeConfig] = None, **cfg_kw) -> None:
+        if config is None:
+            config = ServeConfig(**cfg_kw)
+        elif cfg_kw:
+            config = dataclasses.replace(config, **cfg_kw)
+        self.config = config
+        self.ladder = config.ladder()
+        self.metrics = ServeMetrics()
+        self.registry = ModelRegistry()
+        self.recompile_counter = RecompileCounter.for_forest_predictor()
+        self._device = jax.devices()[0]
+        self._closed = False
+        self._warmed = False
+        self._next_log = (time.perf_counter() + config.log_every_s
+                          if config.log_every_s > 0 else None)
+        self._log_lock = threading.Lock()
+        self.batcher = MicroBatcher(
+            max_batch=self.ladder.max_batch,
+            max_delay_s=config.max_delay_ms / 1e3,
+            max_queue_rows=config.max_queue_rows,
+            dispatch=self._dispatch,
+            on_tick=self._maybe_log if self._next_log else None,
+            on_expire=lambda n: self.metrics.inc("deadline_exceeded", n))
+        for name, src in (models or {}).items():
+            self.load_model(name, src)
+
+    # ------------------------------------------------------- model lifecycle
+    def load_model(self, name: str, source, *, version: Optional[int] = None,
+                   warm: bool = True) -> ServedModel:
+        sm = self.registry.load(name, source, version=version)
+        if warm and sm.n_features > 0:
+            self._warm_model(sm)
+        return sm
+
+    def swap_model(self, name: str, source, *,
+                   version: Optional[int] = None,
+                   warm: bool = True) -> ServedModel:
+        """Hot-swap: fully build and warm the incoming model while the old
+        one keeps serving, then publish atomically. In-flight batches
+        finish on whichever model they resolved."""
+        sm = self.registry.prepare(name, source, version=version)
+        if warm and sm.n_features > 0:
+            self._warm_model(sm)
+        self.registry.publish(sm)
+        self.metrics.inc("swaps")
+        return sm
+
+    def unload_model(self, name: str) -> None:
+        self.registry.unload(name)
+        self.metrics.inc("evictions")
+
+    def warmup(self, model: Optional[str] = None,
+               n_features: Optional[int] = None) -> int:
+        """Compile every (bucket, model) executable up front; marks the
+        recompile baseline. Returns the number of warmup batches run."""
+        targets = ([self.registry.get(model)] if model is not None
+                   else self.registry.models())
+        n = 0
+        for sm in targets:
+            if sm.n_features <= 0 and n_features:
+                sm.n_features = int(n_features)
+            n += self._warm_model(sm)
+        self.mark_warm()
+        return n
+
+    def _warm_model(self, sm: ServedModel) -> int:
+        c0 = self.recompile_counter.compiles()
+        for size in self.ladder.sizes:
+            X = sm.warm_batch(size)
+            self._run_padded(sm, X, size, warm=True)
+            self.metrics.inc("warmup_batches")
+        if self._warmed:
+            # a post-warmup (swap) warm pre-compiles on purpose; keep the
+            # zero-recompile SLO about UNPLANNED cache misses
+            self.recompile_counter.absorb(
+                self.recompile_counter.compiles() - c0)
+        return len(self.ladder.sizes)
+
+    def mark_warm(self) -> None:
+        """Snapshot the compile caches: everything after this counts as a
+        post-warmup recompile (the zero-recompile SLO)."""
+        self.recompile_counter.mark()
+        self._warmed = True
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        return self.recompile_counter.since_mark()
+
+    # ------------------------------------------------------------- requests
+    def submit(self, data, model: Optional[str] = None, *,
+               output: str = "value",
+               timeout_ms: object = _UNSET) -> Future:
+        """Enqueue one predict request; returns a Future resolving to the
+        predictions (or raising a typed ServeError)."""
+        if output not in ("value", "margin"):
+            raise ValueError(f"output must be 'value' or 'margin', "
+                             f"got {output!r}")
+        X = np.ascontiguousarray(np.asarray(data, np.float32))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"expected [rows, features] with rows >= 1, "
+                             f"got shape {X.shape}")
+        name = self.registry.resolve_name(model)  # fail unknown model fast
+        t_ms = (self.config.timeout_ms if timeout_ms is _UNSET
+                else timeout_ms)
+        deadline = (time.perf_counter() + float(t_ms) / 1e3
+                    if t_ms is not None else None)
+        req = PredictRequest(X, name, output, deadline)
+        self.metrics.inc("requests")
+        self.metrics.inc("rows", X.shape[0])
+        try:
+            return self.batcher.submit(req)
+        except ServerOverloaded:
+            self.metrics.inc("sheds")
+            raise
+
+    def predict(self, data, model: Optional[str] = None, *,
+                output: str = "value",
+                timeout_ms: object = _UNSET) -> np.ndarray:
+        return self.submit(data, model, output=output,
+                           timeout_ms=timeout_ms).result()
+
+    # ------------------------------------------------------------- pipeline
+    def _run_padded(self, sm: ServedModel, X: np.ndarray, bucket: int,
+                    warm: bool = False):
+        """pad -> H2D -> compute -> D2H on one bucket; returns
+        (values [R, G] or None, margins [R, G]) host arrays and records
+        stage latencies (skipped for warmup batches)."""
+        t0 = time.perf_counter()
+        Xp = self.ladder.pad(X, bucket, self.config.pad_value)
+        t1 = time.perf_counter()
+        xd = jax.block_until_ready(jax.device_put(Xp, self._device))
+        t2 = time.perf_counter()
+        margin_d = sm.margin_padded(xd)
+        value_d = sm.transform(margin_d)
+        jax.block_until_ready((margin_d, value_d))
+        t3 = time.perf_counter()
+        margin = np.asarray(margin_d)
+        value = np.asarray(value_d)
+        t4 = time.perf_counter()
+        if not warm:
+            self.metrics.observe("pad", t1 - t0)
+            self.metrics.observe("h2d", t2 - t1)
+            self.metrics.observe("compute", t3 - t2)
+            self.metrics.observe("d2h", t4 - t3)
+            self.metrics.hit_bucket(bucket, bucket - X.shape[0])
+        return value, margin
+
+    def _dispatch(self, model_name: str, batch: List[PredictRequest]) -> None:
+        """Batcher callback: resolve the model NOW (hot swap takes effect
+        at batch granularity), run per-ladder chunks, slice results back
+        to request futures."""
+        t_form = time.perf_counter()
+        for r in batch:
+            self.metrics.observe("queue", t_form - r.t_submit)
+        try:
+            sm = self.registry.get(model_name)
+        except ServeError as exc:
+            for r in batch:
+                r.future.set_exception(exc)
+            self.metrics.inc("errors", len(batch))
+            return
+        rows = np.concatenate([r.X for r in batch]) if len(batch) > 1 \
+            else batch[0].X
+        n = rows.shape[0]
+        try:
+            values, margins = [], []
+            off = 0
+            for size in self.ladder.chunks(n):
+                bucket = self.ladder.bucket_for(size)
+                v, m = self._run_padded(sm, rows[off:off + size], bucket)
+                values.append(v[:size])
+                margins.append(m[:size])
+                off += size
+            value = np.concatenate(values) if len(values) > 1 else values[0]
+            margin = (np.concatenate(margins) if len(margins) > 1
+                      else margins[0])
+            self.metrics.inc("batches")
+        except BaseException as exc:  # noqa: BLE001
+            self.metrics.inc("errors", len(batch))
+            for r in batch:
+                r.future.set_exception(exc)
+            return
+        t_done = time.perf_counter()
+        off = 0
+        for r in batch:
+            out = (margin if r.output == "margin" else value)
+            res = np.array(out[off:off + r.rows])  # copy: drop batch ref
+            if res.ndim == 2 and res.shape[1] == 1:
+                res = res[:, 0]  # match Booster.predict non-strict shape
+            r.future.set_result(
+                _ServedResult(res, sm.name, sm.version))
+            self.metrics.observe("e2e", t_done - r.t_submit)
+            off += r.rows
+
+    # ---------------------------------------------------------- maintenance
+    def _maybe_log(self) -> None:
+        if self._next_log is None:
+            return
+        with self._log_lock:
+            now = time.perf_counter()
+            if now < self._next_log:
+                return
+            self._next_log = now + self.config.log_every_s
+        self.metrics.counters["recompiles"] = self.recompiles_after_warmup
+        logger.info(self.metrics.report_line(
+            {"queue_rows": self.batcher.queue_depth_rows(),
+             "models": len(self.registry.models())}))
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        snap = self.metrics.snapshot()
+        snap["recompiles_after_warmup"] = (
+            self.recompiles_after_warmup if self._warmed else None)
+        snap["queue_rows"] = self.batcher.queue_depth_rows()
+        snap["models"] = self.registry.describe()
+        snap["buckets"] = list(self.ladder.sizes)
+        return snap
+
+    def drain(self) -> None:
+        """Serve the backlog, then stop accepting and dispatching."""
+        self.close(drain=True)
+
+    def close(self, drain: bool = True) -> None:
+        if self._closed:
+            return
+        self.batcher.close(drain=drain)
+        self._closed = True
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+
+class _ServedResult(np.ndarray):
+    """Prediction array annotated with the serving model identity
+    (``.model``/``.version``) — plain ndarray everywhere else, so
+    callers that only want numbers never notice."""
+
+    def __new__(cls, arr: np.ndarray, model: str, version: int):
+        obj = np.asarray(arr).view(cls)
+        obj.model = model
+        obj.version = version
+        return obj
+
+    def __array_finalize__(self, obj) -> None:
+        if obj is not None:
+            self.model = getattr(obj, "model", None)
+            self.version = getattr(obj, "version", None)

@@ -51,6 +51,31 @@ from .param import TrainParam, calc_weight
 from .tree import TreeModel
 
 
+def row_split_hist_method(hist_method: str) -> str:
+    """Normalise ``hist_method`` for the vertical federated growers: the
+    two-level coarse/fused schedules are ROW-split resident/paged
+    schemes (their win is device histogram bandwidth; the federated
+    level loop is host-collective-latency-bound — see
+    docs/performance.md "Round 7: coarse x vertical federated"). An
+    explicit request degrades to the exact one-pass kernels with a
+    warning instead of killing the job, mirroring the lossguide
+    fallback policy."""
+    base, sfx = hist_method, ""
+    for s in ("+sub", "+nosub"):
+        if base.endswith(s):
+            base, sfx = base[: -len(s)], s
+    if base in ("coarse", "fused"):
+        import warnings
+
+        warnings.warn(
+            f"hist_method='{base}' requires row split; vertical federated "
+            "(column split) trains with the exact one-pass histogram "
+            "kernels instead (docs/performance.md round 7)", UserWarning,
+            stacklevel=3)
+        return "auto" + sfx
+    return hist_method
+
+
 def exchange_feature_topology(comm, base_local: np.ndarray, w_local: int):
     """The ONE feature-topology protocol of the vertical growers: every
     rank contributes (its real-bin base mask, its cat word width) through
@@ -80,7 +105,7 @@ class VerticalFederatedGrower:
         self.param = param
         self.max_nbins = max_nbins
         self.cuts = cuts
-        self.hist_method = hist_method
+        self.hist_method = row_split_hist_method(hist_method)
         self.has_missing = has_missing
         self.split_mode = split_mode
         self.mesh = None
@@ -380,14 +405,11 @@ class VerticalLossguideGrower(LossguideGrower):
         # monotone/interaction arrays stay GLOBAL-feature-indexed, which
         # is exactly what the replicated pq bookkeeping indexes with the
         # winner's global feature ids
-        super().__init__(param, max_nbins, cuts, hist_method=hist_method,
+        super().__init__(param, max_nbins, cuts,
+                         hist_method=row_split_hist_method(hist_method),
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing, split_mode="row")
-        if self._base_hm in ("coarse", "fused"):
-            raise NotImplementedError(
-                f"hist_method='{self._base_hm}' requires row split "
-                "(vertical federated is column split)")
         self._coarse = False  # host eval path uses the one-pass build
         self._fused = False   # federated apply/eval exchange per step
         self.split_mode = "col"
