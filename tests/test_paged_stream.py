@@ -1,0 +1,340 @@
+"""Page-major streaming schedule + compressed (u4) page transport (r8).
+
+Covers the restructured external-memory level loop (tree/paged.py):
+the all-cached whole-level program, the single-upload-per-level streamed
+path whose refine comes from fine-window slicing, the u4 packed transport
+(XTPU_PAGE_PACK) across depthwise / lossguide / fused / mesh row-split,
+the widened prefetch ring's byte accounting, and the two tier flips that
+ride along (gblinear and tree_method=approx over pages)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+from test_data_iterator import BatchIter
+
+
+def _data(n=3000, f=6, seed=7, missing=0.1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(f) > 0).astype(np.float32)
+    if missing:
+        X[rng.rand(*X.shape) < missing] = np.nan
+    return X, y
+
+
+def _paged_qdm(tmp_path, monkeypatch, X, y, max_bin, page_rows,
+               cache_bytes=None, pack=None):
+    monkeypatch.setenv("XTPU_PAGE_ROWS", str(page_rows))
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")
+    if cache_bytes is not None:
+        monkeypatch.setenv("XTPU_PAGE_CACHE_BYTES", str(cache_bytes))
+    if pack is not None:
+        monkeypatch.setenv("XTPU_PAGE_PACK", str(pack))
+    it = BatchIter(X, y, n_batches=3)
+    it.cache_prefix = str(tmp_path / "pc")
+    return xgb.QuantileDMatrix(it, max_bin=max_bin)
+
+
+def _assert_same_forest(bst_p, bst_r):
+    trees_p, trees_r = bst_p.gbm.trees, bst_r.gbm.trees
+    assert len(trees_p) == len(trees_r)
+    for tp, tr in zip(trees_p, trees_r):
+        np.testing.assert_array_equal(tp.split_feature, tr.split_feature)
+        np.testing.assert_array_equal(tp.split_bin, tr.split_bin)
+        # page-order gradient accumulation: leaves agree to f32
+        # reassociation tolerance (the small-hess deep leaves carry the
+        # largest relative drift)
+        np.testing.assert_allclose(tp.leaf_value, tr.leaf_value,
+                                   rtol=5e-4, atol=5e-5)
+
+
+# ---- packed transport is BIT-identical to unpacked ------------------------
+
+@pytest.mark.parametrize("page_rows", [700, 1999])  # tiny + uneven-last
+def test_pack_bit_identical_to_unpacked(tmp_path, monkeypatch, page_rows):
+    """Same paged stream, pack on vs off: the u4 decode is pure integer
+    unpacking, so the models must be byte-identical — dumps and all."""
+    X, y = _data()
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 15}  # +1 missing slot = 16 -> packable
+    boosters = {}
+    for pack in ("0", "1"):
+        (tmp_path / pack).mkdir(exist_ok=True)
+        qdm = _paged_qdm(tmp_path / pack, monkeypatch, X, y, 15,
+                         page_rows, cache_bytes=0, pack=pack)
+        assert qdm._binned.packed == (pack == "1")
+        boosters[pack] = xgb.train(params, qdm, 4, verbose_eval=False)
+    assert boosters["0"].get_dump(with_stats=True) == \
+        boosters["1"].get_dump(with_stats=True)
+
+
+def test_packed_streaming_matches_resident(tmp_path, monkeypatch):
+    """Packed + forced streaming (zero cache: the single-upload fine-slice
+    path) vs the resident reference on the same quantization."""
+    X, y = _data(seed=3)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 15}
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 15, 700,
+                       cache_bytes=0, pack="1")
+    bst_p = xgb.train(params, qdm_p, 4, verbose_eval=False)
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=15), 4,
+                      verbose_eval=False)
+    _assert_same_forest(bst_p, bst_r)
+    dmx = xgb.DMatrix(X)
+    np.testing.assert_allclose(bst_p.predict(dmx), bst_r.predict(dmx),
+                               rtol=1e-4, atol=1e-5)
+    # prediction over packed pages (decode_page path) matches raw-X walk
+    np.testing.assert_allclose(bst_p.predict(qdm_p), bst_p.predict(dmx),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pack_refused_above_16_bins(tmp_path, monkeypatch):
+    X, y = _data(seed=5)
+    qdm = _paged_qdm(tmp_path, monkeypatch, X, y, 64, 700, pack="1")
+    assert not qdm._binned.packed  # u8 ids don't fit a nibble
+
+
+# ---- page-major coarse schedule (fused) over streamed pages ---------------
+
+def test_fused_streaming_fine_slice_matches_resident(tmp_path, monkeypatch):
+    """hist_method=fused with a ZERO page cache: every level boundary
+    uploads each page once, and the refine histogram comes from slicing
+    the streamed fine partials — must reproduce resident fused exactly."""
+    X, y = _data(n=4000, seed=11)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 256, "hist_method": "fused"}
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 256, 700,
+                       cache_bytes=0)
+    binned = qdm_p.binned(256)
+    binned.reset_ring_stats()
+    bst_p = xgb.train(params, qdm_p, 3, verbose_eval=False)
+    # upload accounting: ~one page visit per level boundary + the final
+    # advance, NOT the two-visits-per-level r6 schedule. 3 rounds x
+    # (4 boundaries + final) x 6 pages = 90 visits; the old schedule's
+    # refine re-uploads would push past 140. Bytes ride the same counter.
+    rs = binned.ring_stats
+    n_pages = binned.n_pages()
+    assert rs["uploads"] <= 3 * (4 + 1) * n_pages + n_pages
+    assert rs["bytes"] > 0
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=256), 3,
+                      verbose_eval=False)
+    _assert_same_forest(bst_p, bst_r)
+
+
+def test_warm_cache_level_program_matches_resident(tmp_path, monkeypatch):
+    """Default cache budget (everything cached after warmup): the whole
+    level runs as ONE program (level_full) — same models as resident."""
+    X, y = _data(seed=13)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3,
+              "max_bin": 31, "hist_method": "fused"}
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 31, 700)
+    binned = qdm_p.binned(31)
+    binned.reset_ring_stats()
+    bst_p = xgb.train(params, qdm_p, 4, verbose_eval=False)
+    # pages upload exactly once (cache warmup); every later level re-reads
+    # the HBM cache — zero further H2D
+    assert binned.ring_stats["uploads"] == binned.n_pages()
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=31), 4,
+                      verbose_eval=False)
+    _assert_same_forest(bst_p, bst_r)
+
+
+def test_packed_lossguide_matches_resident(tmp_path, monkeypatch):
+    X, y = _data(seed=17)
+    params = {"objective": "binary:logistic", "grow_policy": "lossguide",
+              "max_leaves": 8, "max_depth": 0, "eta": 0.3, "max_bin": 15}
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 15, 700,
+                       cache_bytes=0, pack="1")
+    bst_p = xgb.train(params, qdm_p, 4, verbose_eval=False)
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=15), 4,
+                      verbose_eval=False)
+    _assert_same_forest(bst_p, bst_r)
+
+
+def test_packed_mesh_row_split_matches_resident(tmp_path, monkeypatch):
+    """Packed pages under the device mesh: each shard streams its packed
+    row shard, kernels decode in-trace under shard_map."""
+    X, y = _data(seed=19)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 15}
+    mesh = xgb.make_data_mesh()
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 15, 500,
+                       cache_bytes=1, pack="1")
+    bst_p = xgb.train({**params, "mesh": mesh}, qdm_p, 4,
+                      verbose_eval=False)
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=15), 4,
+                      verbose_eval=False)
+    _assert_same_forest(bst_p, bst_r)
+
+
+# ---- ring: depth + byte accounting ----------------------------------------
+
+def test_ring_counts_packed_bytes(tmp_path, monkeypatch):
+    X, y = _data(seed=23)
+    monkeypatch.setenv("XTPU_PAGE_RING", "3")
+    qdm = _paged_qdm(tmp_path, monkeypatch, X, y, 15, 700,
+                     cache_bytes=0, pack="1")
+    binned = qdm.binned(15)
+    assert binned.ring_depth == 3
+    binned.reset_ring_stats()
+    pages = list(binned.pages())
+    assert len(pages) == binned.n_pages()
+    rs = binned.ring_stats
+    assert rs["uploads"] == binned.n_pages()
+    # packed transport: F=6 packs to 3 bytes/row
+    total_packed = sum(p.nbytes for _, _, p in pages)
+    assert rs["bytes"] == total_packed
+    assert total_packed < binned.bins_host.nbytes  # genuinely compressed
+    # decode restores the exact host bins
+    s, e, p0 = pages[0]
+    np.testing.assert_array_equal(np.asarray(binned.decode_page(p0)),
+                                  binned.bins_host[s:e])
+
+
+# ---- tier flips: gblinear + approx over pages ------------------------------
+
+def test_gblinear_paged_matches_resident(tmp_path, monkeypatch):
+    """Streamed shotgun round (per-feature gradient sums over pages) vs
+    the resident iterator-built matrix: identical operands (bin-value
+    reconstruction, missing -> 0), so weights agree to page-order
+    summation tolerance."""
+    X, y = _data(seed=29)
+    params = {"objective": "binary:logistic", "booster": "gblinear",
+              "eta": 0.5, "lambda": 0.1, "alpha": 0.05, "max_bin": 16}
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 16, 700)
+    bst_p = xgb.train(params, qdm_p, 5, verbose_eval=False)
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=16), 5,
+                      verbose_eval=False)
+    np.testing.assert_allclose(np.asarray(bst_p.gbm.W),
+                               np.asarray(bst_r.gbm.W),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(bst_p.predict(qdm_p), bst_r.predict(qdm_p),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gblinear_paged_guards(tmp_path, monkeypatch):
+    X, y = _data(seed=31)
+    qdm = _paged_qdm(tmp_path, monkeypatch, X, y, 16, 700)
+    with pytest.raises(NotImplementedError, match="coord_descent"):
+        xgb.train({"objective": "binary:logistic", "booster": "gblinear",
+                   "updater": "coord_descent", "max_bin": 16}, qdm, 1,
+                  verbose_eval=False)
+
+
+def test_approx_paged_matches_resident(tmp_path, monkeypatch):
+    """approx re-sketches per iteration from the page iterator (page-wise
+    hessian-weighted summaries) and trains through the paged hist driver;
+    quality-identical to the resident iterator path (the weighted sketch
+    merge regroups f64 sums, so parity is prediction-level)."""
+    X, y = _data(seed=37)
+    params = {"objective": "binary:logistic", "tree_method": "approx",
+              "max_depth": 4, "eta": 0.3, "max_bin": 16}
+    qdm_p = _paged_qdm(tmp_path, monkeypatch, X, y, 16, 700)
+    bst_p = xgb.train(params, qdm_p, 3, verbose_eval=False)
+    bst_r = xgb.train(params,
+                      xgb.QuantileDMatrix(BatchIter(X, y, n_batches=3),
+                                          max_bin=16), 3,
+                      verbose_eval=False)
+    dmx = xgb.DMatrix(X)
+    np.testing.assert_allclose(bst_p.predict(dmx), bst_r.predict(dmx),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_approx_paged_under_communicator(tmp_path, monkeypatch):
+    """Multi-host paged approx: per-rank page streams, cross-rank sketch
+    merge + per-level histogram allreduce — the two-rank model must match
+    the single-rank model on the pooled rows."""
+    import threading
+
+    from xgboost_tpu.parallel import collective
+    from xgboost_tpu.parallel.collective import InMemoryCommunicator
+
+    X, y = _data(n=2000, seed=41)
+    params = {"objective": "binary:logistic", "tree_method": "approx",
+              "max_depth": 3, "eta": 0.3, "max_bin": 16}
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "300")
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")
+    comms = InMemoryCommunicator.make_world(2)
+    preds = [None, None]
+    errors = []
+
+    def worker(rank):
+        collective.set_thread_local_communicator(comms[rank])
+        try:
+            half = len(X) // 2
+            lo, hi = (0, half) if rank == 0 else (half, len(X))
+            it = BatchIter(X[lo:hi], y[lo:hi], n_batches=2)
+            it.cache_prefix = str(tmp_path / f"r{rank}")
+            dm = xgb.QuantileDMatrix(it, max_bin=16)
+            bst = xgb.train(params, dm, 2, verbose_eval=False)
+            preds[rank] = bst.predict(xgb.DMatrix(X))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+        finally:
+            collective.set_thread_local_communicator(None)
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors
+    assert preds[0] is not None and preds[1] is not None
+    # both ranks trained the SAME global model
+    np.testing.assert_allclose(preds[0], preds[1], rtol=1e-5, atol=1e-6)
+
+
+# ---- packed pallas kernel (interpret mode) ---------------------------------
+
+def test_pallas_packed_u4_interpret_matches_segment():
+    from xgboost_tpu.ops.histogram import build_hist_segment, unpack_u4
+    from xgboost_tpu.ops.pallas.histogram import build_hist_pallas
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n, F, B, N = 500, 5, 16, 4
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    gpair = rng.randn(n, 2).astype(np.float32)
+    rel = rng.randint(0, N + 1, size=n).astype(np.int32)
+    packed = (np.concatenate(
+        [bins, np.zeros((n, 1), np.uint8)], axis=1)[:, 0::2]
+        | (np.concatenate(
+            [bins, np.zeros((n, 1), np.uint8)], axis=1)[:, 1::2] << 4))
+    # the host pack and the in-trace decode are inverses
+    np.testing.assert_array_equal(
+        np.asarray(unpack_u4(jnp.asarray(packed), F)), bins)
+    ref = build_hist_segment(jnp.asarray(bins), jnp.asarray(gpair),
+                             jnp.asarray(rel), N, B)
+    out = build_hist_pallas(jnp.asarray(packed).T, jnp.asarray(gpair),
+                            jnp.asarray(rel), N, B, precision="int8x2",
+                            block_rows=256, interpret=True, packed_u4=F)
+    # int8x2 is 15-bit fixed point — compare at the quantisation scale
+    # (same protocol as tests/test_pallas_hist.py)
+    scale = max(np.abs(np.asarray(ref)).max(), 1.0)
+    np.testing.assert_allclose(np.asarray(out) / scale,
+                               np.asarray(ref) / scale,
+                               rtol=2e-4, atol=2e-4)
+    # and the packed input gives the SAME result as the pre-decoded one
+    out_ref = build_hist_pallas(jnp.asarray(bins).T, jnp.asarray(gpair),
+                                jnp.asarray(rel), N, B,
+                                precision="int8x2", block_rows=256,
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out_ref))

@@ -78,9 +78,13 @@ def main():
           f"({10 / (t15 - t5):.2f} rounds/s)", flush=True)
 
     # ---- forced-streaming overlap: how much H2D hides behind compute ----
-    # zero cache budget => every page re-uploads every pass, the pure
+    # zero cache budget => every page re-uploads every visit, the pure
     # streaming regime; the ring stats separate upload wall time from the
-    # consumer's blocked time (data/binned.py ring_stats)
+    # consumer's blocked time (data/binned.py ring_stats) and count the
+    # transport bytes, reported as MATRIX-EQUIVALENTS per round — the
+    # page-major schedule's accounting unit (r8: one visit per page per
+    # level boundary => depth+1 equivalents at depth 6, was ~2*depth+1;
+    # u4 packing halves the bytes again when max_bin <= 16)
     os.environ["XTPU_PAGED_COLLAPSE"] = "0"
     prior_budget = binned.cache_budget_bytes
     binned.cache_budget_bytes = 0
@@ -91,8 +95,13 @@ def main():
         t_stream = timed(3)
         ov = binned.streaming_overlap()
         rs = binned.ring_stats
+        meq = rs["bytes"] / 3.0 / max(binned.bins_host.nbytes, 1)
         print(f"streaming (no cache): {t_stream / 3:.2f} s/round; "
-              f"uploads={rs['uploads']} upload={rs['upload_s']:.1f}s "
+              f"uploads/round={rs['uploads'] / 3:.1f} "
+              f"bytes/round={rs['bytes'] / 3 / 2**20:.0f} MiB "
+              f"({meq:.2f} matrix-equivalents, "
+              f"pack={'on' if binned.packed else 'off'}) "
+              f"upload={rs['upload_s']:.1f}s "
               f"blocked={rs['blocked_s']:.1f}s "
               f"overlap={'n/a' if ov is None else f'{100 * ov:.0f}%'}",
               flush=True)

@@ -1,0 +1,210 @@
+"""Promotion gate for the page-major streaming schedule + packed transport.
+
+Round 8 mirrors the r5/r6 promotion protocol (tools/validate_coarse.py /
+validate_fused.py): before the page-major schedule (one upload per page
+per level boundary, streamed refine via fine-window slicing) and the u4
+compressed transport ship as defaults, a grid over
+
+    page size   x  pack  x  cache regime  x  grower tier
+
+trains the streaming tier against the resident reference ON THE SAME
+QUANTIZATION and asserts ZERO model gap: split structure (features and
+threshold bins) must be identical node for node, leaf values equal to
+float-summation-reassociation tolerance (gradients accumulate in page
+order — the standard every paged parity suite pins), predictions
+likewise. The one tolerated divergence is a TIE node: two candidate
+splits inducing the same row partition (equal gain up to f32 cumsum
+error, e.g. bin-0/default-left vs last-bin/default-right around an
+all-missing group) may argmax differently under a different page count —
+those must still agree on gain and leave predictions unchanged. Any
+other structural mismatch is a correctness bug in the schedule, not a
+quality trade.
+
+Cache regimes: "warm" leaves the default HBM page cache on (exercises the
+whole-level fused program, tree/paged.py level_full); "stream" zeroes the
+budget so every page re-uploads each visit (exercises the single-upload
+fine-partial path and the packed transport). The overlap-%% of the stream
+regime's ring is printed per cell; set VALIDATE_OVERLAP_MIN to also gate
+on it (meaningful on a real accelerator, not on the in-container CPU).
+
+Run from the repo root: ``python tools/validate_paged_stream.py``.
+Shrink for a smoke run: VALIDATE_PAGED_SCALE=0.25 (fraction of rows).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+SCALE = float(os.environ.get("VALIDATE_PAGED_SCALE", "1.0"))
+OVERLAP_MIN = os.environ.get("VALIDATE_OVERLAP_MIN")
+
+N = max(int(4000 * SCALE), 400)
+F = 6
+ROUNDS = 4
+
+# (name, params, page_rows) — page sizes cover the uneven-last-page and
+# many-tiny-pages layouts; fused exercises the two-level coarse schedule's
+# page-major path explicitly (auto only promotes it at scale). max_bin 15
+# (+1 missing slot = 16 uniform slots) keeps the pack=1 cells actually
+# packable; the fused tiers pin max_bin=256 (pack ineligible there — its
+# cells double as the pack-refusal regression).
+TIERS = [
+    ("depthwise", {"max_depth": 4, "max_bin": 15}, 700),
+    ("depthwise-tiny-pages", {"max_depth": 4, "max_bin": 15}, 173),
+    ("fused", {"max_depth": 4, "hist_method": "fused", "max_bin": 256},
+     700),
+    ("fused-uneven", {"max_depth": 4, "hist_method": "fused",
+                      "max_bin": 256}, 1999),
+    ("lossguide", {"grow_policy": "lossguide", "max_leaves": 8,
+                   "max_depth": 0, "max_bin": 15}, 700),
+]
+PACKS = ("0", "1")
+REGIMES = ("warm", "stream")
+
+
+def _data(with_missing=True):
+    rng = np.random.RandomState(11)
+    X = rng.randn(N, F).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(F) > 0).astype(np.float32)
+    if with_missing:
+        X[rng.rand(*X.shape) < 0.1] = np.nan
+    return X, y
+
+
+def _iter(X, y, cache=None):
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self.cache_prefix = cache
+            self.parts = np.array_split(np.arange(len(X)), 3)
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(self.parts):
+                return 0
+            idx = self.parts[self.i]
+            input_data(data=X[idx], label=y[idx])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    return It()
+
+
+def run_cell(tier_params, page_rows, pack, regime, max_bin, X, y, tmp):
+    import xgboost_tpu as xgb
+
+    params = {"objective": "binary:logistic", "eta": 0.3,
+              "max_bin": max_bin, **tier_params}
+    env = {"XTPU_PAGE_ROWS": str(page_rows), "XTPU_PAGED_COLLAPSE": "0",
+           "XTPU_PAGE_PACK": pack}
+    if regime == "stream":
+        env["XTPU_PAGE_CACHE_BYTES"] = "0"
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        dm_p = xgb.QuantileDMatrix(_iter(X, y, cache=os.path.join(
+            tmp, f"pc{page_rows}{pack}{regime}")), max_bin=max_bin)
+        binned = dm_p._binned
+        binned.reset_ring_stats()
+        bst_p = xgb.train(params, dm_p, ROUNDS, verbose_eval=False)
+        overlap = binned.streaming_overlap()
+        packed = bool(getattr(binned, "packed", False))
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update(
+                {k: v})
+    dm_r = xgb.QuantileDMatrix(_iter(X, y), max_bin=max_bin)
+    bst_r = xgb.train(params, dm_r, ROUNDS, verbose_eval=False)
+
+    # Structural comparison with TIE awareness: two candidate splits can
+    # induce the same row partition (e.g. "all present rows left, missing
+    # right" expressed at bin 0/default-left or at the last bin/
+    # default-right); their gains are mathematically equal, so which one
+    # wins the argmax depends on f32 accumulation order, which page count
+    # legitimately changes. Such a node counts as a TIE (gains must agree
+    # to float tolerance and the whole model's predictions must match);
+    # anything else is a structural gap and fails the gate.
+    struct_gap = ties = 0
+    leaf_gap = 0.0
+    for tp, tr in zip(bst_p.gbm.trees, bst_r.gbm.trees):
+        mism = np.nonzero((tp.split_feature != tr.split_feature)
+                          | (tp.split_bin != tr.split_bin))[0]
+        for h in mism:
+            if np.isclose(tp.gain[h], tr.gain[h], rtol=1e-3, atol=1e-4):
+                ties += 1
+            else:
+                struct_gap += 1
+        if not mism.size:
+            leaf_gap = max(leaf_gap, float(np.max(np.abs(
+                tp.leaf_value - tr.leaf_value))))
+    dmx = xgb.DMatrix(X)
+    pred_gap = float(np.max(np.abs(bst_p.predict(dmx)
+                                   - bst_r.predict(dmx))))
+    return struct_gap, ties, leaf_gap, pred_gap, overlap, packed
+
+
+def main():
+    import tempfile
+
+    X, y = _data()
+    rows = []
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="vps_") as tmp:
+        for name, tier_params, page_rows in TIERS:
+            tp = dict(tier_params)
+            max_bin = tp.pop("max_bin", 16)
+            for pack in PACKS:
+                for regime in REGIMES:
+                    (sg, ties, lg, pg, ov, packed) = run_cell(
+                        tp, page_rows, pack, regime, max_bin, X, y, tmp)
+                    cell_ok = sg == 0 and lg < 1e-4 and pg < 1e-4
+                    if OVERLAP_MIN and regime == "stream" \
+                            and ov is not None:
+                        cell_ok &= 100 * ov >= float(OVERLAP_MIN)
+                    ok &= cell_ok
+                    rows.append({
+                        "tier": name, "page_rows": page_rows,
+                        "pack": pack, "packed_active": packed,
+                        "regime": regime, "struct_gap": sg,
+                        "tie_nodes": ties,
+                        "leaf_gap": lg, "pred_gap": pg,
+                        "overlap_pct": (None if ov is None
+                                        else round(100 * ov, 1)),
+                        "ok": cell_ok})
+                    r = rows[-1]
+                    print(f"{name} pages={page_rows} pack={pack}"
+                          f"(active={packed}) {regime}: "
+                          f"struct_gap={sg} ties={ties} "
+                          f"leaf_gap={lg:.2e} pred_gap={pg:.2e} "
+                          f"overlap={r['overlap_pct']} "
+                          f"{'OK' if cell_ok else 'MISMATCH'}",
+                          flush=True)
+
+    print("\n| tier | pages | pack | regime | struct gap | ties | "
+          "leaf gap | pred gap | overlap % |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['tier']} | {r['page_rows']} | {r['pack']} | "
+              f"{r['regime']} | {r['struct_gap']} | {r['tie_nodes']} | "
+              f"{r['leaf_gap']:.2e} | "
+              f"{r['pred_gap']:.2e} | {r['overlap_pct']} |")
+    verdict = ("PASS — streaming/packed models match resident across the "
+               "grid" if ok else
+               "FAIL — page-major schedule diverges from resident (bug)")
+    print(f"\n{verdict}")
+    print(json.dumps({"cells": rows, "pass": ok}))
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

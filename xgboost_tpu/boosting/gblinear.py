@@ -29,6 +29,68 @@ def _soft_threshold(x, alpha):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - alpha, 0.0)
 
 
+# ---- external-memory (paged) streaming round -------------------------------
+# The shotgun round is two matmuls + one elementwise update, so it streams
+# naturally: G = Xᵀg and H = (X²)ᵀh accumulate page by page over the
+# host-resident quantized matrix (reference: the shotgun updater iterates
+# GetBatches the same way, src/linear/updater_shotgun.cc:96) and the weight
+# move is a pure [F, K] computation. Pages decode their bin ids to the
+# representative cut values in-trace (missing -> 0, matching the resident
+# path's nan_to_num) — the same reconstruction the quantized predictors use
+# (reference GHistIndexMatrix::GetFvalue).
+
+def _cut_arrays(binned):
+    """(ptrs[:-1], values, n_real) of a quantized matrix as device arrays —
+    the operands of the in-trace bin -> value decode."""
+    cuts = binned.cuts
+    return (jnp.asarray(np.asarray(cuts.ptrs[:-1], np.int32)),
+            jnp.asarray(np.asarray(cuts.values, np.float32)),
+            jnp.asarray(np.asarray(binned.n_real_bins(), np.int32)))
+
+
+def _page_features(page, ptrs, vals, n_real):
+    """[p, F] bin ids -> representative f32 feature values, missing -> 0
+    (bit-identical to ``BinnedMatrix.to_values()`` + ``nan -> 0``, so paged
+    streaming and resident iterator-built training see the same operands)."""
+    local = page.astype(jnp.int32)
+    miss = local >= n_real[None, :]
+    gb = jnp.clip(ptrs[None, :] + jnp.minimum(local, n_real[None, :] - 1),
+                  0, vals.shape[0] - 1)
+    return jnp.where(miss, 0.0, vals[gb])
+
+
+_page_features_jit = jax.jit(_page_features)
+
+
+@jax.jit
+def _page_gh(page, gp_pg, dbias, ptrs, vals, n_real):
+    """One page's (G, H) partial after the bias refresh."""
+    X = _page_features(page, ptrs, vals, n_real)
+    g = gp_pg[..., 0] + gp_pg[..., 1] * dbias[None, :]
+    G = jnp.einsum("nf,nk->fk", X, g, precision=jax.lax.Precision.HIGHEST)
+    H = jnp.einsum("nf,nk->fk", jnp.square(X), gp_pg[..., 1],
+                   precision=jax.lax.Precision.HIGHEST)
+    return G, H
+
+
+@functools.partial(jax.jit, static_argnames=("eta", "lam", "alpha"))
+def _shotgun_dw(G, H, W, *, eta, lam, alpha):
+    """The fused soft-threshold weight move of ``_shotgun_round`` from the
+    page-accumulated gradient sums."""
+    W_star = _soft_threshold(H * W - G, alpha) \
+        / jnp.maximum(H + lam, 1e-10)
+    return (W_star - W) * eta
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _page_delta(delta, page, s, dW, dbias, ptrs, vals, n_real):
+    """Write one page's margin delta X_pg @ dW + dbias into [n, K]."""
+    X = _page_features(page, ptrs, vals, n_real)
+    d = jnp.dot(X, dW, precision=jax.lax.Precision.HIGHEST) \
+        + dbias[None, :]
+    return jax.lax.dynamic_update_slice_in_dim(delta, d, s, 0)
+
+
 @LINEAR_UPDATERS.register("shotgun")
 @functools.partial(jax.jit, static_argnames=("eta", "lam", "alpha"))
 def _shotgun_round(X, gpair, W, bias, *, eta, lam, alpha):
@@ -86,13 +148,15 @@ class GBLinear:
 
     def __init__(self, n_groups: int, updater: str = "shotgun",
                  reg_lambda: float = 0.0, reg_alpha: float = 0.0,
-                 eta: float = 0.5, feature_selector: str = "cyclic") -> None:
+                 eta: float = 0.5, feature_selector: str = "cyclic",
+                 mesh=None) -> None:
         self.n_groups = n_groups
         self.updater = updater
         self.reg_lambda = reg_lambda
         self.reg_alpha = reg_alpha
         self.eta = eta
         self.feature_selector = feature_selector
+        self.mesh = mesh
         self.W: Optional[jnp.ndarray] = None    # [F, K]
         self.bias: Optional[jnp.ndarray] = None  # [K]
         self.rounds = 0
@@ -107,20 +171,57 @@ class GBLinear:
     def training_margin(self, state: dict):
         return state["margin"]
 
+    def _paged_binned(self, state: dict):
+        """The PagedBinnedMatrix to stream over, or None for resident
+        training. Guards: the mesh tier and coord_descent (whose in-scan
+        gradient refresh wants the resident matrix) stay resident-only."""
+        binned = state.get("binned")
+        if not getattr(binned, "is_paged", False):
+            return None
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "booster=gblinear over external-memory pages does not "
+                "support a device mesh; train mesh configs on a resident "
+                "DMatrix")
+        if self.updater != "shotgun":
+            raise NotImplementedError(
+                "external-memory gblinear streams updater=shotgun only "
+                "(the reference shotgun iterates GetBatches the same "
+                "way); coord_descent's in-scan gradient refresh needs "
+                "the resident matrix")
+        return binned
+
     def _X_of(self, state: dict) -> jnp.ndarray:
         if "linear_X" not in state:
             dm_x = state["dm"].X
-            if getattr(dm_x, "is_paged", False) or np.ndim(dm_x) != 2:
-                # the dense-matmul linear round wants the resident matrix
+            binned = state.get("binned")
+            if dm_x is None and binned is not None \
+                    and not getattr(binned, "is_paged", False):
+                # iterator-built resident matrix: raw floats were never
+                # retained, so train on the representative cut values the
+                # quantized matrix reconstructs (missing -> 0) — exactly
+                # the operands the paged streaming round decodes page by
+                # page, keeping paged and resident iterator training in
+                # bit-parity
+                state["linear_X"] = _page_features_jit(
+                    binned.bins, *_cut_arrays(binned))
+            elif getattr(dm_x, "is_paged", False) or np.ndim(dm_x) != 2:
+                # paged matrices route through _do_boost_paged; anything
+                # else (no raw data, no quantized form) cannot train
                 raise NotImplementedError(
-                    "booster=gblinear does not support external-memory "
-                    "(paged) matrices; train on a resident DMatrix")
-            X = np.nan_to_num(np.asarray(dm_x, dtype=np.float32), nan=0.0)
-            state["linear_X"] = jnp.asarray(X)
+                    "booster=gblinear needs a resident matrix or an "
+                    "external-memory QuantileDMatrix")
+            else:
+                X = np.nan_to_num(np.asarray(dm_x, dtype=np.float32),
+                                  nan=0.0)
+                state["linear_X"] = jnp.asarray(X)
         return state["linear_X"]
 
     def do_boost(self, state: dict, gpair, iteration, key, obj=None,
                  margin=None):
+        paged = self._paged_binned(state)
+        if paged is not None:
+            return self._do_boost_paged(state, paged, gpair)
         X = self._X_of(state)
         if self.W is None:
             self.W = jnp.zeros((X.shape[1], self.n_groups), jnp.float32)
@@ -135,7 +236,63 @@ class GBLinear:
         self.rounds += 1
         return delta
 
+    def _cuts_of(self, state: dict, binned):
+        if "linear_cuts" not in state:
+            state["linear_cuts"] = _cut_arrays(binned)
+        return state["linear_cuts"]
+
+    def _do_boost_paged(self, state: dict, binned, gpair):
+        """One shotgun round streamed over host-resident pages: bias step
+        from the (page-free) device gradient sums, then ONE page sweep
+        accumulating the per-feature gradient sums G/H, the fused
+        soft-threshold weight move, and a second sweep writing the margin
+        delta. Multi-host external memory: G/H and the bias sums cross
+        hosts through the communicator, so every rank applies identical
+        weight moves to replicated weights while streaming only ITS row
+        shard (the same sync shape as the paged tree tier's per-level
+        histogram allreduce)."""
+        from ..tree.paged import _host_allreduce
+
+        n, K = gpair.shape[0], gpair.shape[1]
+        F = binned.n_features
+        if self.W is None:
+            self.W = jnp.zeros((F, K), jnp.float32)
+            self.bias = jnp.zeros((K,), jnp.float32)
+        arrs = self._cuts_of(state, binned)
+        gsum = _host_allreduce(jnp.sum(gpair[..., 0], axis=0))
+        hsum = _host_allreduce(jnp.sum(gpair[..., 1], axis=0))
+        dbias = -gsum / jnp.maximum(hsum, 1e-10) * self.eta
+        G = jnp.zeros((F, K), jnp.float32)
+        H = jnp.zeros((F, K), jnp.float32)
+        for s, e, page in binned.pages():
+            pg, ph = _page_gh(binned.decode_page(page), gpair[s:e], dbias,
+                              *arrs)
+            G = G + pg
+            H = H + ph
+        G = _host_allreduce(G)
+        H = _host_allreduce(H)
+        dW = _shotgun_dw(G, H, self.W, eta=self.eta, lam=self.reg_lambda,
+                         alpha=self.reg_alpha)
+        self.W = self.W + dW
+        self.bias = self.bias + dbias
+        delta = jnp.zeros((n, K), jnp.float32)
+        for s, e, page in binned.pages():
+            delta = _page_delta(delta, binned.decode_page(page),
+                                jnp.int32(s), dW, dbias, *arrs)
+        self.rounds += 1
+        return delta
+
     def compute_margin(self, state: dict):
+        paged = self._paged_binned(state)
+        if paged is not None:
+            if self.W is None:
+                return state["base"]
+            arrs = self._cuts_of(state, paged)
+            m = jnp.zeros(state["base"].shape, jnp.float32)
+            for s, e, page in paged.pages():
+                m = _page_delta(m, paged.decode_page(page), jnp.int32(s),
+                                self.W, self.bias, *arrs)
+            return state["base"] + m
         X = self._X_of(state)
         if self.W is None:
             return state["base"]

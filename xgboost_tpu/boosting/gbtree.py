@@ -338,18 +338,43 @@ class GBTree:
                     from ..data.binned import BinnedMatrix
                     from ..data.quantile import sketch_matrix
 
-                    if getattr(dm.X, "is_paged", False) \
-                            or np.ndim(dm.X) != 2:
-                        raise NotImplementedError(
-                            "tree_method=approx re-sketches the raw "
-                            "matrix every iteration and does not support "
-                            "external-memory (paged) matrices; use "
-                            "tree_method=hist")
                     w = np.asarray(gpair[:, k, 1], np.float64)
-                    cuts = sketch_matrix(np.asarray(dm.X),
-                                         self.tree_param.max_bin, w,
-                                         info.feature_types)
-                    binned = BinnedMatrix.from_dense(np.asarray(dm.X), cuts)
+                    src = getattr(dm, "_binned", None)
+                    if dm.X is None and getattr(src, "is_paged", False):
+                        # external memory: re-sketch from the page
+                        # iterator (hessian-weighted, cross-host merge
+                        # under a communicator) and hand the re-binned
+                        # pages to the paged hist driver — the reference
+                        # GlobalApproxUpdater trains from GetBatches the
+                        # same way (src/tree/updater_approx.cc)
+                        if self.mesh is not None:
+                            raise NotImplementedError(
+                                "tree_method=approx over external-memory "
+                                "pages supports row split without a "
+                                "device mesh (single- or multi-host)")
+                        binned = src.resketch(self.tree_param.max_bin, w,
+                                              info.feature_types)
+                        cuts = binned.cuts
+                    elif dm.X is None and src is not None:
+                        # iterator-built resident matrix: raw floats were
+                        # never retained; sketch the representative cut
+                        # values the quantized matrix reconstructs — the
+                        # same operands the paged path sketches page-wise
+                        vals = np.asarray(src.to_values())
+                        cuts = sketch_matrix(vals, self.tree_param.max_bin,
+                                             w, info.feature_types)
+                        binned = BinnedMatrix.from_dense(vals, cuts)
+                    else:
+                        if np.ndim(dm.X) != 2:
+                            raise NotImplementedError(
+                                "tree_method=approx needs a dense raw "
+                                "matrix or an iterator-built "
+                                "QuantileDMatrix")
+                        cuts = sketch_matrix(np.asarray(dm.X),
+                                             self.tree_param.max_bin, w,
+                                             info.feature_types)
+                        binned = BinnedMatrix.from_dense(np.asarray(dm.X),
+                                                         cuts)
                 if self.split_mode == "col" and self.mesh is not None:
                     # column-split mesh: the re-sketched matrix lands
                     # feature-sharded exactly like the hist training state
@@ -366,7 +391,12 @@ class GBTree:
                 # when the compiled shapes are unchanged; categorical split
                 # sets depend on the cuts, so those rebuild
                 g = self._grower
+                # paged growers cannot be reused across re-sketches: their
+                # _LevelEvaluator bakes the per-feature real-bin counts
+                # into its jitted closures as trace constants, and a new
+                # sketch changes them
                 if (g is not None and g.max_nbins == binned.max_nbins
+                        and not getattr(binned, "is_paged", False)
                         and g.cat is None and not cuts.is_cat().any()):
                     # pending trees still reference this grower's cuts for
                     # their raw thresholds — materialise them first
@@ -580,14 +610,16 @@ class GBTree:
             world = self.mesh.shape.get(DATA_AXIS, 1)
             outs = []
             for _, page in binned.pages_sharded(self.mesh, DATA_AXIS):
-                m, _ = pred.margin_binned(page, binned.missing_bin, base)
+                m, _ = pred.margin_binned(binned.decode_page(page),
+                                          binned.missing_bin, base)
                 outs.append(m.reshape(world, -1, m.shape[-1]))
             full = jnp.concatenate(outs, axis=1).reshape(
                 -1, outs[0].shape[-1])
             return full[:binned.n_rows]
         outs = []
         for _, _, page in binned.pages():
-            m, _ = pred.margin_binned(page, binned.missing_bin, base)
+            m, _ = pred.margin_binned(binned.decode_page(page),
+                                      binned.missing_bin, base)
             outs.append(m)
         return jnp.concatenate(outs)
 

@@ -360,7 +360,8 @@ class Booster:
                 updater=self.learner_params.get("updater", "shotgun"),
                 reg_lambda=lam, reg_alpha=alpha, eta=self.tree_param.eta,
                 feature_selector=self.learner_params.get(
-                    "feature_selector", "cyclic"))
+                    "feature_selector", "cyclic"),
+                mesh=self.ctx.mesh)
         from .tree.param import (parse_interaction_constraints,
                                  parse_monotone_constraints)
 
@@ -517,8 +518,15 @@ class Booster:
                 # approx re-sketches per iteration and exact rank-encodes
                 # losslessly — neither trains against a shared binned matrix,
                 # so margins always walk raw thresholds (binned=None).
+                # approx over an iterator-built PAGED matrix DOES sync under
+                # a communicator (per-iteration sketch merge + the paged
+                # hist driver's per-level allreduce), so it passes the
+                # row-comm check like the hist paged tier; exact still
+                # refuses (it rejects paged matrices outright in do_boost).
                 binned = None
-                self._check_row_comm_sync(paged=False)
+                self._check_row_comm_sync(paged=(
+                    tm == "approx" and getattr(
+                        getattr(dm, "_binned", None), "is_paged", False)))
             elif is_train:
                 binned = dm.binned(self.tree_param.max_bin)
                 if self.ctx.mesh is not None:
@@ -549,12 +557,15 @@ class Booster:
             # continuation on a persistent booster) must still refuse
             # silently-local resident training — including a matrix the
             # paged collapse already swapped for a resident one. approx/
-            # exact entries carry binned=None, so is_paged resolves False
-            # and the same check refuses them too (the build-time path at
-            # the approx/exact branch above already did — the re-check
-            # must protect the same set of methods)
-            self._check_row_comm_sync(paged=getattr(
-                self._caches[key]["binned"], "is_paged", False))
+            # exact entries carry binned=None, so the re-check consults
+            # the DMatrix's own quantized form like the build-time path:
+            # approx over ITERATOR-PAGED data syncs (sketch merge + paged
+            # hist allreduce) and passes; everything else with binned=None
+            # still refuses
+            self._check_row_comm_sync(paged=(
+                getattr(self._caches[key]["binned"], "is_paged", False)
+                or (tm == "approx" and getattr(
+                    getattr(dm, "_binned", None), "is_paged", False))))
         return self._caches[key]
 
     def _collapse_paged_if_fits(self, binned):

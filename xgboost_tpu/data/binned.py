@@ -334,6 +334,8 @@ class PagedBinnedMatrix:
     is_paged = True
 
     def __post_init__(self) -> None:
+        import os
+
         self._device_cache: dict = {}
         self._mesh_cache: dict = {}
         self._resident = None  # built by resident_binned() when under budget
@@ -341,18 +343,53 @@ class PagedBinnedMatrix:
         # wall time the worker thread spent inside device_put uploads,
         # blocked_s = wall time the CONSUMER waited on those uploads.
         # overlap = 1 - blocked/upload is the fraction of H2D hidden
-        # behind compute; tools/bench_paged.py reports it. Reset with
-        # reset_ring_stats() around the window being measured.
+        # behind compute; bytes counts the H2D payload actually shipped
+        # (packed bytes under compressed transport), which
+        # tools/bench_paged.py and bench.py turn into uploads/round and
+        # matrix-equivalents. Reset with reset_ring_stats() around the
+        # window being measured.
         self.ring_stats: dict = {"upload_s": 0.0, "blocked_s": 0.0,
-                                 "uploads": 0}
+                                 "uploads": 0, "bytes": 0}
         if self.cache_budget_bytes < 0:
-            import os
-
             self.cache_budget_bytes = int(os.environ.get(
                 "XTPU_PAGE_CACHE_BYTES", 4 << 30))
+        # Compressed page transport (XTPU_PAGE_PACK, default on): with
+        # max_nbins <= 16 every bin id fits 4 bits, so pages ship (and
+        # cache in HBM) as two-ids-per-byte u8 — half the H2D bytes and
+        # half the page-cache footprint. Kernels decode in-trace
+        # (ops/histogram.py unpack_u4; the Pallas int8 kernel decodes
+        # nibbles in VMEM), bit-exact with the unpacked transport.
+        self.packed = (os.environ.get("XTPU_PAGE_PACK", "1") != "0"
+                       and self.max_nbins <= 16
+                       and self.bins_host.dtype == np.uint8)
+        # prefetch ring depth: pages queued ahead of the consumer (the
+        # uploads themselves serialize on one tunnel; depth > 1 keeps the
+        # queue full across bursty per-page compute)
+        self.ring_depth = max(1, int(os.environ.get("XTPU_PAGE_RING", 3)))
 
     def reset_ring_stats(self) -> None:
-        self.ring_stats.update(upload_s=0.0, blocked_s=0.0, uploads=0)
+        self.ring_stats.update(upload_s=0.0, blocked_s=0.0, uploads=0,
+                               bytes=0)
+
+    @staticmethod
+    def _pack_host(arr: np.ndarray) -> np.ndarray:
+        """u4-pack a host page along the feature axis: byte w = feature 2w
+        (low nibble) | feature 2w+1 << 4; odd F pads one zero column."""
+        if arr.shape[1] % 2:
+            arr = np.concatenate(
+                [arr, np.zeros((arr.shape[0], 1), arr.dtype)], axis=1)
+        return (arr[:, 0::2] | (arr[:, 1::2] << 4)).astype(np.uint8)
+
+    def decode_page(self, page):
+        """Device-side decode of one (possibly packed) page back to [p, F]
+        bin ids — for consumers outside the training kernels (paged
+        prediction walk, resident collapse); kernel bodies inline the same
+        unpack in-trace."""
+        if not self.packed:
+            return page
+        from ..ops.histogram import unpack_u4
+
+        return unpack_u4(page, self.n_features)
 
     def streaming_overlap(self) -> Optional[float]:
         """Fraction of page-upload time hidden behind compute since the
@@ -396,19 +433,25 @@ class PagedBinnedMatrix:
         e = min(s + self.page_rows, self.n_rows)
         cached = self._device_cache.get(s)  # holds (e, page) ring payloads
         uploaded = cached is None
-        page = (jax.device_put(
-            np.ascontiguousarray(self.bins_host[s:e]), device)
-            if uploaded else cached[1])
+        if uploaded:
+            host = np.ascontiguousarray(self.bins_host[s:e])
+            if self.packed:
+                host = self._pack_host(host)
+            page = jax.device_put(host, device)
+        else:
+            page = cached[1]
         return s, e, page, uploaded
 
     def _ring(self, starts, fetch, cache, page_bytes):
         """The shared prefetch ring: cached pages yield straight from HBM;
-        pages past the cache budget upload per visit with one page of
-        lookahead (``jax.device_put`` blocks over remote-device tunnels,
-        so the upload of page k+1 rides on a worker thread while the
-        consumer computes on page k). ``fetch(start)`` returns
-        ``(key, payload, uploaded)``; uploaded pages cache under the HBM
-        budget."""
+        pages past the cache budget upload per visit with ``ring_depth``
+        pages of lookahead (``jax.device_put`` blocks over remote-device
+        tunnels, so uploads ride a worker thread while the consumer
+        computes; a depth-3 queue keeps the tunnel busy across bursty
+        per-page compute where one-ahead drained dry). ``fetch(start)``
+        returns ``(key, payload, uploaded, nbytes)``; uploaded pages
+        cache under the HBM budget."""
+        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         # streaming re-engaging (mesh train, XTPU_PAGED_COLLAPSE flipped,
@@ -430,25 +473,38 @@ class PagedBinnedMatrix:
             if out[2]:  # uploaded (not a cache hit)
                 stats["upload_s"] += _time.perf_counter() - t0
                 stats["uploads"] += 1
+                stats["bytes"] += out[3]
             return out
 
+        depth = self.ring_depth
         with ThreadPoolExecutor(1) as ex:
-            fut = ex.submit(timed_fetch, starts[0])
+            pending = deque(ex.submit(timed_fetch, s)
+                            for s in starts[:depth])
             for i in range(len(starts)):
                 t0 = _time.perf_counter()
-                key, payload, uploaded = fut.result()
+                key, payload, uploaded, _ = pending.popleft().result()
                 if uploaded:  # consumer stalled on an in-flight upload
                     stats["blocked_s"] += _time.perf_counter() - t0
-                if i + 1 < len(starts):
-                    fut = ex.submit(timed_fetch, starts[i + 1])
+                if i + depth < len(starts):
+                    pending.append(ex.submit(timed_fetch,
+                                             starts[i + depth]))
                 if uploaded and len(cache) < max_cached:
                     cache[key] = payload
                 yield key, payload
 
     def pages(self, device=None):
-        """(start, end, device_page) triples through the prefetch ring."""
+        """(start, end, device_page) triples through the prefetch ring.
+        Pages arrive in TRANSPORT layout — u4-packed under compressed
+        transport; consumers outside the kernel bodies decode with
+        ``decode_page``."""
         yield from self.stream_pages(
             list(range(0, self.n_rows, self.page_rows)), device)
+
+    def page_nbytes(self) -> int:
+        """HBM/H2D bytes of one full page in transport layout."""
+        f_eff = ((self.n_features + 1) // 2 if self.packed
+                 else self.n_features)
+        return self.page_rows * f_eff * self.bins_host.dtype.itemsize
 
     def stream_pages(self, starts, device=None):
         """(start, end, device_page) for the given page starts, through
@@ -456,12 +512,11 @@ class PagedBinnedMatrix:
         cache under the budget)."""
         if not starts or self.n_rows == 0:
             return
-        page_bytes = (self.page_rows * self.n_features
-                      * self.bins_host.dtype.itemsize)
+        page_bytes = self.page_nbytes()
 
         def fetch(s):
             s, e, page, uploaded = self._fetch(s, device)
-            return s, (e, page), uploaded
+            return s, (e, page), uploaded, page.nbytes
 
         for s, (e, page) in self._ring(starts, fetch, self._device_cache,
                                        page_bytes):
@@ -524,6 +579,7 @@ class PagedBinnedMatrix:
             got_page = False
             for s, e, p in self.pages():
                 got_page = True
+                p = self.decode_page(p)  # packed transport -> [p, F] ids
                 if bins is None:
                     bins = jnp.zeros((self.n_rows, self.n_features),
                                      p.dtype)
@@ -598,13 +654,16 @@ class PagedBinnedMatrix:
                     g1 = min(g0 + p_loc, n)
                     if g1 > g0:
                         block[d, : g1 - g0] = self.bins_host[g0:g1]
-                page = jax.device_put(block.reshape(world * p_loc, F),
-                                      sharding)
-            return s_loc, page, uploaded
+                flat = block.reshape(world * p_loc, F)
+                if self.packed:
+                    flat = self._pack_host(flat)
+                page = jax.device_put(flat, sharding)
+            return s_loc, page, uploaded, page.nbytes
 
+        f_eff = (F + 1) // 2 if self.packed else F
         yield from self._ring(
             starts, fetch, self._mesh_cache,
-            world * p_loc * F * self.bins_host.dtype.itemsize)
+            world * p_loc * f_eff * self.bins_host.dtype.itemsize)
 
     def cached_split_mesh(self, world: int):
         """``(cached, streamed)`` for the mesh page stream: ``cached`` =
@@ -620,21 +679,73 @@ class PagedBinnedMatrix:
                 cached.append((s, page))
         return cached, streamed
 
-    def to_values_host(self) -> np.ndarray:
-        """Representative feature values from bin ids, page-wise on host
-        (the raw matrix was never retained)."""
+    def _values_page(self, s: int) -> np.ndarray:
+        """Representative feature values of one HOST page (NaN missing)."""
         cuts = self.cuts
         ptrs = np.asarray(cuts.ptrs[:-1], np.int64)
         vals = np.asarray(cuts.values, np.float32)
         n_real = np.asarray(self.n_real_bins())
+        local = np.asarray(self.bins_host[s:s + self.page_rows], np.int64)
+        miss = local >= n_real[None, :]
+        gb = np.clip(ptrs[None, :] + np.minimum(local, n_real - 1), 0,
+                     len(vals) - 1)
+        page = vals[gb]
+        page[miss] = np.nan
+        return page
+
+    def to_values_host(self) -> np.ndarray:
+        """Representative feature values from bin ids, page-wise on host
+        (the raw matrix was never retained)."""
         out = np.empty((self.n_rows, self.n_features), np.float32)
         for s in range(0, self.n_rows, self.page_rows):
-            local = np.asarray(self.bins_host[s:s + self.page_rows],
-                               np.int64)
-            miss = local >= n_real[None, :]
-            gb = np.clip(ptrs[None, :] + np.minimum(local, n_real - 1), 0,
-                         len(vals) - 1)
-            page = vals[gb]
-            page[miss] = np.nan
-            out[s:s + local.shape[0]] = page
+            page = self._values_page(s)
+            out[s:s + page.shape[0]] = page
         return out
+
+    def resketch(self, max_bin: int, hess: np.ndarray,
+                 feature_types=None) -> "PagedBinnedMatrix":
+        """Fresh hessian-weighted quantization FROM THE PAGE ITERATOR —
+        what ``tree_method=approx`` does every iteration (reference
+        ``GlobalApproxUpdater``, ``src/tree/updater_approx.cc:55``):
+        page-wise per-feature summaries merge exactly like iterator
+        ingestion (``DMatrix._init_from_iter``), the cross-worker summary
+        merge runs when a communicator is active (reference sketch sync,
+        ``src/common/quantile.cc:147-276``), and the pages re-bin page by
+        page into a new host-resident matrix for the paged hist driver.
+        Raw floats were never retained, so the sketch runs over the
+        representative cut values of the CURRENT quantization — the same
+        values approx walks on any iterator-built matrix. Host memory
+        peaks at one page of f32 values."""
+        from ..parallel import collective as _collective
+        from .quantile import FeatureSummary, cuts_from_summaries
+
+        F = self.n_features
+        n = self.n_rows
+        summaries = None
+        for s in range(0, n, self.page_rows):
+            vals = self._values_page(s)
+            if not vals.shape[0]:
+                continue
+            w = np.asarray(hess[s:s + vals.shape[0]], np.float64)
+            batch = [FeatureSummary.from_data(vals[:, f], w)
+                     for f in range(F)]
+            if summaries is None:
+                summaries = batch
+            else:
+                summaries = [a.merge(b).prune(max_bin * 8)
+                             for a, b in zip(summaries, batch)]
+        if _collective.get_communicator().is_distributed():
+            summaries = _collective.merge_summaries(summaries or [],
+                                                    max_bin)
+        cuts = cuts_from_summaries(summaries or [], max_bin, feature_types)
+        max_nbins = (int(cuts.n_real_bins().max(initial=0))
+                     + int(self.has_missing))
+        out = np.empty((n, F), _dtype_for(max(max_nbins - 1, 0)))
+        for s in range(0, n, self.page_rows):
+            vals = self._values_page(s)
+            search_bin_into(vals, cuts, max_nbins - 1,
+                            out[s:s + vals.shape[0]])
+        return PagedBinnedMatrix(
+            bins_host=out, cuts=cuts, max_nbins=max_nbins,
+            has_missing=self.has_missing, page_rows=self.page_rows,
+            cache_budget_bytes=self.cache_budget_bytes)

@@ -27,6 +27,22 @@ import jax
 import jax.numpy as jnp
 
 
+def unpack_u4(packed: jnp.ndarray, n_features: int) -> jnp.ndarray:
+    """Decode a u4-packed bin page (compressed page transport,
+    ``XTPU_PAGE_PACK``): byte ``[r, w]`` holds feature ``2w`` in its low
+    nibble and feature ``2w+1`` in its high nibble, so a ``[p, ceil(F/2)]``
+    uint8 page expands to the original ``[p, F]`` bin ids. Pure integer
+    unpack — bit-exact with the unpacked transport — shared by every lax
+    consumer (paged kernel bodies, paged prediction, resident collapse);
+    the Pallas int8 kernel carries its own in-VMEM decode
+    (``build_hist_pallas(packed_u4=...)``) so the packed page is the only
+    HBM-resident copy on that path."""
+    lo = packed & jnp.uint8(0x0F)
+    hi = packed >> jnp.uint8(4)
+    out = jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+    return out[:, :n_features]
+
+
 def build_hist_segment(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                        n_nodes: int, max_nbins: int) -> jnp.ndarray:
     """Scatter-add histogram.
@@ -158,11 +174,29 @@ def build_hist_prehot(oh_pre: jnp.ndarray, gpair: jnp.ndarray,
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "max_nbins", "method",
-                                   "block_rows", "axis_name"))
+                                   "block_rows", "axis_name", "packed_u4"))
 def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                n_nodes: int, max_nbins: int, method: str = "auto",
                block_rows: int = 1 << 16,
-               bins_t: jnp.ndarray = None, axis_name=None) -> jnp.ndarray:
+               bins_t: jnp.ndarray = None, axis_name=None,
+               packed_u4: int = 0) -> jnp.ndarray:
+    if packed_u4:
+        # ``bins`` is a u4-packed [n, ceil(F/2)] page (packed_u4 = logical
+        # F). The Pallas path decodes nibbles in-VMEM inside the kernel's
+        # feature loop; every lax formulation decodes in-trace here (XLA
+        # fuses the unpack into the consumer's read).
+        if method.startswith("pallas") or (
+                method == "auto" and jax.default_backend() == "tpu"
+                and n_nodes <= 128):
+            from .pallas.histogram import build_hist_pallas
+
+            precision = method.split(":", 1)[1] if ":" in method else "int8x2"
+            return build_hist_pallas(
+                bins.T, gpair, rel_pos, n_nodes, max_nbins,
+                precision=precision, axis_name=axis_name,
+                packed_u4=packed_u4)
+        bins = unpack_u4(bins, packed_u4)
+        bins_t = None
     if method in ("coarse", "fused"):
         raise ValueError(
             f"hist_method='{method}' runs inside the depthwise scalar "

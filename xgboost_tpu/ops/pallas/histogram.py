@@ -59,8 +59,18 @@ def _round_up(x: int, m: int) -> int:
 _CONTRACT_LAST = (((1,), (1,)), ((), ()))  # oh [M, R] . P^T [K, R] -> [M, K]
 
 
+def _u4_row(bins_ref, f):
+    """Feature ``f``'s bin ids from a u4-packed ``[ceil(F/2), R]`` block:
+    byte row ``f // 2``, low nibble for even features, high for odd — the
+    in-VMEM decode of the compressed page transport (the packed page is
+    the only HBM-resident copy; each nibble extract is one VPU shift+mask
+    against the same resident byte row)."""
+    word = bins_ref[f // 2:f // 2 + 1, :].astype(jnp.int32)
+    return (word >> (4 * (f % 2))) & 0x0F
+
+
 def _make_kernel(n_feat_block: int, n_bins: int, n_nodes: int, block_rows: int,
-                 precision: str):
+                 precision: str, u4: bool = False):
     B, N, R, Fb = n_bins, n_nodes, block_rows, n_feat_block
     oh_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
     mxu_prec = (jax.lax.Precision.HIGHEST if precision == "f32"
@@ -91,7 +101,8 @@ def _make_kernel(n_feat_block: int, n_bins: int, n_nodes: int, block_rows: int,
 
         bin_iota = jax.lax.broadcasted_iota(jnp.int32, (B, R), 0)
         for f in range(Fb):
-            row = bins_ref[f:f + 1, :].astype(jnp.int32)   # [1, R]
+            row = (_u4_row(bins_ref, f) if u4
+                   else bins_ref[f:f + 1, :].astype(jnp.int32))  # [1, R]
             oh_scratch[f * B:(f + 1) * B, :] = (
                 bin_iota == row).astype(oh_dtype)
         acc = jnp.zeros((Fb * B, 2 * N), jnp.float32)
@@ -105,7 +116,8 @@ def _make_kernel(n_feat_block: int, n_bins: int, n_nodes: int, block_rows: int,
 
 
 def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
-                      block_rows: int, packed: bool = False):
+                      block_rows: int, packed: bool = False,
+                      u4: bool = False):
     """Fixed-point kernel: gradients arrive as two int8 byte planes
     (value = hi * 256 + lo, a 15-bit quantisation done by the caller);
     both planes are contracted with the 0/1 one-hot on the int8 MXU with
@@ -177,12 +189,14 @@ def _make_int8_kernel(n_feat_block: int, n_bins: int, n_nodes: int,
             bin_iota = jax.lax.broadcasted_iota(jnp.int32, (B, R), 0)
         for f in range(Fb):
             if packed:
-                row = bins_ref[f:f + 1, :].astype(jnp.uint32)  # [1, R]
+                row = (_u4_row(bins_ref, f).astype(jnp.uint32) if u4
+                       else bins_ref[f:f + 1, :].astype(jnp.uint32))
                 x = K4 ^ (row * jnp.uint32(0x01010101))        # [B/4, R]
                 y = (~(((x & M7F) + M7F) | x | M7F)) >> jnp.uint32(7)
                 oh = pltpu.bitcast(y, jnp.int8)                # [B, R]
             else:
-                row = bins_ref[f:f + 1, :].astype(jnp.int32)   # [1, R]
+                row = (_u4_row(bins_ref, f) if u4
+                       else bins_ref[f:f + 1, :].astype(jnp.int32))
                 oh = (bin_iota == row).astype(jnp.int8)        # [B, R]
             acc4 = jax.lax.dot_general(
                 oh, PT4, _CONTRACT_LAST,
@@ -356,16 +370,19 @@ def fused_advance_coarse_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
 @functools.partial(
     jax.jit,
     static_argnames=("n_nodes", "max_nbins", "precision", "block_rows",
-                     "feat_block", "interpret", "axis_name"))
+                     "feat_block", "interpret", "axis_name", "packed_u4"))
 def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
                       rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
                       precision: str = "int8x2", block_rows: int = 2048,
                       feat_block: Optional[int] = None,
                       interpret: bool = False,
-                      axis_name=None) -> jnp.ndarray:
+                      axis_name=None, packed_u4: int = 0) -> jnp.ndarray:
     """Fused histogram kernel.
 
     bins_t: [F, n] local bin ids (any int dtype), missing at max_nbins - 1
+        — or, with ``packed_u4 = F``, a u4-packed ``[ceil(F/2), n]`` uint8
+        page (compressed page transport): nibbles decode in-VMEM inside
+        the feature loop, so the packed page is the only HBM copy
     gpair: [n, 2] f32
     rel_pos: [n] int32 in [0, n_nodes]; n_nodes means "inactive row"
     axis_name: mesh axis carrying row shards — the int8x2 quantisation
@@ -373,7 +390,15 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
         N-chip histograms reproduce the 1-chip run bit-for-bit
     -> [n_nodes, F, max_nbins, 2] f32
     """
-    F, n = bins_t.shape
+    u4 = bool(packed_u4)
+    if u4:
+        F, n = packed_u4, bins_t.shape[1]
+        # packed transport exists for max_nbins <= 16, so the whole-F
+        # accumulator [F, B, 2N] is far inside the VMEM budget — one
+        # feature block, no F padding, nibble rows addressed in-kernel
+        feat_block = F
+    else:
+        F, n = bins_t.shape
     B, N = max_nbins, n_nodes
 
     if precision == "bf16x2":
@@ -413,7 +438,8 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
     F_blk = min(feat_block, F)
     F_pad = _round_up(F, F_blk)
     if n_pad != n or F_pad != F:
-        bins_t = jnp.pad(bins_t, ((0, F_pad - F), (0, n_pad - n)))
+        bins_t = jnp.pad(bins_t, ((0, 0 if u4 else F_pad - F),
+                                  (0, n_pad - n)))
         gpair = jnp.pad(gpair, ((0, n_pad - n), (0, 0)))
         rel_pos = jnp.pad(rel_pos, (0, n_pad - n),
                           constant_values=n_nodes)  # padded rows inactive
@@ -422,7 +448,9 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
     pos_t = rel_pos.astype(jnp.int32)[None, :]       # [1, n]
     grid = (F_pad // F_blk, n_pad // R)
 
-    bins_spec = pl.BlockSpec((F_blk, R), lambda j, i: (j, i),
+    bins_rows = bins_t.shape[0]                      # ceil(F/2) when u4
+    bins_spec = pl.BlockSpec((bins_rows if u4 else F_blk, R),
+                             lambda j, i: (j, i),
                              memory_space=pltpu.VMEM)
     vec2_spec = pl.BlockSpec((2, R), lambda j, i: (0, i),
                              memory_space=pltpu.VMEM)
@@ -445,7 +473,7 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
         # to the compare build
         packed = B % 4 == 0 and B <= 256
         out = pl.pallas_call(
-            _make_int8_kernel(F_blk, B, N, R, packed=packed),
+            _make_int8_kernel(F_blk, B, N, R, packed=packed, u4=u4),
             out_shape=out_shape,
             grid=grid,
             in_specs=[bins_spec, vec2_spec, pos_spec],
@@ -458,7 +486,7 @@ def build_hist_pallas(bins_t: jnp.ndarray, gpair: jnp.ndarray,
         out = out * inv
     else:
         out = pl.pallas_call(
-            _make_kernel(F_blk, B, N, R, precision),
+            _make_kernel(F_blk, B, N, R, precision, u4=u4),
             out_shape=out_shape,
             grid=grid,
             in_specs=[bins_spec, vec2_spec, pos_spec],

@@ -325,6 +325,31 @@ def refine_bin_ids(bins_i32: jnp.ndarray, span_sel_i32: jnp.ndarray,
     return jnp.where(ok, rb, WINDOW + 3).astype(jnp.uint8)
 
 
+def refine_from_fine(fine: jnp.ndarray, window: jnp.ndarray,
+                     missing_bin: int) -> jnp.ndarray:
+    """Refine-pass histogram recovered by WINDOW-slicing a full fine
+    histogram — the page-major streaming schedule's replacement for the
+    second page sweep: a streamed page's single visit accumulates its
+    full ``[N, F, max_nbins, 2]`` fine partial, and once the window is
+    chosen (after the global coarse reduction) this slice stands in for
+    the direct ``refine_bin_ids`` build of the same rows.
+
+    Exactness: refine slot ``w`` of (node, feature) with window start
+    ``c`` is the sum over rows with fine bin ``16c + w`` — the SAME row
+    set, summed in the same row order, as fine bin ``16c + w`` of the
+    full build (only the segment numbering differs), so the slice is
+    bit-equal per page. Out-of-range slices (windows clamped near the
+    feature's last real coarse bin) and the missing slot — which the
+    direct build routes to the discarded pad — are zeroed."""
+    N, F, B, _ = fine.shape
+    idx = (COARSE_SPAN * window[:, :, None]
+           + jnp.arange(WINDOW, dtype=jnp.int32)[None, None, :])  # [N,F,W]
+    out = jnp.take_along_axis(fine, jnp.clip(idx, 0, B - 1)[..., None],
+                              axis=2)
+    ok = (idx < B) & (idx != missing_bin)
+    return jnp.where(ok[..., None], out, 0.0)
+
+
 def choose_refine_window(hist_c: jnp.ndarray, parent_sum: jnp.ndarray,
                          n_real_bins: jnp.ndarray, param: TrainParam,
                          has_missing: bool) -> jnp.ndarray:

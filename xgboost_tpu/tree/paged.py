@@ -86,6 +86,30 @@ def _rel_of(pos, lo, n_level, n_static):
                      n_static).astype(jnp.int32)
 
 
+def _page_packed(paged) -> bool:
+    return bool(getattr(paged, "packed", False))
+
+
+def _page_decoder(paged):
+    """In-trace decode of the page transport layout (u4 compressed
+    transport, data/binned.py) back to ``[p, F]`` bin ids — applied at the
+    top of every kernel body, so XLA fuses the nibble unpack into the
+    first consumer's read and the packed page stays the only HBM copy."""
+    if not _page_packed(paged):
+        return lambda page: page
+    F = paged.n_features
+    from ..ops.histogram import unpack_u4
+
+    return lambda page: unpack_u4(page, F)
+
+
+def _page_key(paged):
+    """Kernel-cache key bits that change a body's trace: the transport
+    layout (packed pages decode in-body) and the logical feature count
+    the decoder was built for."""
+    return (_page_packed(paged), paged.n_features)
+
+
 def _coarse_bins(page, missing_bin):
     """Coarse-pass bin ids of one page — the shared two-level mapping
     (ops/split.py coarse_bin_ids), computed in-kernel so the page streams
@@ -220,20 +244,22 @@ class _LevelEvaluator:
         self._init_fn = None
         self._win_fn = None
 
+    def _window_body(self, hc, parent):
+        """Traced refine-window choice — shared by the standalone
+        ``choose_window`` jit and the page-major whole-level program
+        (``_PageKernels.level_full``), so both paths pick bit-identical
+        windows."""
+        from ..ops.split import choose_refine_window
+
+        return choose_refine_window(hc, parent, self.n_real_d, self.param,
+                                    self.has_missing)
+
     def choose_window(self, hist_c, state):
         """Refine-window starts [n_static, F] from the GLOBAL coarse
         histogram and the carried parent sums (paged two-level histogram:
         the window choice is node-level, after the coarse page pass)."""
         if self._win_fn is None:
-            from ..ops.split import choose_refine_window
-
-            param, hm = self.param, self.has_missing
-
-            def fn(hc, parent):
-                return choose_refine_window(hc, parent, self.n_real_d,
-                                            param, hm)
-
-            self._win_fn = jax.jit(fn)
+            self._win_fn = jax.jit(self._window_body)
         return self._win_fn(hist_c, state[1])
 
     def init_state(self, root_sum):
@@ -273,8 +299,15 @@ class _LevelEvaluator:
         if self._fn is None:
             self._fn = jax.jit(self._build())
         hist = hist if isinstance(hist, tuple) else (hist,)
-        stash, state_n, feat_v, bin_v, dl_v, cs_v, ic_v, cw_v = self._fn(
-            *hist, state, tree_mask, key, depth, lo, n_level)
+        outs = self._fn(*hist, state, tree_mask, key, depth, lo, n_level)
+        return self._package(outs, lo, n_level)
+
+    def _package(self, outs, lo, n_level):
+        """Wrap the traced eval outputs into (stash, next state, prev
+        advance payload) — shared by the standalone per-level jit above
+        and the page-major whole-level program, which embeds the same
+        traced eval and returns the same output tuple."""
+        stash, state_n, feat_v, bin_v, dl_v, cs_v, ic_v, cw_v = outs
         cat_prev = None if self.cat is None else (ic_v, cw_v)
         if self.deep:
             sf, sb, dl, isf, icf, cwf = state_n[5]
@@ -465,8 +498,11 @@ class _PageKernels:
         per-page dispatch over a remote-device tunnel costs an RTT, and
         with a warm cache that latency — not H2D — was the paged tier's
         whole gap to the resident path), then the prefetch ring for the
-        cache overflow, one dispatch each with the next upload overlapped
-        one page ahead. The carry pytree is donated both ways."""
+        cache overflow, one dispatch each with uploads overlapped through
+        the depth-3 ring. Pages arrive in transport layout and decode
+        in-trace; the carry pytree is donated both ways."""
+        dec = _page_decoder(paged)
+        key = key + _page_key(paged)
         cached, streamed = paged.cached_split()
         if cached:
             def build_fused():
@@ -474,7 +510,7 @@ class _PageKernels:
 
                 def fn(carry, consts, starts, pages):
                     for st, page in zip(starts, pages):
-                        carry = body(carry, page, st, consts)
+                        carry = body(carry, dec(page), st, consts)
                     return carry
 
                 return jax.jit(fn, donate_argnums=0)
@@ -488,7 +524,7 @@ class _PageKernels:
                 body = make_body()
                 return jax.jit(
                     lambda carry, page, s, consts:
-                    body(carry, page, s, consts), donate_argnums=0)
+                    body(carry, dec(page), s, consts), donate_argnums=0)
 
             single = self._cached(key + ("single",), build_single)
             for s, e, page in paged.stream_pages(streamed):
@@ -496,14 +532,10 @@ class _PageKernels:
         return carry
 
     def level_hist(self, paged, gpair, positions, lo, n_level, n_static,
-                   multi=False, coarse=False):
-        """Histogram-only pass (the root level of each tree). With
-        ``coarse`` the pass builds the coarse histogram of the two-level
-        scheme over ``bins >> 4`` (computed in-kernel)."""
-        from ..ops.split import COARSE_B
-
-        B = COARSE_B if coarse else self.max_nbins
-
+                   multi=False):
+        """Histogram-only pass (the root level of each tree, one-pass
+        scheme; the two-level coarse scheme routes through
+        ``coarse_pass``/``refine_pass``/``level_full`` instead)."""
         def make_body():
             builder = self._builder(multi)
 
@@ -513,26 +545,21 @@ class _PageKernels:
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
                 rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
-                data = (_coarse_bins(page, self.missing_bin) if coarse
-                        else page)
-                return acc + builder(data, gp_pg, rel, n_static, B,
+                return acc + builder(page, gp_pg, rel, n_static,
+                                     self.max_nbins,
                                      method=self.hist_kernel)
 
             return body
 
-        acc = self._acc_zeros(paged, gpair, n_static, multi,
-                              nbins=B if coarse else None)
+        acc = self._acc_zeros(paged, gpair, n_static, multi)
         return self._drive(
-            paged, ("hist", n_static, multi, coarse), make_body, acc,
+            paged, ("hist", n_static, multi), make_body, acc,
             (gpair, positions, jnp.int32(lo), jnp.int32(n_level)))
 
     def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
-                 multi=False, coarse=False):
+                 multi=False):
         """The fused pass: advance rows below the PREVIOUS level's splits,
         then build THIS level's histogram — one page read per level."""
-        from ..ops.split import COARSE_B
-
-        B = COARSE_B if coarse else self.max_nbins
         kind = prev["kind"]
         cat = prev["cat"]
         n_arr = len(prev["arrs"])
@@ -554,52 +581,264 @@ class _PageKernels:
                                      self.missing_bin)
                 pos = jax.lax.dynamic_update_slice_in_dim(pos, newp, s, 0)
                 rel = _rel_of(newp, lo_d, nl_d, n_static)
-                data = (_coarse_bins(page, self.missing_bin) if coarse
-                        else page)
-                h = builder(data, gp_pg, rel, n_static, B,
+                h = builder(page, gp_pg, rel, n_static, self.max_nbins,
                             method=self.hist_kernel)
                 return pos, acc + h
 
             return body
 
-        acc = self._acc_zeros(paged, gpair, n_static, multi,
-                              nbins=B if coarse else None)
+        acc = self._acc_zeros(paged, gpair, n_static, multi)
         extra = prev["arrs"] + (() if cat is None else tuple(cat))
         consts = (gpair, jnp.int32(prev["lo"]), jnp.int32(prev["n_level"]),
                   jnp.int32(lo), jnp.int32(n_level)) + extra
         return self._drive(
-            paged, ("advhist", kind, n_static, multi, W, coarse),
+            paged, ("advhist", kind, n_static, multi, W),
             make_body, (positions, acc), consts)
 
-    def refine_hist(self, paged, gpair, positions, span, lo, n_level,
-                    n_static):
-        """Refine pass of the two-level histogram: a (WINDOW+4)-slot build
-        over each row's in-window relative bin (positions already advanced
-        by the coarse pass), summed across pages; the top 4 slots are
-        discarded out-of-window pads."""
-        from ..ops.split import WINDOW
+    # -- page-major two-level (coarse) schedule ------------------------------
+    # The r5/r6 schedule swept the data TWICE per level boundary
+    # (advance+coarse, then refine), so a forced-streaming round at depth 6
+    # re-uploaded the matrix ~13 times. Page-major: a streamed page's ONE
+    # visit per level carries the advance, the direct coarse partial, AND a
+    # full fine-histogram partial; after the (tiny) cross-page coarse
+    # reduction picks the refine window, the streamed refine contribution
+    # is a window SLICE of the fine accumulator — bit-equal to the direct
+    # refine build of the same rows (ops/split.py refine_from_fine) — so
+    # only HBM-cached pages run a second (free) sweep. Uploads/round drop
+    # from ~2*depth+1 to depth+1 matrix-equivalents before packing.
 
-        def make_body():
-            def body(acc, page, s, consts):
-                gp, pos, lo_d, nl_d, span_d = consts
+    def coarse_pass(self, paged, gpair, positions, prev, lo, n_level,
+                    n_static, cached, streamed):
+        """First sweep of a level boundary: advance below the previous
+        level's splits (when ``prev``) + the level's direct coarse
+        histogram. Cached pages run as ONE fused dispatch; streamed pages
+        upload once and also accumulate their fine partial.
+        -> (positions, hist_c, fine-or-None). The (cached, streamed)
+        partition is frozen by the caller for the whole level."""
+        from ..ops.split import COARSE_B
+
+        kind = None if prev is None else prev["kind"]
+        cat = None if prev is None else prev["cat"]
+        n_arr = 0 if prev is None else len(prev["arrs"])
+        W = None if cat is None else int(cat[1].shape[1])
+        dec = _page_decoder(paged)
+        mb = self.missing_bin
+        hk = self.hist_kernel
+
+        def make_body(fine):
+            def body(carry, page, s, consts):
+                pos, acc = carry[0], carry[1]
+                gp, lo_prev, nl_prev, lo_d, nl_d = consts[:5]
+                arrs = consts[5:5 + n_arr]
+                cat_args = consts[5 + n_arr:]
+                page = dec(page)
                 p = page.shape[0]
                 pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
                 gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
+                if kind is not None:
+                    pos_pg = _advance_rows(page, pos_pg, kind, arrs,
+                                           cat_args, lo_prev, nl_prev,
+                                           n_static, mb)
+                    pos = jax.lax.dynamic_update_slice_in_dim(pos, pos_pg,
+                                                              s, 0)
                 rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
-                rb = _refine_bins(page, rel, span_d, n_static,
-                                  self.missing_bin)
-                return acc + build_hist(rb, gp_pg, rel, n_static,
-                                        WINDOW + 4,
-                                        method=self.hist_kernel)
+                acc = acc + build_hist(_coarse_bins(page, mb), gp_pg, rel,
+                                       n_static, COARSE_B, method=hk)
+                if not fine:
+                    return pos, acc
+                af = carry[2] + build_hist(page, gp_pg, rel, n_static,
+                                           self.max_nbins, method=hk)
+                return pos, acc, af
 
             return body
 
+        consts = (gpair,
+                  jnp.int32(0 if prev is None else prev["lo"]),
+                  jnp.int32(0 if prev is None else prev["n_level"]),
+                  jnp.int32(lo), jnp.int32(n_level))
+        if prev is not None:
+            consts = consts + prev["arrs"] + (() if cat is None
+                                              else tuple(cat))
+        key = ("cpass", kind, n_static, W) + _page_key(paged)
+        carry = (positions,
+                 self._acc_zeros(paged, gpair, n_static, False,
+                                 nbins=COARSE_B))
+        if cached:
+            def build_fused():
+                body = make_body(False)
+
+                def fn(carry, consts, starts, pages):
+                    for st, page in zip(starts, pages):
+                        carry = body(carry, page, st, consts)
+                    return carry
+
+                return jax.jit(fn, donate_argnums=0)
+
+            fused = self._cached(key + ("fused",), build_fused)
+            carry = fused(carry, consts,
+                          tuple(jnp.int32(s) for s, _, _ in cached),
+                          tuple(p for _, _, p in cached))
+        fine = None
+        if streamed:
+            carry = carry + (self._acc_zeros(paged, gpair, n_static,
+                                             False),)
+
+            def build_single():
+                return jax.jit(make_body(True), donate_argnums=0)
+
+            single = self._cached(key + ("single",), build_single)
+            for s, e, page in paged.stream_pages(streamed):
+                carry = single(carry, page, jnp.int32(s), consts)
+            fine = carry[2]
+        return carry[0], carry[1], fine
+
+    def refine_pass(self, paged, gpair, positions, span, lo, n_level,
+                    n_static, cached, fine=None):
+        """Second sweep of a coarse-mode level: direct refine build over
+        the level's CACHED pages only (HBM re-reads, no H2D) plus the
+        window slice of the streamed pages' fine accumulator — streamed
+        pages are never re-uploaded."""
+        from ..ops.split import WINDOW, refine_from_fine
+
+        dec = _page_decoder(paged)
+        mb = self.missing_bin
+        hk = self.hist_kernel
         acc = self._acc_zeros(paged, gpair, n_static, False,
                               nbins=WINDOW + 4)
-        acc = self._drive(
-            paged, ("rhist", n_static), make_body, acc,
-            (gpair, positions, jnp.int32(lo), jnp.int32(n_level), span))
-        return acc[:, :, :WINDOW, :]
+        key = ("rpass", n_static) + _page_key(paged)
+        if cached:
+            def build_fused():
+                def body(acc, page, s, consts):
+                    gp, pos, lo_d, nl_d, span_d = consts
+                    page = dec(page)
+                    p = page.shape[0]
+                    pos_pg = jax.lax.dynamic_slice_in_dim(pos, s, p)
+                    gp_pg = jax.lax.dynamic_slice_in_dim(gp, s, p)
+                    rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                    rb = _refine_bins(page, rel, span_d, n_static, mb)
+                    return acc + build_hist(rb, gp_pg, rel, n_static,
+                                            WINDOW + 4, method=hk)
+
+                def fn(acc, consts, starts, pages):
+                    for st, page in zip(starts, pages):
+                        acc = body(acc, page, st, consts)
+                    return acc
+
+                return jax.jit(fn, donate_argnums=0)
+
+            fused = self._cached(key, build_fused)
+            acc = fused(acc,
+                        (gpair, positions, jnp.int32(lo),
+                         jnp.int32(n_level), span),
+                        tuple(jnp.int32(s) for s, _, _ in cached),
+                        tuple(p for _, _, p in cached))
+        if fine is None:
+            return acc[:, :, :WINDOW, :]
+
+        def build_combine():
+            # no donation: the combined output is a SLICE of the direct
+            # accumulator's shape, so the donated buffer could never be
+            # reused anyway
+            return jax.jit(
+                lambda acc, fine, span_d:
+                acc[:, :, :WINDOW, :] + refine_from_fine(fine, span_d, mb))
+
+        return self._cached(("rslice", n_static), build_combine)(
+            acc, fine, span)
+
+    def level_full(self, paged, gpair, positions, prev, lo, n_level,
+                   n_static, ev, state, tree_mask, key, depth, cached):
+        """The all-cached page-major fast path: ONE jitted dispatch runs
+        the whole level boundary — advance below the previous level's
+        splits, the coarse (or one-pass full-width) histogram over every
+        HBM-cached page, the refine-window choice, the refine build, and
+        the split evaluation / carried-state update — with ``lo`` /
+        ``n_level`` / ``depth`` traced so a single compiled program
+        serves every level of every tree. This is what closes the
+        dispatch-granularity gap of the r5/r6 streaming tier against a
+        remote device: ~4 kernel dispatches plus an eval dispatch per
+        level collapse into one program launch per level.
+        -> (positions, stash, next_state, prev-dict)."""
+        from ..ops.split import COARSE_B, WINDOW
+
+        coarse = ev.coarse
+        kind = None if prev is None else prev["kind"]
+        cat = None if prev is None else prev["cat"]
+        n_arr = 0 if prev is None else len(prev["arrs"])
+        W = None if cat is None else int(cat[1].shape[1])
+        dec = _page_decoder(paged)
+        mb = self.missing_bin
+        hk = self.hist_kernel
+        F = paged.n_features
+        B = COARSE_B if coarse else self.max_nbins
+
+        def build():
+            eval_fn = ev._build()
+
+            def fn(positions, state, tree_mask, keyv, consts, starts,
+                   pages):
+                gp, lo_prev, nl_prev, lo_d, nl_d, depth_d = consts[:6]
+                arrs = consts[6:6 + n_arr]
+                cat_args = consts[6 + n_arr:]
+                pages_d = [dec(pg) for pg in pages]
+                pos = positions
+                pos_pgs = []
+                for st, page in zip(starts, pages_d):
+                    pos_pg = jax.lax.dynamic_slice_in_dim(
+                        pos, st, page.shape[0])
+                    if kind is not None:
+                        pos_pg = _advance_rows(page, pos_pg, kind, arrs,
+                                               cat_args, lo_prev, nl_prev,
+                                               n_static, mb)
+                        pos = jax.lax.dynamic_update_slice_in_dim(
+                            pos, pos_pg, st, 0)
+                    pos_pgs.append(pos_pg)
+                acc = jnp.zeros((n_static, F, B, 2), jnp.float32)
+                for st, page, pos_pg in zip(starts, pages_d, pos_pgs):
+                    gp_pg = jax.lax.dynamic_slice_in_dim(gp, st,
+                                                         page.shape[0])
+                    rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                    data = _coarse_bins(page, mb) if coarse else page
+                    acc = acc + build_hist(data, gp_pg, rel, n_static, B,
+                                           method=hk)
+                if coarse:
+                    span = ev._window_body(acc, state[1])
+                    accr = jnp.zeros((n_static, F, WINDOW + 4, 2),
+                                     jnp.float32)
+                    for st, page, pos_pg in zip(starts, pages_d, pos_pgs):
+                        gp_pg = jax.lax.dynamic_slice_in_dim(
+                            gp, st, page.shape[0])
+                        rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                        rb = _refine_bins(page, rel, span, n_static, mb)
+                        accr = accr + build_hist(rb, gp_pg, rel, n_static,
+                                                 WINDOW + 4, method=hk)
+                    hist = (acc, accr[:, :, :WINDOW, :], span)
+                else:
+                    hist = (acc,)
+                outs = eval_fn(*hist, state, tree_mask, keyv, depth_d,
+                               lo_d, nl_d)
+                return (pos,) + tuple(outs)
+
+            # deep (walk) mode: prev["arrs"] alias the carried state's
+            # full tree arrays, which also arrive as consts — donating
+            # state would just trip jax's alias check every level
+            return jax.jit(fn, donate_argnums=(0,) if ev.deep else (0, 1))
+
+        fused = self._cached(
+            ("levelfull", kind, n_static, W, coarse, len(cached), ev.deep)
+            + _page_key(paged), build)
+        consts = (gpair,
+                  jnp.int32(0 if prev is None else prev["lo"]),
+                  jnp.int32(0 if prev is None else prev["n_level"]),
+                  jnp.int32(lo), jnp.int32(n_level), jnp.int32(depth))
+        if prev is not None:
+            consts = consts + prev["arrs"] + (() if cat is None
+                                              else tuple(cat))
+        outs = fused(positions, state, tree_mask, key, consts,
+                     tuple(jnp.int32(s) for s, _, _ in cached),
+                     tuple(p for _, _, p in cached))
+        stash, state_n, prev_n = ev._package(tuple(outs[1:]), lo, n_level)
+        return outs[0], stash, state_n, prev_n
 
     def final_advance(self, paged, positions, prev, n_static):
         """Advance-only pass for the LAST evaluated level (leaf routing)."""
@@ -759,6 +998,8 @@ class _MeshPageKernels:
         trace/compile). Donation only saves an HBM copy of the carry on
         real accelerators, so CPU keeps the copy and its stability."""
         P = jax.sharding.PartitionSpec
+        dec = _page_decoder(paged)
+        key = key + _page_key(paged)
         donate = ({} if jax.default_backend() == "cpu"
                   else {"donate_argnums": 0})
         page_spec = P(self.axis, None)
@@ -769,7 +1010,7 @@ class _MeshPageKernels:
 
                 def fn(carry, consts, starts, pages):
                     for st, page in zip(starts, pages):
-                        carry = body(carry, page, st, consts)
+                        carry = body(carry, dec(page), st, consts)
                     return carry
 
                 return jax.jit(_shard_map(
@@ -786,7 +1027,7 @@ class _MeshPageKernels:
                 body = make_body()
                 return jax.jit(_shard_map(
                     lambda carry, page, s, consts:
-                    body(carry, page, s, consts),
+                    body(carry, dec(page), s, consts),
                     mesh=self.mesh,
                     in_specs=(carry_spec, page_spec, P(), consts_spec),
                     out_specs=carry_spec), **donate)
@@ -848,52 +1089,22 @@ class _MeshPageKernels:
         return fin(acc)
 
     def level_hist(self, paged, gpair, positions, lo: int, n_level: int,
-                   n_static: int, multi: bool = False, coarse: bool = False):
-        """One depthwise level histogram over the pages."""
-        from ..ops.split import COARSE_B
-
+                   n_static: int, multi: bool = False):
+        """One depthwise level histogram over the pages (one-pass scheme;
+        the two-level coarse schedule routes through
+        ``coarse_pass``/``refine_pass``)."""
         def rel_fn(pos_pg, lo_d, n_level_d):
             return _rel_of(pos_pg, lo_d, n_level_d, n_static)
 
-        data_fn = None
-        if coarse:
-            def data_fn(page, rel, lo_d, n_level_d):
-                return _coarse_bins(page, self.missing_bin)
-
         return self._hist_over_pages(
             paged, gpair, positions, rel_fn, n_static, multi,
-            ("hist", n_static, coarse), (jnp.int32(lo), jnp.int32(n_level)),
-            nbins=COARSE_B if coarse else None, data_fn=data_fn)
-
-    def refine_hist(self, paged, gpair, positions, span, lo, n_level,
-                    n_static):
-        """Refine pass of the two-level histogram (mesh tier): the
-        replicated window array rides as an extra input; shard-local
-        (WINDOW+4)-slot partials psum once at pass end like every level
-        hist."""
-        from ..ops.split import WINDOW
-
-        def rel_fn(pos_pg, lo_d, n_level_d, span_d):
-            return _rel_of(pos_pg, lo_d, n_level_d, n_static)
-
-        def data_fn(page, rel, lo_d, n_level_d, span_d):
-            return _refine_bins(page, rel, span_d, n_static,
-                                self.missing_bin)
-
-        h = self._hist_over_pages(
-            paged, gpair, positions, rel_fn, n_static, False,
-            ("rhist", n_static),
-            (jnp.int32(lo), jnp.int32(n_level), span),
-            nbins=WINDOW + 4, data_fn=data_fn)
-        return h[:, :, :WINDOW, :]
+            ("hist", n_static), (jnp.int32(lo), jnp.int32(n_level)))
 
     def adv_hist(self, paged, gpair, positions, prev, lo, n_level, n_static,
-                 multi=False, coarse=False):
+                 multi=False):
         """Fused advance(previous level) + histogram(this level);
         shard-local partials accumulate across pages and psum once at
         level end."""
-        from ..ops.split import COARSE_B
-
         P = jax.sharding.PartitionSpec
         axis = self.axis
         kind = prev["kind"]
@@ -901,7 +1112,7 @@ class _MeshPageKernels:
         n_arr = len(prev["arrs"])
         W = None if cat is None else int(cat[1].shape[1])
         K = gpair.shape[1] if multi else None
-        B = COARSE_B if coarse else self.max_nbins
+        B = self.max_nbins
         gspec = P(axis, None, None) if multi else P(axis, None)
         acc_spec = P(axis, *([None] * (4 + int(multi))))
 
@@ -924,9 +1135,7 @@ class _MeshPageKernels:
                 pos = jax.lax.dynamic_update_slice_in_dim(pos, newp, s_loc,
                                                           0)
                 rel = _rel_of(newp, lo_d, nl_d, n_static)
-                data = (_coarse_bins(page, self.missing_bin) if coarse
-                        else page)
-                h = builder(data, gp_pg, rel, n_static, B,
+                h = builder(page, gp_pg, rel, n_static, B,
                             method=self.hist_kernel)
                 return pos, acc + h[None]
 
@@ -945,10 +1154,197 @@ class _MeshPageKernels:
         consts = (gpair, jnp.int32(prev["lo"]), jnp.int32(prev["n_level"]),
                   jnp.int32(lo), jnp.int32(n_level)) + extra
         positions, acc = self._drive(
-            paged, ("advhist", kind, n_static, multi, W, coarse),
+            paged, ("advhist", kind, n_static, multi, W),
             make_body, (positions, acc), (P(axis), acc_spec),
             consts, (gspec,) + (P(),) * (len(consts) - 1))
         return positions, fin(acc)
+
+    # -- page-major two-level (coarse) schedule ------------------------------
+    # Mesh twin of _PageKernels.coarse_pass/refine_pass: each shard's
+    # streamed pages upload ONCE per level (advance + direct coarse +
+    # fine partial in one shard_map dispatch); the refine fold adds each
+    # shard's fine window slice to its cached-page direct partial BEFORE
+    # the single psum, so the cross-shard reduction happens on the small
+    # refine accumulator, never by re-streaming bins.
+
+    def coarse_pass(self, paged, gpair, positions, prev, lo, n_level,
+                    n_static, cached, streamed):
+        """-> (positions, hist_c replicated, fine-or-None). ``fine`` keeps
+        its leading [world] shard axis — ``refine_pass`` slices it
+        shard-locally and folds it into the refine psum."""
+        from ..ops.split import COARSE_B
+
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        kind = None if prev is None else prev["kind"]
+        cat = None if prev is None else prev["cat"]
+        n_arr = 0 if prev is None else len(prev["arrs"])
+        W = None if cat is None else int(cat[1].shape[1])
+        dec = _page_decoder(paged)
+        mb = self.missing_bin
+        hk = self.hist_kernel
+        F = paged.n_features
+        donate = ({} if jax.default_backend() == "cpu"
+                  else {"donate_argnums": 0})
+        page_spec = P(axis, None)
+        acc_spec = P(axis, None, None, None, None)
+        gspec = P(axis, None)
+
+        def make_body(fine):
+            def body(carry, page, s_loc, consts):
+                pos, acc = carry[0], carry[1]
+                gp, lo_prev, nl_prev, lo_d, nl_d = consts[:5]
+                arrs = consts[5:5 + n_arr]
+                cat_args = consts[5 + n_arr:]
+                page = dec(page)
+                p = page.shape[0]
+                pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
+                if kind is not None:
+                    pos_pg = _advance_rows(page, pos_pg, kind, arrs,
+                                           cat_args, lo_prev, nl_prev,
+                                           n_static, mb)
+                    pos = jax.lax.dynamic_update_slice_in_dim(
+                        pos, pos_pg, s_loc, 0)
+                rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                acc = acc + build_hist(_coarse_bins(page, mb), gp_pg, rel,
+                                       n_static, COARSE_B,
+                                       method=hk)[None]
+                if not fine:
+                    return pos, acc
+                af = carry[2] + build_hist(page, gp_pg, rel, n_static,
+                                           self.max_nbins,
+                                           method=hk)[None]
+                return pos, acc, af
+
+            return body
+
+        consts = (gpair,
+                  jnp.int32(0 if prev is None else prev["lo"]),
+                  jnp.int32(0 if prev is None else prev["n_level"]),
+                  jnp.int32(lo), jnp.int32(n_level))
+        if prev is not None:
+            consts = consts + prev["arrs"] + (() if cat is None
+                                              else tuple(cat))
+        consts_spec = (gspec,) + (P(),) * (len(consts) - 1)
+        key = ("cpass", kind, n_static, W) + _page_key(paged)
+        carry = (positions,
+                 self._acc_zeros((self.world, n_static, F, COARSE_B, 2)))
+        carry_spec = (P(axis), acc_spec)
+        if cached:
+            def build_fused():
+                body = make_body(False)
+
+                def fn(carry, consts, starts, pages):
+                    for st, page in zip(starts, pages):
+                        carry = body(carry, page, st, consts)
+                    return carry
+
+                return jax.jit(_shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(carry_spec, consts_spec, P(), page_spec),
+                    out_specs=carry_spec), **donate)
+
+            fused = self._cached(key + ("fused",), build_fused)
+            carry = fused(carry, consts,
+                          tuple(jnp.int32(s) for s, _ in cached),
+                          tuple(p for _, p in cached))
+        fine = None
+        if streamed:
+            carry = carry + (self._acc_zeros(
+                (self.world, n_static, F, self.max_nbins, 2)),)
+            carry_spec = carry_spec + (acc_spec,)
+
+            def build_single():
+                body = make_body(True)
+                return jax.jit(_shard_map(
+                    lambda carry, page, s, consts:
+                    body(carry, page, s, consts),
+                    mesh=self.mesh,
+                    in_specs=(carry_spec, page_spec, P(), consts_spec),
+                    out_specs=carry_spec), **donate)
+
+            single = self._cached(key + ("single",), build_single)
+            for s_loc, page in paged.stream_pages_sharded(
+                    streamed, self.mesh, self.axis):
+                carry = single(carry, page, jnp.int32(s_loc), consts)
+            fine = carry[2]
+
+        def build_fin():
+            return jax.jit(_shard_map(
+                lambda acc: jax.lax.psum(acc[0], axis), mesh=self.mesh,
+                in_specs=(acc_spec,), out_specs=P()))
+
+        fin = self._cached(("cpass_fin", n_static), build_fin)
+        return carry[0], fin(carry[1]), fine
+
+    def refine_pass(self, paged, gpair, positions, span, lo, n_level,
+                    n_static, cached, fine=None):
+        """Refine fold: direct build over the level's CACHED pages plus
+        each shard's fine window slice, combined shard-locally and summed
+        in ONE psum — streamed pages are never re-uploaded."""
+        from ..ops.split import WINDOW, refine_from_fine
+
+        P = jax.sharding.PartitionSpec
+        axis = self.axis
+        dec = _page_decoder(paged)
+        mb = self.missing_bin
+        hk = self.hist_kernel
+        F = paged.n_features
+        donate = ({} if jax.default_backend() == "cpu"
+                  else {"donate_argnums": 0})
+        page_spec = P(axis, None)
+        acc_spec = P(axis, None, None, None, None)
+        consts_spec = (P(axis, None), P(axis), P(), P(), P())
+        acc = self._acc_zeros((self.world, n_static, F, WINDOW + 4, 2))
+        consts = (gpair, positions, jnp.int32(lo), jnp.int32(n_level),
+                  span)
+        key = ("rpass", n_static) + _page_key(paged)
+        if cached:
+            def build_fused():
+                def body(acc, page, s_loc, consts):
+                    gp, pos, lo_d, nl_d, span_d = consts
+                    page = dec(page)
+                    p = page.shape[0]
+                    pos_pg = jax.lax.dynamic_slice_in_dim(pos, s_loc, p)
+                    gp_pg = jax.lax.dynamic_slice_in_dim(gp, s_loc, p)
+                    rel = _rel_of(pos_pg, lo_d, nl_d, n_static)
+                    rb = _refine_bins(page, rel, span_d, n_static, mb)
+                    return acc + build_hist(rb, gp_pg, rel, n_static,
+                                            WINDOW + 4, method=hk)[None]
+
+                def fn(acc, consts, starts, pages):
+                    for st, page in zip(starts, pages):
+                        acc = body(acc, page, st, consts)
+                    return acc
+
+                return jax.jit(_shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(acc_spec, consts_spec, P(), page_spec),
+                    out_specs=acc_spec), **donate)
+
+            fused = self._cached(key, build_fused)
+            acc = fused(acc, consts,
+                        tuple(jnp.int32(s) for s, _ in cached),
+                        tuple(p for _, p in cached))
+        has_fine = fine is not None
+
+        def build_fin():
+            if has_fine:
+                def fin(acc, fine, span_d):
+                    local = (acc[0][:, :, :WINDOW, :]
+                             + refine_from_fine(fine[0], span_d, mb))
+                    return jax.lax.psum(local, axis)
+
+                return jax.jit(_shard_map(
+                    fin, mesh=self.mesh,
+                    in_specs=(acc_spec, acc_spec, P()), out_specs=P()))
+            return jax.jit(_shard_map(
+                lambda acc: jax.lax.psum(acc[0][:, :, :WINDOW, :], axis),
+                mesh=self.mesh, in_specs=(acc_spec,), out_specs=P()))
+
+        fin = self._cached(("rpass_fin", n_static, has_fine), build_fin)
+        return fin(acc, fine, span) if has_fine else fin(acc)
 
     def final_advance(self, paged, positions, prev, n_static):
         """Advance-only pass for the LAST evaluated level (leaf routing)."""
@@ -1155,35 +1551,64 @@ class PagedGrower(TreeGrower):
         state = self._ev.init_state(root_sum)
 
         # ---- device loop: ZERO blocking host syncs on a single host ----
-        # per depth: one fused page pass (advance previous level + build
-        # this level's histogram) and one eval/state-update dispatch; the
-        # host pulls every level's decisions in ONE packed transfer at the
-        # end and replays the tree bookkeeping
+        # PAGE-MAJOR schedule per level boundary: when every page sits in
+        # the HBM cache (and no host communicator must allreduce between
+        # sweeps) the ENTIRE level — advance + histogram(s) + window +
+        # eval — runs as ONE jitted dispatch (level_full). Otherwise each
+        # streamed page uploads ONCE per level: its single visit carries
+        # the advance, the direct coarse partial and a full fine partial,
+        # and the refine contribution is a window slice of that fine
+        # accumulator (coarse_pass/refine_pass) — the r5/r6 schedule
+        # re-uploaded every streamed page twice per level. The host pulls
+        # every level's decisions in ONE packed transfer at tree end.
+        from ..parallel import collective as _coll
+
         stashes = []
         prev = None
+        single_dev = isinstance(self._mk, _PageKernels)
         for depth in range(max_depth):
             lo = 2 ** depth - 1
             n_level = 2 ** depth
-            if prev is None:
-                hist = self._mk.level_hist(paged, gpair, positions, lo,
-                                           n_level, n_static,
-                                           coarse=self._coarse)
+            # freeze the level's page partition: a page uploaded (and
+            # cached) during the first sweep must not be double-counted
+            # by the refine sweep
+            if single_dev:
+                cached, streamed = paged.cached_split()
             else:
-                positions, hist = self._mk.adv_hist(
+                cached, streamed = paged.cached_split_mesh(self._mk.world)
+            distributed = _coll.get_communicator().is_distributed()
+            if single_dev and cached and not streamed and not distributed:
+                positions, stash, state, prev = self._mk.level_full(
                     paged, gpair, positions, prev, lo, n_level, n_static,
-                    coarse=self._coarse)
-            hist = _host_allreduce(hist)
-            if self._coarse:
+                    self._ev, state, tree_mask, key, depth, cached)
+            elif self._coarse:
+                positions, hist_c, fine = self._mk.coarse_pass(
+                    paged, gpair, positions, prev, lo, n_level, n_static,
+                    cached, streamed)
+                hist_c = _host_allreduce(hist_c)
                 # node-level window choice from the GLOBAL coarse hist
                 # (allreduced above, so every host/shard refines the same
-                # windows), then the refine pass re-streams the pages
-                span = self._ev.choose_window(hist, state)
-                hist_r = _host_allreduce(self._mk.refine_hist(
-                    paged, gpair, positions, span, lo, n_level, n_static))
-                hist = (hist, hist_r, span)
-            stash, state, prev = self._ev(
-                hist, state, tree_mask, key, jnp.int32(depth),
-                jnp.int32(lo), jnp.int32(n_level))
+                # windows); cached pages re-read HBM for the refine,
+                # streamed pages' refine comes from their fine partials
+                span = self._ev.choose_window(hist_c, state)
+                hist_r = _host_allreduce(self._mk.refine_pass(
+                    paged, gpair, positions, span, lo, n_level, n_static,
+                    cached, fine=fine))
+                stash, state, prev = self._ev(
+                    (hist_c, hist_r, span), state, tree_mask, key,
+                    jnp.int32(depth), jnp.int32(lo), jnp.int32(n_level))
+            else:
+                if prev is None:
+                    hist = self._mk.level_hist(paged, gpair, positions,
+                                               lo, n_level, n_static)
+                else:
+                    positions, hist = self._mk.adv_hist(
+                        paged, gpair, positions, prev, lo, n_level,
+                        n_static)
+                hist = _host_allreduce(hist)
+                stash, state, prev = self._ev(
+                    hist, state, tree_mask, key, jnp.int32(depth),
+                    jnp.int32(lo), jnp.int32(n_level))
             stashes.append(stash)
             # ONE-BEHIND early stop: the previous level's eval finished
             # long before this level's page passes were even dispatched, so
