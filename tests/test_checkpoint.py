@@ -99,3 +99,242 @@ def test_deterministic_rerun_single_device():
                       verbose_eval=False).save_raw("json")
             for _ in range(2)]
     assert bytes(runs[0]) == bytes(runs[1])
+
+
+# --------------------------------------------------------- bit-exact resume
+# Full-state snapshots (utils/checkpoint.py): straight(N) must equal
+# crash-at-k + auto-resume as save_raw BYTE equality — not rtol. The
+# snapshot carries the training margin, whose accumulation order is the
+# ulp-level state the old model-only recovery lost.
+
+SAMPLED = {**PARAMS, "subsample": 0.7, "colsample_bytree": 0.8, "seed": 5}
+
+
+class DieAtRound(xgb.callback.TrainingCallback):
+    def __init__(self, round_):
+        self.round_ = round_
+
+    def after_iteration(self, model, epoch, evals_log):
+        if epoch == self.round_:
+            raise RuntimeError("injected crash")
+        return False
+
+
+def _crash_and_resume(params, make_dm, ckdir, n_rounds=12, die_at=7,
+                      every=3):
+    straight = xgb.train(params, make_dm(), n_rounds, verbose_eval=False)
+    ck = xgb.CheckpointConfig(directory=ckdir, every_n_rounds=every)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        xgb.train(params, make_dm(), n_rounds, checkpoint=ck,
+                  callbacks=[DieAtRound(die_at)], verbose_eval=False)
+    resumed = xgb.train(params, make_dm(), n_rounds, checkpoint=ck,
+                        verbose_eval=False)
+    assert resumed.num_boosted_rounds() == n_rounds
+    return straight, resumed
+
+
+def test_autoresume_bitexact_resident(tmp_path):
+    X, y = _data(seed=5)
+    straight, resumed = _crash_and_resume(
+        SAMPLED, lambda: xgb.DMatrix(X, label=y), str(tmp_path))
+    assert bytes(straight.save_raw("ubj")) == bytes(resumed.save_raw("ubj"))
+
+
+def test_autoresume_bitexact_paged_streaming(tmp_path, monkeypatch):
+    """Forced-streaming external-memory tier: pages stay paged
+    (XTPU_PAGED_COLLAPSE=0) and each segment rebuilds the QuantileDMatrix
+    from the iterator — cuts are deterministic, the snapshot restores the
+    margin bits."""
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "400")
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")
+    X, y = _data(n=2000, f=6, seed=6)
+
+    class It(xgb.DataIter):
+        def __init__(self, prefix):
+            super().__init__(cache_prefix=prefix)
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= 2:
+                return 0
+            parts = np.array_split(np.arange(len(y)), 2)
+            idx = parts[self.i]
+            self.i += 1
+            input_data(data=X[idx], label=y[idx])
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    tags = iter("abcdef")
+
+    def make_dm():
+        return xgb.QuantileDMatrix(It(str(tmp_path / next(tags))),
+                                   max_bin=32)
+
+    params = {**SAMPLED, "max_bin": 32}
+    straight, resumed = _crash_and_resume(
+        params, make_dm, str(tmp_path / "ck"), n_rounds=8, die_at=4,
+        every=2)
+    assert bytes(straight.save_raw("ubj")) == bytes(resumed.save_raw("ubj"))
+
+
+@pytest.mark.slow
+def test_autoresume_bitexact_mesh(tmp_path):
+    """Virtual-mesh tier (8 CPU devices): sharded margins snapshot trimmed
+    to the logical rows and re-pad on restore. slow: shard_map compiles
+    dominate; tools/validate_resume.py covers the mesh grid too."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device platform")
+    X, y = _data(n=2000, f=6, seed=7)
+    mesh = xgb.make_data_mesh()
+    params = {**PARAMS, "seed": 2, "mesh": mesh}
+    straight, resumed = _crash_and_resume(
+        params, lambda: xgb.DMatrix(X, label=y), str(tmp_path),
+        n_rounds=8, die_at=4, every=3)
+    assert bytes(straight.save_raw("ubj")) == bytes(resumed.save_raw("ubj"))
+
+
+@pytest.mark.slow
+def test_autoresume_bitexact_dart(tmp_path):
+    """DART is the hardest resume case: a STATEFUL drop-selection RNG
+    stream (captured in the snapshot) plus per-state margin/delta-ring
+    caches (re-seeded bit-exactly by Dart.on_resume)."""
+    X, y = _data(n=1000, f=5, seed=17)
+    params = {"booster": "dart", "objective": "binary:logistic",
+              "max_depth": 3, "eta": 0.3, "rate_drop": 0.3,
+              "one_drop": True, "seed": 3}
+    straight, resumed = _crash_and_resume(
+        params, lambda: xgb.DMatrix(X, label=y), str(tmp_path),
+        n_rounds=8, die_at=4, every=2)
+    assert bytes(straight.save_raw("ubj")) == bytes(resumed.save_raw("ubj"))
+
+
+def test_autoresume_skips_corrupt_newest_snapshot(tmp_path):
+    """A crash can mangle the newest snapshot itself: resume must fall
+    back to the previous valid one and STILL land byte-identical."""
+    from xgboost_tpu.utils.checkpoint import list_snapshots
+
+    X, y = _data(seed=8)
+    dmf = lambda: xgb.DMatrix(X, label=y)  # noqa: E731
+    straight = xgb.train(SAMPLED, dmf(), 12, verbose_eval=False)
+    ck = xgb.CheckpointConfig(directory=str(tmp_path), every_n_rounds=3)
+    with pytest.raises(RuntimeError):
+        xgb.train(SAMPLED, dmf(), 12, checkpoint=ck,
+                  callbacks=[DieAtRound(7)], verbose_eval=False)
+    snaps = list_snapshots(str(tmp_path))
+    newest = snaps[0][1]
+    with open(newest, "r+b") as fh:
+        fh.truncate(os.path.getsize(newest) // 2)
+    resumed = xgb.train(SAMPLED, dmf(), 12, checkpoint=ck,
+                        verbose_eval=False)
+    assert bytes(straight.save_raw("ubj")) == bytes(resumed.save_raw("ubj"))
+
+
+def test_autoresume_ignores_snapshot_of_other_data(tmp_path):
+    """Fingerprint guard: a snapshot written for different training data
+    must not be resumed — the run starts from scratch instead."""
+    X, y = _data(seed=9)
+    ck = xgb.CheckpointConfig(directory=str(tmp_path), every_n_rounds=2)
+    xgb.train(PARAMS, xgb.DMatrix(X, label=y), 4, checkpoint=ck,
+              verbose_eval=False)
+    X2, y2 = _data(seed=10)
+    bst = xgb.train(PARAMS, xgb.DMatrix(X2, label=y2), 4, checkpoint=ck,
+                    verbose_eval=False)
+    fresh = xgb.train(PARAMS, xgb.DMatrix(X2, label=y2), 4,
+                      verbose_eval=False)
+    assert bytes(bst.save_raw("ubj")) == bytes(fresh.save_raw("ubj"))
+
+
+def test_checkpoint_background_writer_matches_sync(tmp_path):
+    X, y = _data(seed=11)
+    dmf = lambda: xgb.DMatrix(X, label=y)  # noqa: E731
+    a = xgb.train(PARAMS, dmf(), 6, verbose_eval=False,
+                  checkpoint=xgb.CheckpointConfig(
+                      directory=str(tmp_path / "sync"), every_n_rounds=2))
+    b = xgb.train(PARAMS, dmf(), 6, verbose_eval=False,
+                  checkpoint=xgb.CheckpointConfig(
+                      directory=str(tmp_path / "bg"), every_n_rounds=2,
+                      background=True))
+    assert bytes(a.save_raw("ubj")) == bytes(b.save_raw("ubj"))
+    from xgboost_tpu.utils.checkpoint import (list_snapshots,
+                                              load_snapshot)
+    sync = [(r, load_snapshot(p).model)
+            for r, p in list_snapshots(str(tmp_path / "sync"))]
+    bg = [(r, load_snapshot(p).model)
+          for r, p in list_snapshots(str(tmp_path / "bg"))]
+    assert sync == bg
+
+
+def test_checkpoint_keep_prunes_old_snapshots(tmp_path):
+    from xgboost_tpu.utils.checkpoint import list_snapshots
+
+    X, y = _data(seed=12)
+    xgb.train(PARAMS, xgb.DMatrix(X, label=y), 10, verbose_eval=False,
+              checkpoint=xgb.CheckpointConfig(
+                  directory=str(tmp_path), every_n_rounds=2, keep=2))
+    rounds = [r for r, _ in list_snapshots(str(tmp_path))]
+    assert rounds == [10, 8]
+
+
+def test_training_checkpoint_callback_atomic_and_keep(tmp_path):
+    """The model-only callback writes via tmp + os.replace (no truncated
+    'latest' file for a recovery run to trip on) and prunes to keep=N."""
+    from xgboost_tpu.callback import TrainingCheckPoint
+
+    X, y = _data(seed=18)
+    cb = TrainingCheckPoint(directory=str(tmp_path), name="model",
+                            interval=2, keep=2)
+    xgb.train(PARAMS, xgb.DMatrix(X, label=y), 8, verbose_eval=False,
+              callbacks=[cb])
+    saved = sorted(glob.glob(os.path.join(str(tmp_path), "model_*.json")))
+    assert len(saved) == 2
+    assert not glob.glob(os.path.join(str(tmp_path), "*.tmp"))
+    for p in saved:  # every survivor is a complete, loadable model
+        xgb.Booster(model_file=p)
+    with pytest.raises(ValueError):
+        TrainingCheckPoint(directory=str(tmp_path), keep=0)
+
+
+# ------------------------------------------------- early-stopping state
+
+def test_early_stopping_state_survives_resume():
+    """A resumed run keeps the patience window: best_score/best_iteration/
+    rounds-since-improvement ride the booster attributes, so split
+    training stops at the same total round as the straight run."""
+    X, y = _data(seed=13)
+    Xv, yv = _data(n=800, seed=14)
+    dm, dv = xgb.DMatrix(X, label=y), xgb.DMatrix(Xv, label=yv)
+    es = 3
+
+    straight = xgb.train(PARAMS, dm, 30, evals=[(dv, "val")],
+                         early_stopping_rounds=es, verbose_eval=False)
+    stop_round = straight.num_boosted_rounds()
+    best_it = straight.best_iteration
+
+    k = max(2, stop_round - 2)  # split inside the patience window
+    first = xgb.train(PARAMS, xgb.DMatrix(X, label=y), k,
+                      evals=[(dv, "val")], early_stopping_rounds=es,
+                      verbose_eval=False)
+    assert first.num_boosted_rounds() == k  # did not stop yet
+    assert first.attr("rounds_since_improvement") is not None
+    resumed = xgb.train(PARAMS, xgb.DMatrix(X, label=y), 30 - k,
+                        evals=[(dv, "val")], early_stopping_rounds=es,
+                        xgb_model=first, verbose_eval=False)
+    assert resumed.num_boosted_rounds() == stop_round
+    assert resumed.best_iteration == best_it
+
+
+def test_early_stopping_attrs_serialized_through_save(tmp_path):
+    X, y = _data(seed=15)
+    Xv, yv = _data(n=600, seed=16)
+    dv = xgb.DMatrix(Xv, label=yv)
+    bst = xgb.train(PARAMS, xgb.DMatrix(X, label=y), 6,
+                    evals=[(dv, "val")], early_stopping_rounds=10,
+                    verbose_eval=False)
+    path = str(tmp_path / "m.json")
+    bst.save_model(path)
+    back = xgb.Booster(model_file=path)
+    assert back.attr("best_score") == bst.attr("best_score")
+    assert back.attr("rounds_since_improvement") == \
+        bst.attr("rounds_since_improvement")

@@ -1,0 +1,388 @@
+"""Resilient collectives + snapshot subsystem (ISSUE 5: rabit-style
+checkpoint/recover): retry/backoff schedules, typed desync/corruption/timeout
+detection, FaultPlan fault injection, atomic snapshot IO with corrupt-file
+fallback, and distributed kill-and-recover to the byte-identical model."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.data.dmatrix import DataIter
+from xgboost_tpu.parallel import resilience as R
+from xgboost_tpu.parallel.collective import (InMemoryCommunicator,
+                                             NoOpCommunicator,
+                                             set_thread_local_communicator)
+from xgboost_tpu.utils import checkpoint as C
+
+
+# ---------------------------------------------------------------- primitives
+
+def test_retry_recovers_transient_fault():
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(), R.FaultPlan(fail_at_op=2, transient=True))
+    rc = R.ResilientCommunicator(faulty,
+                                 R.RetryPolicy(base_delay_s=0.001))
+    assert rc.allreduce(np.ones(3))[0] == 1.0
+    out = rc.allreduce(np.full(3, 2.0))  # op 2: fails once, then retries
+    assert out[0] == 2.0
+    assert rc.stats["retries"] == 1
+
+
+def test_permanent_fault_not_retried():
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(), R.FaultPlan(fail_at_op=1, transient=False))
+    rc = R.ResilientCommunicator(faulty,
+                                 R.RetryPolicy(base_delay_s=0.001))
+    with pytest.raises(R.CollectiveFault):
+        rc.allreduce(np.ones(2))
+    assert rc.stats["retries"] == 0
+
+
+def test_retries_are_bounded():
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(),
+        R.FaultPlan(fail_at_op=None, flaky_p=1.0, max_failures=None))
+    rc = R.ResilientCommunicator(
+        faulty, R.RetryPolicy(max_retries=2, base_delay_s=0.001))
+    with pytest.raises(R.TransientCollectiveError):
+        rc.allreduce(np.ones(2))
+    assert rc.stats["retries"] == 2
+
+
+def test_flaky_schedule_completes_under_retries():
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(),
+        R.FaultPlan(fail_at_op=None, flaky_p=0.4, seed=42,
+                    max_failures=None))
+    rc = R.ResilientCommunicator(
+        faulty, R.RetryPolicy(max_retries=8, base_delay_s=0.0))
+    for i in range(30):
+        assert rc.allreduce(np.asarray([float(i)]))[0] == float(i)
+    assert rc.stats["retries"] > 0
+
+
+def test_desync_raises_typed_error_on_all_ranks():
+    """Two ranks issuing mismatched op kinds at the same sequence number
+    must both see CollectiveDesync — never a silently wrong sum."""
+    comms = InMemoryCommunicator.make_world(2)
+    out = [None, None]
+
+    def worker(rank):
+        rc = R.ResilientCommunicator(comms[rank])
+        try:
+            if rank == 0:
+                rc.allreduce(np.ones(4, np.float32), op="sum")
+            else:
+                rc.allreduce(np.ones(4, np.float32), op="max")
+            out[rank] = "ok"
+        except R.CollectiveDesync:
+            out[rank] = "desync"
+
+    ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert out == ["desync", "desync"]
+
+
+def test_op_label_enters_desync_header():
+    """Same seq + kind but different CALL SITES (op_context labels) is a
+    desync: one rank in the paged hist reduce, a peer in the sketch merge."""
+    comms = InMemoryCommunicator.make_world(2)
+    out = [None, None]
+
+    def worker(rank):
+        rc = R.ResilientCommunicator(comms[rank])
+        try:
+            with R.op_context("paged/hist" if rank == 0 else "sketch/merge"):
+                rc.allreduce(np.ones(4, np.float32))
+            out[rank] = "ok"
+        except R.CollectiveDesync:
+            out[rank] = "desync"
+
+    ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert out == ["desync", "desync"]
+
+
+def test_allreduce_corruption_caught_by_control_sum():
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(), R.FaultPlan(fail_at_op=None, corrupt_at_op=1))
+    rc = R.ResilientCommunicator(faulty)
+    with pytest.raises(R.CollectiveCorruption):
+        rc.allreduce(np.ones(5, np.float64))
+    assert rc.stats["corruptions"] == 1
+
+
+def test_allgather_corruption_caught_by_crc():
+    comms = InMemoryCommunicator.make_world(2)
+    out = [None, None]
+
+    def worker(rank):
+        plan = R.FaultPlan(fail_at_op=None,
+                           corrupt_at_op=1 if rank == 0 else None)
+        rc = R.ResilientCommunicator(
+            R.FaultyCommunicator(comms[rank], plan))
+        try:
+            rc.allgather_objects({"rank": rank})
+            out[rank] = "ok"
+        except R.CollectiveCorruption:
+            out[rank] = "corrupt"
+
+    ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert out[0] == "corrupt"  # rank 0 corrupted a peer slot it received
+
+
+def test_latency_injection_trips_timeout():
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(),
+        R.FaultPlan(fail_at_op=None, latency_s=0.3, max_failures=0))
+    rc = R.ResilientCommunicator(faulty, R.RetryPolicy(timeout_s=0.05))
+    with pytest.raises(R.CollectiveTimeout):
+        rc.allreduce(np.ones(2))
+    # under the latency budget: passes
+    rc2 = R.ResilientCommunicator(
+        R.FaultyCommunicator(
+            NoOpCommunicator(),
+            R.FaultPlan(fail_at_op=None, latency_s=0.01, max_failures=0)),
+        R.RetryPolicy(timeout_s=5.0))
+    assert rc2.allreduce(np.ones(2))[0] == 1.0
+
+
+def test_fault_plan_round_schedule():
+    """fail_round counts ops within the round announced via notify_round."""
+    faulty = R.FaultyCommunicator(
+        NoOpCommunicator(),
+        R.FaultPlan(fail_at_op=2, fail_round=3, transient=False))
+    faulty.on_round(2)
+    faulty.allreduce(np.ones(1))
+    faulty.allreduce(np.ones(1))  # op 2 of round 2: no fault
+    faulty.on_round(3)
+    faulty.allreduce(np.ones(1))  # op 1 of round 3: no fault
+    with pytest.raises(R.CollectiveFault, match="round 3"):
+        faulty.allreduce(np.ones(1))
+    # fail-once: the schedule does not re-fire
+    faulty.on_round(3)
+    faulty.allreduce(np.ones(1))
+    faulty.allreduce(np.ones(1))
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError):
+        R.FaultPlan(fail_at_op=0)
+    with pytest.raises(ValueError):
+        R.FaultPlan(op_filter="broadcast")
+    with pytest.raises(ValueError):
+        R.FaultPlan(corrupt_at_op=0)
+
+
+def test_resilient_wrapper_preserves_plain_values():
+    """Integrity framing must be invisible to callers: values, shapes and
+    dtypes round-trip bit-exactly through the wrapper."""
+    rc = R.ResilientCommunicator(NoOpCommunicator())
+    x = np.arange(12, dtype=np.float32).reshape(3, 4) * 1.5
+    out = rc.allreduce(x)
+    assert out.dtype == x.dtype and out.shape == x.shape
+    np.testing.assert_array_equal(out, x)
+    assert rc.allgather_objects({"a": 1}) == [{"a": 1}]
+    # int dtypes skip in-band framing but still reduce correctly
+    xi = np.asarray([3, 5], np.int64)
+    np.testing.assert_array_equal(rc.allreduce(xi, op="max"), xi)
+
+
+def test_agree_round_is_min_across_ranks():
+    comms = InMemoryCommunicator.make_world(2)
+    out = [None, None]
+
+    def worker(rank):
+        out[rank] = R.agree_round(6 if rank == 0 else 4, comm=comms[rank])
+
+    ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert out == [4, 4]
+    assert R.agree_round(7, comm=NoOpCommunicator()) == 7
+
+
+# ------------------------------------------------------------- snapshot files
+
+def _snap(round_=3, n=8):
+    rng = np.random.RandomState(round_)
+    return C.TrainingSnapshot(
+        round=round_, model=b"\x00model-bytes\xff" * 4,
+        margin=rng.randn(n, 2).astype(np.float32),
+        fingerprint={"n_rows": n, "n_cols": 2},
+        rng={"seed": 0, "seed_per_iteration": False})
+
+
+def test_snapshot_roundtrip(tmp_path):
+    snap = _snap()
+    path = C.write_snapshot(str(tmp_path), snap)
+    assert os.path.exists(path) and os.path.exists(path + ".crc")
+    back = C.load_snapshot(path)
+    assert back.round == snap.round
+    assert back.model == snap.model
+    np.testing.assert_array_equal(back.margin, snap.margin)
+    assert back.fingerprint == snap.fingerprint
+
+
+def test_truncated_snapshot_is_skipped_with_fallback(tmp_path):
+    C.write_snapshot(str(tmp_path), _snap(round_=2))
+    newest = C.write_snapshot(str(tmp_path), _snap(round_=4))
+    with open(newest, "r+b") as fh:  # crash-style truncation
+        fh.truncate(os.path.getsize(newest) // 2)
+    with pytest.raises(C.SnapshotCorrupt):
+        C.load_snapshot(newest)
+    found = C.latest_valid_snapshot(str(tmp_path))
+    assert found is not None and found[0].round == 2
+
+
+def test_missing_sidecar_invalidates_snapshot(tmp_path):
+    path = C.write_snapshot(str(tmp_path), _snap(round_=5))
+    os.remove(path + ".crc")
+    with pytest.raises(C.SnapshotCorrupt, match="sidecar"):
+        C.load_snapshot(path)
+    assert C.latest_valid_snapshot(str(tmp_path)) is None
+
+
+def test_prune_keeps_newest(tmp_path):
+    for r in (2, 4, 6, 8):
+        C.write_snapshot(str(tmp_path), _snap(round_=r))
+    C.prune_snapshots(str(tmp_path), keep=2)
+    rounds = [r for r, _ in C.list_snapshots(str(tmp_path))]
+    assert rounds == [8, 6]
+    assert not [f for f in os.listdir(str(tmp_path)) if f.endswith(".crc")
+                and not os.path.exists(os.path.join(
+                    str(tmp_path), f[:-4]))]
+
+
+def test_fingerprint_mismatch_skipped(tmp_path):
+    C.write_snapshot(str(tmp_path), _snap(round_=3))
+    found = C.latest_valid_snapshot(
+        str(tmp_path), fingerprint={"n_rows": 999, "n_cols": 2})
+    assert found is None
+    found = C.latest_valid_snapshot(
+        str(tmp_path), fingerprint={"n_rows": 8, "n_cols": 2})
+    assert found is not None
+
+
+def test_background_writer(tmp_path):
+    w = C.SnapshotWriter()
+    for r in (1, 2, 3):
+        w.submit(str(tmp_path), _snap(round_=r), "snapshot", keep=2)
+    w.close()
+    rounds = [r for r, _ in C.list_snapshots(str(tmp_path))]
+    assert rounds == [3, 2]
+    assert C.load_snapshot(C.snapshot_path(str(tmp_path), 3)).round == 3
+
+
+def test_background_writer_surfaces_errors(tmp_path):
+    w = C.SnapshotWriter()
+    bad = os.path.join(str(tmp_path), "not_a_dir_file")
+    with open(bad, "w") as fh:
+        fh.write("x")
+    w.submit(bad, _snap(), "snapshot", keep=None)  # dir IS a file: fails
+    with pytest.raises(C.SnapshotError):
+        w.flush(raise_errors=True)
+    w.close()
+
+
+# -------------------------------------------------- distributed kill/recover
+
+class _OneShotIter(DataIter):
+    def __init__(self, X, y, prefix):
+        super().__init__(cache_prefix=prefix)
+        self.X, self.y, self._done = X, y, False
+
+    def next(self, input_data):
+        if self._done:
+            return 0
+        self._done = True
+        input_data(data=self.X, label=self.y)
+        return 1
+
+    def reset(self):
+        self._done = False
+
+
+@pytest.mark.slow
+def test_multirank_kill_and_agreed_resume_bitexact(tmp_path, monkeypatch):
+    """The full recovery protocol on the in-memory multi-rank paged tier:
+    both ranks die on an injected CollectiveFault at round 5, reload the
+    last collectively AGREED snapshot (min round across ranks), finish,
+    and land on the byte-identical model of the uninterrupted 2-rank
+    run."""
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "200")
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")
+    rng = np.random.RandomState(5)
+    X = rng.randn(1600, 5).astype(np.float32)
+    y = (X @ rng.randn(5) > 0).astype(np.float32)
+    half = len(y) // 2
+    shards = [(X[:half], y[:half]), (X[half:], y[half:])]
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 16}
+
+    def run_world(tag, plan_fn=None, ck=True):
+        comms = InMemoryCommunicator.make_world(2)
+        res, errs = [None] * 2, [[] for _ in range(2)]
+
+        def worker(rank):
+            comm = comms[rank]
+            if plan_fn is not None:
+                comm = R.FaultyCommunicator(comm, plan_fn())
+            set_thread_local_communicator(comm)
+            try:
+                Xr, yr = shards[rank]
+                qdm = xgb.QuantileDMatrix(
+                    _OneShotIter(Xr, yr, str(tmp_path / f"{tag}{rank}")),
+                    max_bin=16)
+                cfg = (xgb.CheckpointConfig(
+                    directory=str(tmp_path / f"ck{rank}"), every_n_rounds=2)
+                    if ck else None)
+                bst = xgb.train(params, qdm, 8, checkpoint=cfg,
+                                verbose_eval=False)
+                res[rank] = bytes(bst.save_raw("ubj"))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errs[rank].append(e)
+            finally:
+                set_thread_local_communicator(None)
+
+        ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(300)
+        assert not any(t.is_alive() for t in ts), "worker deadlocked"
+        return res, errs
+
+    straight, errs = run_world("s", ck=False)
+    assert not any(errs), errs
+    assert straight[0] == straight[1]
+
+    killed, errs = run_world(
+        "k", plan_fn=lambda: R.FaultPlan(fail_round=5, transient=False))
+    assert all(e and isinstance(e[0], R.CollectiveFault) for e in errs)
+
+    resumed, errs = run_world("r")  # same ck dirs: auto-resume, agreed round
+    assert not any(errs), errs
+    assert resumed[0] == resumed[1]
+    assert resumed[0] == straight[0], \
+        "resumed multi-rank model is not byte-identical to the straight run"

@@ -288,6 +288,56 @@ def test_model_hot_swap_mid_stream(data, booster):
     srv.close()
 
 
+def test_failed_swap_rolls_back_mid_stream(data, booster):
+    """Corrupted/truncated model bytes on load or hot-swap raise a typed
+    ModelLoadError and the PREVIOUS version keeps serving — live traffic
+    through the failed swap never sees an error or a half-loaded model."""
+    from xgboost_tpu.serve import ModelLoadError
+
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X))
+    good = bytes(booster.save_raw("ubj"))
+    corrupt = good[: len(good) // 2]           # truncated write
+    garbage = b"\x13\x37" + good[::-1][:64]     # parses as nothing
+
+    srv = _server(booster)
+    errors = []
+    stop = threading.Event()
+
+    def stream():
+        rng = np.random.RandomState(1)
+        while not stop.is_set():
+            n = int(rng.randint(1, 20))
+            r = srv.predict(X[:n])
+            if r.version != 1 or \
+                    not np.array_equal(np.asarray(r), oracle[:n]):
+                errors.append((r.version, n))
+
+    t = threading.Thread(target=stream)
+    t.start()
+    try:
+        time.sleep(0.1)
+        for bad in (corrupt, garbage):
+            with pytest.raises(ModelLoadError):
+                srv.swap_model("m", bad)
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors, "traffic broke during a failed swap"
+    # v1 is still the live version after both failed swaps
+    r = srv.predict(X[:3])
+    assert r.version == 1
+    np.testing.assert_array_equal(np.asarray(r), oracle[:3])
+    assert srv.metrics.counters.get("swaps", 0) == 0
+    # a failed initial load also leaves the registry unchanged
+    with pytest.raises(ModelLoadError):
+        srv.load_model("m2", corrupt)
+    with pytest.raises(UnknownModel):
+        srv.registry.get("m2")
+    srv.close()
+
+
 def test_registry_load_unload(data, booster):
     X, _ = data
     srv = _server(booster)

@@ -1,0 +1,273 @@
+"""Promotion gate for elastic fault-tolerant training (ISSUE 5).
+
+Before the checkpoint/recover layer counts as shipped, a grid over
+
+    tier (resident / paged-streaming / mesh)  x  objective  x  sampling
+
+must prove the recovery contract BIT-EXACTLY: for each cell the straight
+N-round run is compared against a run KILLED at round k (injected crash)
+and auto-resumed from its snapshot directory — the two final models must
+be byte-identical under ``save_raw`` (zero model gap, not rtol). Two
+adversarial cases ride along:
+
+- corrupt-newest: after the kill, the newest snapshot is truncated in
+  place (the artifact the crash itself is most likely to mangle); resume
+  must fall back to the previous valid snapshot and STILL converge to the
+  byte-identical model.
+- mid-collective kill (paged tier): the crash is injected by a FaultPlan
+  at an arbitrary collective op inside a round rather than a round
+  boundary, through a FaultyCommunicator (single-rank world).
+
+Run from the repo root: ``python tools/validate_resume.py``.
+Shrink for a smoke run: VALIDATE_RESUME_SCALE=0.25 (fraction of rows).
+Exits non-zero and prints FAIL on any model gap.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+SCALE = float(os.environ.get("VALIDATE_RESUME_SCALE", "1.0"))
+N = max(int(4000 * SCALE), 600)
+F = 6
+ROUNDS = 10
+DIE_AT = 6          # crash after this round commits (0-based epoch)
+EVERY = 3           # snapshot cadence -> resume restarts from round 6 or 3
+
+OBJECTIVES = [
+    ("logistic", {"objective": "binary:logistic"}),
+    ("squarederror", {"objective": "reg:squarederror"}),
+]
+SAMPLING = [
+    ("plain", {}),
+    ("sampled", {"subsample": 0.7, "colsample_bytree": 0.8, "seed": 9}),
+]
+
+
+def _data(objective):
+    rng = np.random.RandomState(11)
+    X = rng.randn(N, F).astype(np.float32)
+    w = rng.randn(F)
+    y = ((X @ w > 0).astype(np.float32) if "logistic" in objective
+         else (X @ w).astype(np.float32))
+    return X, y
+
+
+def _make_dm(tier, X, y, tmp, tag):
+    import xgboost_tpu as xgb
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    if tier == "resident":
+        return xgb.DMatrix(X, label=y)
+    if tier == "mesh":
+        return xgb.DMatrix(X, label=y)
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__(cache_prefix=os.path.join(tmp, tag))
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= 2:
+                return 0
+            parts = np.array_split(np.arange(len(y)), 2)
+            idx = parts[self.i]
+            self.i += 1
+            input_data(data=X[idx], label=y[idx])
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    return xgb.QuantileDMatrix(It(), max_bin=32)
+
+
+def _params(tier, obj_params, samp_params):
+    import xgboost_tpu as xgb
+
+    p = {"max_depth": 4, "eta": 0.3, **obj_params, **samp_params}
+    if tier == "paged":
+        p["max_bin"] = 32
+    if tier == "mesh":
+        p["mesh"] = xgb.make_data_mesh()
+    return p
+
+
+def _run_cell(tier, obj_name, obj_params, samp_name, samp_params, tmp,
+              corrupt_newest=False):
+    import xgboost_tpu as xgb
+    from xgboost_tpu.utils.checkpoint import list_snapshots
+
+    cell = f"{tier}/{obj_name}/{samp_name}" \
+        + ("/corrupt-newest" if corrupt_newest else "")
+    X, y = _data(obj_params["objective"])
+    params = _params(tier, obj_params, samp_params)
+    tag = cell.replace("/", "_")
+
+    straight = xgb.train(params, _make_dm(tier, X, y, tmp, tag + "_s"),
+                         ROUNDS, verbose_eval=False)
+    want = bytes(straight.save_raw("ubj"))
+
+    ckdir = os.path.join(tmp, "ck_" + tag)
+    ck = xgb.CheckpointConfig(directory=ckdir, every_n_rounds=EVERY)
+
+    class Die(xgb.callback.TrainingCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            if epoch == DIE_AT:
+                raise RuntimeError("injected crash")
+            return False
+
+    killed = False
+    try:
+        xgb.train(params, _make_dm(tier, X, y, tmp, tag + "_k"),
+                  ROUNDS, checkpoint=ck, callbacks=[Die()],
+                  verbose_eval=False)
+    except RuntimeError:
+        killed = True
+    if not killed:
+        return cell, "FAIL(no-kill)"
+
+    if corrupt_newest:
+        snaps = list_snapshots(ckdir)
+        if not snaps:
+            return cell, "FAIL(no-snapshot)"
+        newest = snaps[0][1]
+        with open(newest, "r+b") as fh:
+            fh.truncate(os.path.getsize(newest) // 2)
+
+    resumed = xgb.train(params, _make_dm(tier, X, y, tmp, tag + "_r"),
+                        ROUNDS, checkpoint=ck, verbose_eval=False)
+    got = bytes(resumed.save_raw("ubj"))
+    if got != want:
+        p1 = np.asarray(straight.predict(xgb.DMatrix(X)))
+        p2 = np.asarray(resumed.predict(xgb.DMatrix(X)))
+        gap = float(np.abs(p1 - p2).max())
+        return cell, f"FAIL(model-gap max_pred_diff={gap:g})"
+    return cell, "OK"
+
+
+def _run_multirank_mid_collective(tmp):
+    """2-rank in-memory world, kill BOTH ranks at an arbitrary collective
+    op INSIDE round DIE_AT (FaultPlan fail_round + fail_at_op through the
+    paged tier's per-level hist allreduce), resume from the agreed
+    snapshot, compare against the straight 2-rank run — byte equality on
+    every rank."""
+    import threading
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.data.dmatrix import DataIter
+    from xgboost_tpu.parallel import resilience as R
+    from xgboost_tpu.parallel.collective import (
+        InMemoryCommunicator, set_thread_local_communicator)
+
+    cell = "paged-2rank/logistic/plain/mid-collective"
+    X, y = _data("binary:logistic")
+    half = len(y) // 2
+    shards = [(X[:half], y[:half]), (X[half:], y[half:])]
+    params = {"max_depth": 4, "eta": 0.3, "max_bin": 32,
+              "objective": "binary:logistic"}
+
+    class OneShot(DataIter):
+        def __init__(self, Xr, yr, prefix):
+            super().__init__(cache_prefix=prefix)
+            self.X, self.y, self._done = Xr, yr, False
+
+        def next(self, input_data):
+            if self._done:
+                return 0
+            self._done = True
+            input_data(data=self.X, label=self.y)
+            return 1
+
+        def reset(self):
+            self._done = False
+
+    def run_world(tag, plan_fn=None, ck=False):
+        comms = InMemoryCommunicator.make_world(2)
+        res, errs = [None] * 2, [[] for _ in range(2)]
+
+        def worker(rank):
+            comm = comms[rank]
+            if plan_fn is not None:
+                comm = R.FaultyCommunicator(comm, plan_fn())
+            set_thread_local_communicator(comm)
+            try:
+                Xr, yr = shards[rank]
+                qdm = xgb.QuantileDMatrix(
+                    OneShot(Xr, yr, os.path.join(tmp, f"mc_{tag}{rank}")),
+                    max_bin=32)
+                cfg = (xgb.CheckpointConfig(
+                    directory=os.path.join(tmp, f"mc_ck{rank}"),
+                    every_n_rounds=EVERY) if ck else None)
+                bst = xgb.train(params, qdm, ROUNDS, checkpoint=cfg,
+                                verbose_eval=False)
+                res[rank] = bytes(bst.save_raw("ubj"))
+            except Exception as e:  # noqa: BLE001 - reported below
+                errs[rank].append(e)
+            finally:
+                set_thread_local_communicator(None)
+
+        ts = [threading.Thread(target=worker, args=(r,), daemon=True)
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(600)
+        return res, errs
+
+    straight, errs = run_world("s")
+    if any(errs) or straight[0] != straight[1]:
+        return cell, f"FAIL(straight-run {errs})"
+    _, errs = run_world("k", plan_fn=lambda: R.FaultPlan(
+        fail_round=DIE_AT, fail_at_op=2, transient=False), ck=True)
+    if not all(e and isinstance(e[0], R.CollectiveFault) for e in errs):
+        return cell, f"FAIL(no-kill {errs})"
+    resumed, errs = run_world("r", ck=True)
+    if any(errs):
+        return cell, f"FAIL(resume {errs})"
+    if resumed[0] != resumed[1] or resumed[0] != straight[0]:
+        return cell, "FAIL(model-gap)"
+    return cell, "OK"
+
+
+def main():
+    import tempfile
+
+    os.environ.setdefault("XTPU_PAGE_ROWS", str(max(N // 8, 100)))
+    os.environ.setdefault("XTPU_PAGED_COLLAPSE", "0")
+    results = {}
+    ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        for tier in ("resident", "paged", "mesh"):
+            for obj_name, obj_params in OBJECTIVES:
+                for samp_name, samp_params in SAMPLING:
+                    cell, verdict = _run_cell(tier, obj_name, obj_params,
+                                              samp_name, samp_params, tmp)
+                    results[cell] = verdict
+                    ok &= verdict == "OK"
+                    print(f"{cell:48s} {verdict}", flush=True)
+        # adversarial cases on the cheapest objective
+        for kwargs in ({"corrupt_newest": True},):
+            for tier in ("resident", "paged"):
+                cell, verdict = _run_cell(
+                    tier, "logistic", OBJECTIVES[0][1], "plain", {}, tmp,
+                    **kwargs)
+                results[cell] = verdict
+                ok &= verdict == "OK"
+                print(f"{cell:48s} {verdict}", flush=True)
+        cell, verdict = _run_multirank_mid_collective(tmp)
+        results[cell] = verdict
+        ok &= verdict == "OK"
+        print(f"{cell:48s} {verdict}", flush=True)
+
+    print(json.dumps({"pass": ok, "cells": results}))
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

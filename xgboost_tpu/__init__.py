@@ -43,6 +43,7 @@ from .sklearn import (XGBClassifier, XGBModel, XGBRanker, XGBRegressor,
                       XGBRFClassifier, XGBRFRegressor)
 from .training import cv
 from .tree.param import TrainParam
+from .utils.checkpoint import CheckpointConfig, TrainingSnapshot
 
 # Populate the component registries that live in lazily-imported modules
 # (grow/gblinear load via core above): TREE_UPDATERS (grow_colmaker,
@@ -80,5 +81,6 @@ __all__ = [
     "XGBRFRegressor", "XGBRFClassifier",
     "plot_importance", "plot_tree", "to_graphviz",
     "config_context", "set_config", "get_config",
-    "load_xgboost_model", "save_xgboost_model", "__version__",
+    "load_xgboost_model", "save_xgboost_model",
+    "CheckpointConfig", "TrainingSnapshot", "__version__",
 ]

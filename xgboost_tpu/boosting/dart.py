@@ -247,6 +247,46 @@ class Dart(GBTree):
         self._store(state, m)
         return m
 
+    def on_resume(self, state: dict) -> None:
+        """Checkpoint resume (core._prime_resume): the snapshot's margin IS
+        this booster's cached full-forest margin at the captured round —
+        seed the roll-forward cache with those exact bits. Recomputing it
+        by a fresh forest walk would reassociate the per-round sums and
+        fork the resumed run from the straight one by an ulp.
+
+        The per-round delta ring is rebuilt the same way: resumed rounds
+        must take the SAME drop-sum path (one weighted reduction over the
+        ring) as the uninterrupted run, or the two runs' margins diverge
+        by reassociation. A binned walk of one round's trees at unit
+        weight reproduces the grow-time delta bit-for-bit (same positions,
+        same leaf gathers)."""
+        self._store(state, state["margin"])
+        if self._dcache_off or state.get("binned") is None:
+            return
+        from ..tree.tree import stack_forest
+        from .gbtree import match_rows
+        from .predict import ForestPredictor
+
+        trees = self.trees
+        binned = state["binned"]
+        zero = np.zeros(self.n_groups, np.float32)
+        n = state["base"].shape[0]
+        for it in range(len(self.iteration_indptr) - 1):
+            lo, hi = self.iteration_indptr[it], self.iteration_indptr[it + 1]
+            if hi - lo != self.n_groups or self.num_parallel_tree != 1:
+                state.pop("dart_deltas", None)
+                return
+            pred = ForestPredictor(stack_forest(trees[lo:hi]),
+                                   np.asarray(self.tree_info[lo:hi]),
+                                   self.n_groups)  # UNIT weights
+            if getattr(binned, "is_paged", False):
+                delta = self._margin_binned_paged(pred, binned, zero)
+            else:
+                delta, _ = pred.margin_binned(binned.bins,
+                                              binned.missing_bin, zero)
+            self._cache_round_delta(state, match_rows(jnp.asarray(delta), n),
+                                    lo, hi - lo)
+
     def do_boost(self, state, gpair, iteration, key, obj=None, margin=None):
         start = len(self._trees)
         w_pre = np.asarray(self.weight_drop, np.float64).copy()

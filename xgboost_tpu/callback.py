@@ -128,6 +128,17 @@ class EarlyStopping(TrainingCallback):
 
     def before_training(self, model):
         self.starting_round = model.num_boosted_rounds()
+        if self.starting_round > 0 and not self.best_scores:
+            # continuation / checkpoint resume: pick the patience window
+            # back up from the booster attributes (persisted below and
+            # through every save_raw/snapshot) instead of resetting it —
+            # a resumed run must stop at the same round the straight run
+            # would have (tests/test_checkpoint.py pins this)
+            bs = model.attr("best_score")
+            if bs is not None:
+                self.best_scores = [float(bs)]
+                since = model.attr("rounds_since_improvement")
+                self.current_rounds = int(since) if since is not None else 0
         return model
 
     def _is_better(self, new: float, best: float) -> bool:
@@ -155,6 +166,8 @@ class EarlyStopping(TrainingCallback):
             self.current_rounds = 0
         else:
             self.current_rounds += 1
+        # persisted with the model, restored by before_training on resume
+        model.set_attr(rounds_since_improvement=str(self.current_rounds))
         return self.current_rounds >= self.rounds
 
     def after_training(self, model):
@@ -179,13 +192,42 @@ class LearningRateScheduler(TrainingCallback):
 
 
 class TrainingCheckPoint(TrainingCallback):
+    """Periodic model checkpoints (reference callback.py TrainingCheckPoint).
+
+    Files are written ATOMICALLY (tmp + fsync + ``os.replace``): the old
+    direct-write left a truncated "latest" checkpoint when a crash landed
+    mid-write — exactly the artifact a recovery run would then load.
+    ``keep=N`` prunes older checkpoints as new ones land (None keeps all).
+    For bit-exact full-state recovery use ``CheckpointConfig`` instead
+    (docs/reliability.md); this callback stores the model only.
+    """
+
     def __init__(self, directory: str, name: str = "model",
-                 as_pickle: bool = False, interval: int = 100) -> None:
+                 as_pickle: bool = False, interval: int = 100,
+                 keep: Optional[int] = None) -> None:
         self.dir = directory
         self.name = name
         self.as_pickle = as_pickle
         self.interval = max(1, interval)
+        self.keep = keep
+        if keep is not None and keep < 1:
+            raise ValueError(f"keep must be >= 1 or None, got {keep}")
         self._epoch = 0
+        self._written: List[str] = []
+
+    def _write(self, model, path: str) -> None:
+        if self.as_pickle:
+            import pickle
+
+            raw = pickle.dumps(model)
+        else:
+            raw = bytes(model.save_raw("json"))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(raw)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
 
     def after_iteration(self, model, epoch, evals_log) -> bool:
         if self._epoch == self.interval:
@@ -193,11 +235,13 @@ class TrainingCheckPoint(TrainingCallback):
                 self.dir,
                 f"{self.name}_{epoch}." + ("pkl" if self.as_pickle else "json"))
             self._epoch = 0
-            if self.as_pickle:
-                import pickle
-                with open(path, "wb") as fh:
-                    pickle.dump(model, fh)
-            else:
-                model.save_model(path)
+            self._write(model, path)
+            self._written.append(path)
+            while self.keep is not None and len(self._written) > self.keep:
+                stale = self._written.pop(0)
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
         self._epoch += 1
         return False

@@ -11,7 +11,10 @@ booster parameters): ``task`` (train|dump|pred), ``data``, ``test:data``,
 ``eval[NAME]``, ``num_round``, ``model_in``, ``model_out``, ``model_dir``,
 ``save_period``, ``name_dump``, ``name_pred``, ``dump_format``,
 ``dump_stats``, ``fmap``, ``pred_margin``, ``iteration_begin``,
-``iteration_end``, ``silent``.
+``iteration_end``, ``silent``, plus fault tolerance (docs/reliability.md):
+``checkpoint_dir``, ``checkpoint_every``, ``checkpoint_keep``, ``resume``
+(full-state snapshots + bit-exact auto-resume; re-running a killed train
+command converges to the uninterrupted run's model).
 
 Beyond the reference tasks there is an inference-serving mode (no config
 file — key=value args only; see ``serve/frontend.py`` / docs/serving.md):
@@ -31,6 +34,10 @@ _CLI_KEYS = {
     "model_dir", "save_period", "name_dump", "name_pred", "dump_format",
     "dump_stats", "fmap", "pred_margin", "iteration_begin", "iteration_end",
     "silent",
+    # fault tolerance (docs/reliability.md): full-state snapshots every
+    # checkpoint_every rounds into checkpoint_dir; resume=auto (default
+    # when checkpoint_dir is set) continues a killed run bit-exactly
+    "checkpoint_dir", "checkpoint_every", "checkpoint_keep", "resume",
 }
 
 
@@ -80,9 +87,21 @@ def _train(cfg: Dict[str, str], evals: List[Tuple[str, str]],
         callbacks.append(TrainingCheckPoint(
             directory=model_dir or ".", name="model",
             interval=save_period))
+    checkpoint = None
+    ck_dir = cfg.get("checkpoint_dir")
+    if ck_dir and ck_dir.lower() != "null":
+        from .utils.checkpoint import CheckpointConfig
+
+        checkpoint = CheckpointConfig(
+            directory=ck_dir,
+            every_n_rounds=int(cfg.get("checkpoint_every", "10")),
+            keep=int(cfg.get("checkpoint_keep", "3")),
+            resume=(cfg.get("resume", "auto").lower()
+                    not in ("0", "false", "none")) and "auto")
     bst = core.train(params, dtrain, num_round, evals=watch,
                      xgb_model=xgb_model,
-                     verbose_eval=not silent, callbacks=callbacks)
+                     verbose_eval=not silent, callbacks=callbacks,
+                     checkpoint=checkpoint)
     model_out = cfg.get("model_out", "")
     if not model_out or model_out.lower() == "null":
         model_out = os.path.join(model_dir or ".", f"{num_round:04d}.model")

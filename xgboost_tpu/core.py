@@ -1534,6 +1534,85 @@ class Booster:
         self._configured = True
         self._caches = {}
 
+    # ------------------------------------------------------------- snapshots
+    def make_snapshot(self, dtrain: Optional[DMatrix] = None,
+                      fingerprint: Optional[Dict[str, Any]] = None,
+                      round_: Optional[int] = None):
+        """Full recoverable training state (``utils.checkpoint``): model +
+        round counter + the training-cache MARGIN. The margin is the hidden
+        accumulator that makes resume bit-exact — recomputing it from the
+        trees sums leaf deltas in a different order than training
+        accumulated them, which forks the models by an ulp (why the old
+        recovery contract was rtol). RNG needs no stream state: every key
+        is a stateless function of ``(seed, iteration)``."""
+        from .utils.checkpoint import TrainingSnapshot
+
+        margin = None
+        state = self._caches.get(id(dtrain)) if dtrain is not None else None
+        if state is not None and state.get("is_train"):
+            m = state["margin"]
+            if not (isinstance(m, jax.Array)
+                    and not m.is_fully_addressable):
+                # trim mesh/page padding: pad rows carry zero weight, so
+                # their margins never reach a gradient — restore re-pads
+                # with zeros (multi-controller arrays are not host-visible;
+                # those snapshots fall back to model-only = rtol resume)
+                margin = np.asarray(m, np.float32)[: state["n_valid"]]
+        extra: Dict[str, Any] = {}
+        # stateful booster RNG streams (dart's drop selection): the key-based
+        # tree PRNG is stateless, but np.random.RandomState streams consume
+        # state per round and must resume mid-stream
+        brng = getattr(self.gbm, "_rng", None)
+        if brng is not None and hasattr(brng, "get_state"):
+            alg, keys, pos, has_gauss, cached = brng.get_state()
+            extra["booster_rng"] = {
+                "alg": str(alg), "keys": np.asarray(keys, np.int64),
+                "pos": int(pos), "has_gauss": int(has_gauss),
+                "cached": float(cached)}
+        return TrainingSnapshot(
+            round=int(round_ if round_ is not None
+                      else self.num_boosted_rounds()),
+            model=bytes(self.save_raw("ubj")),
+            margin=margin,
+            fingerprint=dict(fingerprint or {}),
+            rng={"seed": int(self.ctx.seed),
+                 "seed_per_iteration": bool(self.ctx.seed_per_iteration)},
+            extra=extra)
+
+    def _prime_resume(self, dtrain: DMatrix, snap) -> None:
+        """Install a snapshot's margin into the training cache so the next
+        ``update`` continues from the exact interrupted state instead of
+        re-deriving the margin through the (order-divergent) continuation
+        walk. No-op when the snapshot carried no margin — the standard
+        xgb_model continuation fold then applies (rtol-grade resume)."""
+        self._configure(dtrain)
+        state = self._state_of(dtrain, is_train=True)
+        st = snap.extra.get("booster_rng") if snap.extra else None
+        brng = getattr(self.gbm, "_rng", None)
+        if st is not None and brng is not None \
+                and hasattr(brng, "set_state"):
+            brng.set_state((st["alg"],
+                            np.asarray(st["keys"]).astype(np.uint32),
+                            int(st["pos"]), int(st["has_gauss"]),
+                            float(st["cached"])))
+        if snap.margin is None:
+            return
+        m = jnp.asarray(np.asarray(snap.margin, np.float32))
+        cur = state["margin"]
+        if m.ndim == 1:
+            m = m[:, None]
+        if m.shape[0] < cur.shape[0]:  # re-extend mesh/page pad rows
+            m = jnp.concatenate(
+                [m, jnp.zeros((cur.shape[0] - m.shape[0], m.shape[1]),
+                              jnp.float32)])
+        if isinstance(cur, jax.Array) and self.ctx.mesh is not None:
+            m = jax.device_put(m, cur.sharding)
+        state["margin"] = m
+        state["n_trees"] = self.gbm.version()
+        hook = getattr(self.gbm, "on_resume", None)
+        if hook is not None:
+            hook(state)
+
     def __getstate__(self):
         return {"raw": bytes(self.save_raw("json"))}
 
@@ -1719,10 +1798,18 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
           verbose_eval: Union[bool, int, None] = True,
           xgb_model: Optional[Union[str, Booster]] = None,
           callbacks: Optional[Sequence] = None,
-          custom_metric: Optional[Callable] = None) -> Booster:
-    """Train loop (reference ``python-package/xgboost/training.py:178``)."""
+          custom_metric: Optional[Callable] = None,
+          checkpoint: Optional[Any] = None) -> Booster:
+    """Train loop (reference ``python-package/xgboost/training.py:178``).
+
+    ``checkpoint``: a ``CheckpointConfig`` enabling full-state snapshots
+    every N rounds plus auto-resume (docs/reliability.md). On auto-resume
+    ``num_boost_round`` is the TOTAL round target, so re-running the
+    identical command after a crash converges to the straight-run model —
+    bit-exactly (``tools/validate_resume.py`` gates this)."""
     from .callback import (CallbackContainer, EarlyStopping,
                            EvaluationMonitor)
+    from .parallel import collective
 
     callbacks = list(callbacks) if callbacks else []
     # Round batching: valid when NOTHING consumes per-round output. Decided
@@ -1739,7 +1826,20 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
     metric_fn = custom_metric if custom_metric is not None else feval
     container = CallbackContainer(callbacks, metric=metric_fn)
 
-    if isinstance(xgb_model, Booster):
+    ck = None
+    resumed = None
+    if checkpoint is not None:
+        from .utils.checkpoint import CheckpointManager
+
+        ck = CheckpointManager(checkpoint)
+        if xgb_model is None:
+            resumed = ck.find_resume(dtrain)
+
+    if resumed is not None:
+        bst = Booster(params)
+        bst.load_model(resumed.model)
+        bst.set_param(params)
+    elif isinstance(xgb_model, Booster):
         bst = xgb_model
         bst.set_param(params)
     elif xgb_model is not None:
@@ -1747,28 +1847,54 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
     else:
         bst = Booster(params)
 
+    if ck is not None:
+        ck.ensure_fingerprint(dtrain)
+    if resumed is not None:
+        bst._prime_resume(dtrain, resumed)
+
     bst = container.before_training(bst)
     start = bst.num_boosted_rounds()
     # Largest power-of-two chunks <= XTPU_BATCH_ROUNDS: each chunk is one
     # device dispatch (lax.scan), and pow2 sizing bounds the set of distinct
-    # scan lengths — i.e. compiled programs — to log2(max) + 1.
+    # scan lengths — i.e. compiled programs — to log2(max) + 1. Checkpoint
+    # boundaries additionally cap a chunk so snapshots land exactly every
+    # N rounds (scan-batched rounds are bit-identical to sequential ones,
+    # so chunk geometry never changes the model).
     batch_max = int(os.environ.get("XTPU_BATCH_ROUNDS", "16"))
     i = start
-    end = start + num_boost_round
-    while i < end:
-        if batchable and end - i >= 2 and batch_max >= 2:
-            k = 1 << (min(batch_max, end - i).bit_length() - 1)
-            if bst.update_batch(dtrain, list(range(i, i + k))):
-                i += k
-                continue
-            # config needs the per-round path (or a continuation bootstrap
-            # round) — fall through; retried next iteration
-        if container.before_iteration(bst, i):
-            break
-        bst.update(dtrain, i, fobj=obj)
-        if container.after_iteration(bst, i, list(evals)):
-            break
-        i += 1
+    # auto-resume treats num_boost_round as the TOTAL target (see docstring)
+    end = (max(start, num_boost_round) if resumed is not None
+           else start + num_boost_round)
+    try:
+        while i < end:
+            collective.notify_round(i)
+            lim = min(batch_max, end - i)
+            if ck is not None:
+                lim = min(lim, ck.rounds_to_boundary(i))
+            if batchable and lim >= 2:
+                k = 1 << (lim.bit_length() - 1)
+                if bst.update_batch(dtrain, list(range(i, i + k))):
+                    i += k
+                    if ck is not None:
+                        ck.maybe_save(bst, dtrain, i, force=(i == end))
+                    continue
+                # config needs the per-round path (or a continuation
+                # bootstrap round) — fall through; retried next iteration
+            if container.before_iteration(bst, i):
+                break
+            bst.update(dtrain, i, fobj=obj)
+            stop = container.after_iteration(bst, i, list(evals))
+            i += 1
+            if ck is not None:
+                ck.maybe_save(bst, dtrain, i, force=(stop or i == end))
+            if stop:
+                break
+    finally:
+        # flush pending background snapshot writes even when the round
+        # loop dies — the snapshot being flushed is exactly what the
+        # relaunched run will resume from
+        if ck is not None:
+            ck.close()
     bst = container.after_training(bst)
     bst._monitor.maybe_print()  # one cumulative table (reference: destructor)
 

@@ -25,6 +25,32 @@ import numpy as np
 from .quantile import HistogramCuts
 
 
+def _retry_io(fn, what: str, attempts: Optional[int] = None,
+              base_delay_s: float = 0.05):
+    """Bounded retry with exponential backoff for host<->device IO
+    (page uploads, iterator batches): transient transport failures against
+    a remote TPU (tunnel hiccup, preempted transfer) retry before the run
+    aborts (docs/reliability.md graceful degradation). Attempts beyond the
+    first are logged; the final failure re-raises the original error."""
+    import os
+    import time
+
+    from ..logging_utils import logger
+
+    if attempts is None:
+        attempts = int(os.environ.get("XTPU_IO_RETRIES", "2"))
+    for a in range(attempts + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - re-raised on exhaustion
+            if a >= attempts:
+                raise
+            delay = base_delay_s * (2.0 ** a)
+            logger.warning("%s failed (%s); retry %d/%d in %.0f ms",
+                           what, e, a + 1, attempts, delay * 1e3)
+            time.sleep(delay)
+
+
 def _dtype_for(max_local_bins: int):
     if max_local_bins <= np.iinfo(np.uint8).max:
         return np.uint8
@@ -437,7 +463,8 @@ class PagedBinnedMatrix:
             host = np.ascontiguousarray(self.bins_host[s:e])
             if self.packed:
                 host = self._pack_host(host)
-            page = jax.device_put(host, device)
+            page = _retry_io(lambda: jax.device_put(host, device),
+                             f"page upload [{s}:{e}]")
         else:
             page = cached[1]
         return s, e, page, uploaded
@@ -575,18 +602,32 @@ class PagedBinnedMatrix:
                 or os.environ.get("XTPU_PAGED_COLLAPSE") == "0"):
             return None
         if self._resident is None:
-            bins = None
-            got_page = False
-            for s, e, p in self.pages():
-                got_page = True
-                p = self.decode_page(p)  # packed transport -> [p, F] ids
-                if bins is None:
-                    bins = jnp.zeros((self.n_rows, self.n_features),
-                                     p.dtype)
-                bins = _collapse_page(bins, p, np.int32(s))
-                # the copy above is the entry's last consumer: free the
-                # cached page now, before the next page uploads
-                self._device_cache.pop(s, None)
+            try:
+                bins = None
+                got_page = False
+                for s, e, p in self.pages():
+                    got_page = True
+                    p = self.decode_page(p)  # packed transport -> [p, F] ids
+                    if bins is None:
+                        bins = jnp.zeros((self.n_rows, self.n_features),
+                                         p.dtype)
+                    bins = _collapse_page(bins, p, np.int32(s))
+                    # the copy above is the entry's last consumer: free the
+                    # cached page now, before the next page uploads
+                    self._device_cache.pop(s, None)
+            except Exception as e:  # noqa: BLE001 - degrade, don't abort
+                # graceful degradation: an allocation failure mid-collapse
+                # (the budget admits the matrix but the DEVICE doesn't —
+                # fragmentation, other residents) must not abort the run;
+                # drop the partial buffer and keep the streaming tier,
+                # which bounds device memory to the page cache
+                from ..logging_utils import logger
+
+                logger.warning(
+                    "resident collapse failed (%s); falling back to the "
+                    "streaming paged tier", e)
+                self._device_cache.clear()
+                return None
             if not got_page:
                 return None
             self._resident = BinnedMatrix(

@@ -593,7 +593,17 @@ class DataIter:
         raise NotImplementedError
 
     def collect(self) -> Iterator[dict]:
-        """Drive the callback protocol and yield raw batch dicts."""
+        """Drive the callback protocol and yield raw batch dicts.
+
+        A ``next()`` that raises (transient read failure on the batch
+        source) is retried with backoff before the error propagates —
+        external-memory iterators typically front object stores or network
+        filesystems where one failed read should not kill an hours-long
+        run (docs/reliability.md). Each retry re-invokes ``next`` with a
+        fresh collector, so a partially-delivered batch is discarded, not
+        duplicated."""
+        from .binned import _retry_io
+
         self.reset()
         while True:
             batches: List[dict] = []
@@ -601,7 +611,11 @@ class DataIter:
             def input_data(**kwargs: Any) -> None:
                 batches.append(kwargs)
 
-            if not self.next(input_data):
+            def step() -> int:
+                batches.clear()
+                return self.next(input_data)
+
+            if not _retry_io(step, "data iterator next()"):
                 break
             for b in batches:
                 yield b

@@ -291,6 +291,16 @@ def allgather(data: Any) -> List[Any]:
     return get_communicator().allgather_objects(data)
 
 
+def notify_round(iteration: int) -> None:
+    """Announce a boosting-round boundary to round-aware communicators
+    (``FaultyCommunicator`` fault schedules keyed on rounds,
+    ``ResilientCommunicator`` forwarding). A plain communicator ignores
+    it — the hook costs one getattr per round."""
+    cb = getattr(get_communicator(), "on_round", None)
+    if cb is not None:
+        cb(iteration)
+
+
 def communicator_print(msg: Any) -> None:
     """Rank-prefixed print (reference ``collective.communicator_print``)."""
     print(f"[{get_rank()}] {msg}", flush=True)
@@ -339,8 +349,11 @@ def merge_summaries(local: list, max_bin: int,
     comm = comm or get_communicator()
     if not comm.is_distributed():
         return local
+    from .resilience import op_context
+
     payload = [(s.values, s.weights) for s in local]
-    gathered = comm.allgather_objects(payload)
+    with op_context("sketch/merge"):
+        gathered = comm.allgather_objects(payload)
     widths = [len(g) for g in gathered]
     if len(set(widths)) != 1:
         # zip would silently truncate to the shortest list, destroying the

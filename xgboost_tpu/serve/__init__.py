@@ -19,8 +19,8 @@ Frontends: ``python -m xgboost_tpu serve model=... [http_port=...]``
 
 from .buckets import BucketLadder, RecompileCounter
 from .client import ServeClient
-from .errors import (DeadlineExceeded, ServeError, ServerClosed,
-                     ServerOverloaded, UnknownModel)
+from .errors import (DeadlineExceeded, ModelLoadError, ServeError,
+                     ServerClosed, ServerOverloaded, UnknownModel)
 from .metrics import LatencyHistogram, ServeMetrics
 from .registry import ModelRegistry, ServedModel
 from .server import ServeConfig, Server
@@ -31,5 +31,5 @@ __all__ = [
     "ModelRegistry", "ServedModel",
     "ServeMetrics", "LatencyHistogram",
     "ServeError", "ServerOverloaded", "DeadlineExceeded",
-    "ServerClosed", "UnknownModel",
+    "ServerClosed", "UnknownModel", "ModelLoadError",
 ]

@@ -39,5 +39,18 @@ class ServerClosed(ServeError):
 class UnknownModel(ServeError, KeyError):
     """No served model under the requested name."""
 
+
+class ModelLoadError(ServeError):
+    """The model source could not be loaded (corrupted/truncated bytes, a
+    file that parses as neither native nor reference xgboost, a booster
+    that fails to configure).
+
+    Raised by ``ModelRegistry.load``/``prepare`` BEFORE anything is
+    published: a failed ``load`` leaves the registry unchanged and a
+    failed hot-``swap`` keeps the previous version live — in-flight and
+    subsequent requests keep serving the old model (rollback-on-failed-
+    swap, tested mid-stream in tests/test_serve.py).
+    """
+
     def __str__(self) -> str:  # KeyError quotes repr(args); keep a message
         return RuntimeError.__str__(self)

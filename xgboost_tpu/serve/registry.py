@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from .errors import UnknownModel
+from .errors import ModelLoadError, UnknownModel
 
 
 def _load_booster(source):
@@ -44,7 +44,22 @@ def _load_booster(source):
 
             return load_xgboost_model(source)
         except Exception:
-            raise native_err from None
+            # typed so the serving layer can roll back: a corrupted or
+            # truncated source must never evict the live version
+            raise ModelLoadError(
+                f"cannot load model from {type(source).__name__} source: "
+                f"{native_err}") from native_err
+
+
+def _build_served(name: str, booster, version: int) -> "ServedModel":
+    """Construct (configure + pin) a ServedModel; failures surface as
+    ``ModelLoadError`` so a swap can roll back to the live version."""
+    try:
+        return ServedModel(name, booster, version=version)
+    except Exception as e:
+        raise ModelLoadError(
+            f"model '{name}' loaded but failed to prepare for serving: "
+            f"{e}") from e
 
 
 class ServedModel:
@@ -112,7 +127,7 @@ class ModelRegistry:
                     f"model '{name}' is already served; use swap")
             v = (int(version) if version is not None
                  else self._versions.get(name, 0) + 1)
-            sm = ServedModel(name, booster, version=v)
+            sm = _build_served(name, booster, v)
             self._publish(sm)
             return sm
 
@@ -124,7 +139,7 @@ class ModelRegistry:
         with self._lock:
             v = (int(version) if version is not None
                  else self._versions.get(name, 0) + 1)
-        return ServedModel(name, booster, version=v)
+        return _build_served(name, booster, v)
 
     def publish(self, sm: ServedModel) -> ServedModel:
         with self._lock:

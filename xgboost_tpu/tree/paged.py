@@ -920,13 +920,19 @@ def _host_allreduce(arr: jnp.ndarray) -> jnp.ndarray:
     re-read on every call, never cached: growers persist on the booster
     across training continuations, and a communicator captured at
     construction would go stale (silently skipping the allreduce, or
-    calling a dead one)."""
+    calling a dead one). The op is labeled for the resilient layer's
+    integrity header: a rank stuck in the paged histogram reduce while a
+    peer entered e.g. the sketch merge surfaces as a typed
+    ``CollectiveDesync`` naming both call sites (docs/reliability.md)."""
     from ..parallel import collective
+    from ..parallel.resilience import op_context
 
     comm = collective.get_communicator()
     if not comm.is_distributed():
         return arr
-    return jnp.asarray(comm.allreduce(np.asarray(arr, np.float32), op="sum"))
+    with op_context("paged/hist"):
+        return jnp.asarray(comm.allreduce(np.asarray(arr, np.float32),
+                                          op="sum"))
 
 
 class _MeshPageKernels:

@@ -83,7 +83,22 @@ def _write(obj: Any, out: io.BytesIO) -> None:
         elif isinstance(obj, np.floating):
             out.write(b"D" + struct.pack(">d", float(obj)))
         elif isinstance(obj, np.ndarray):
-            _write(obj.tolist(), out)
+            tag = _TYPED_TAG.get(obj.dtype.str.lstrip("<>=|"))
+            if obj.ndim == 1 and tag is not None:
+                # strongly-typed sized array ("[$<t>#<n><payload>"): the
+                # reference UBJWriter emits these for model arrays and our
+                # reader already decodes them — 1 byte/element for u8
+                # (snapshot payloads) vs 9 for element-wise D tags
+                out.write(b"[$" + tag + b"#")
+                _write_int(obj.shape[0], out)
+                out.write(np.ascontiguousarray(
+                    obj, obj.dtype.newbyteorder(">")).tobytes())
+            else:
+                _write(obj.tolist(), out)
+        elif isinstance(obj, (bytes, bytearray, memoryview)):
+            import numpy as np
+
+            _write(np.frombuffer(bytes(obj), np.uint8), out)
         else:
             raise TypeError(f"cannot UBJSON-encode {type(obj)}")
 
@@ -94,6 +109,10 @@ _INT_FMT = {b"i": (">b", 1), b"U": (">B", 1), b"I": (">h", 2),
 # strongly-typed array payload dtypes (big-endian per the UBJSON spec)
 _TYPED_DTYPE = {b"i": ">i1", b"U": ">u1", b"I": ">i2", b"l": ">i4",
                 b"L": ">i8", b"d": ">f4", b"D": ">f8"}
+
+# inverse map for the writer (numpy dtype.str without byte order -> tag)
+_TYPED_TAG = {"i1": b"i", "u1": b"U", "i2": b"I", "i4": b"l", "i8": b"L",
+              "f4": b"d", "f8": b"D"}
 
 
 def _read_int(raw: bytes, pos: int):
