@@ -332,6 +332,76 @@ def bench_shard1375k():
             else None)
 
 
+def bench_pipeline():
+    """Continuous train->serve loop SLOs (docs/pipeline.md). Three keys:
+    ``pipeline_promotion_ms`` — wall-clock of the atomic serve swap
+    (artifact read + warm + publish) for the LAST promotion;
+    ``pipeline_rounds_behind`` — lineage lag after the loop drains
+    (0 = every ingested page decided); ``pipeline_replay_byte_equal`` —
+    the crash-recovery contract measured end to end: a run killed
+    mid-epoch and resumed by a fresh pipeline produces promoted
+    artifacts byte-identical to the uninterrupted run. Skip with
+    BENCH_PIPELINE=0."""
+    import shutil
+    import tempfile
+
+    from xgboost_tpu.pipeline import (GateRule, KilledByChaos, Pipeline,
+                                      PipelineConfig, PipelineFaultPlan)
+    from xgboost_tpu.serve import Server
+
+    n, f, k, epochs = 20_000, COLS, 5, 3
+    rng = np.random.RandomState(17)
+    w = rng.randn(f)
+
+    def page(e):
+        r = np.random.RandomState(100 + e)
+        X = r.randn(n, f).astype(np.float32)
+        y = (X @ w + 0.2 * r.randn(n) > 0).astype(np.float32)
+        return X, y
+
+    holdout = page(99)
+    tmp = tempfile.mkdtemp(prefix="xtpu_bench_pipe_")
+    params = {**PARAMS, "max_bin": 64}
+
+    def cfg(wd):
+        return PipelineConfig(workdir=os.path.join(tmp, wd), params=params,
+                              rounds_per_epoch=k,
+                              gates=(GateRule("auc", max_regression=0.05),),
+                              checkpoint_every=2)
+
+    def artifacts(wd):
+        d = os.path.join(tmp, wd, "models")
+        return {fn: open(os.path.join(d, fn), "rb").read()
+                for fn in sorted(os.listdir(d)) if fn.endswith(".ubj")}
+
+    try:
+        srv = Server()
+        pipe = Pipeline(cfg("straight"), server=srv, holdout=holdout)
+        for e in range(epochs):
+            pipe.step(*page(e))
+        status = pipe.status()
+        promotion_ms = status["last_promotion_ms"]
+        rounds_behind = status["rounds_behind"]
+        srv.close()
+
+        plan = PipelineFaultPlan(kill_stage="mid_epoch", kill_epoch=1,
+                                 kill_round=k + 2)
+        killed = Pipeline(cfg("killed"), holdout=holdout, chaos=plan)
+        try:
+            for e in range(epochs):
+                killed.step(*page(e))
+        except KilledByChaos:
+            pass
+        resumed = Pipeline(cfg("killed"), holdout=holdout)
+        resumed.run_pending()
+        for e in range(resumed.log.count(), epochs):
+            resumed.step(*page(e))
+        byte_equal = artifacts("killed") == artifacts("straight")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return (round(promotion_ms, 3), int(rounds_behind), bool(byte_equal))
+
+
 def bench_checkpoint_overhead(X, y):
     """Full-state checkpointing cost at the headline shape: round time with
     ``CheckpointConfig(every_n_rounds=10)`` vs none, as a percentage. The
@@ -427,6 +497,14 @@ def main():
             bench_dart_multiclass(), 3)
     if os.environ.get("BENCH_RANK", "1") != "0":
         result["rank_unbiased_rounds_per_sec"] = bench_rank_unbiased()
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        # continuous train->serve pipeline (docs/pipeline.md): swap
+        # latency, lineage lag, and the crash-recovery byte-exactness
+        # contract measured end to end
+        promo_ms, behind, byte_equal = bench_pipeline()
+        result["pipeline_promotion_ms"] = promo_ms
+        result["pipeline_rounds_behind"] = behind
+        result["pipeline_replay_byte_equal"] = byte_equal
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # inference-serving SLOs (tools/bench_serve.py): open-loop mixed
         # 1/8/64/512-row workload through the micro-batcher; the four

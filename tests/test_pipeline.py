@@ -1,0 +1,499 @@
+"""Continuous train->serve pipeline: crash-safety, drift gates,
+promotion/rollback, byte-exact replay (docs/pipeline.md).
+
+The central invariant under test: every promoted artifact is a
+deterministic function of the durable page-log prefix, so killing the
+loop at ANY stage boundary and restarting over the same workdir yields
+byte-identical promoted models — snapshots only make recovery cheaper,
+never different."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.pipeline import (CanaryRolledBack, DriftGateFailed,
+                                  GateRule, KilledByChaos, PageCorrupt,
+                                  PageLog, Pipeline, PipelineConfig,
+                                  PipelineFaultPlan, PromotionRejected,
+                                  parse_gate)
+from xgboost_tpu.serve import Server
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 2, "eta": 0.3,
+          "max_bin": 32}
+K = 3          # rounds per epoch
+N_PAGES = 2    # epochs in the kill-stage matrix
+
+STAGES = ["post_ingest", "mid_epoch", "post_train", "post_gate",
+          "post_artifact", "post_manifest", "post_promote"]
+
+
+def _page(n=60, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+HOLDOUT = _page(150, 99)
+
+
+def _config(workdir, **kw):
+    base = dict(workdir=str(workdir), params=PARAMS, rounds_per_epoch=K,
+                gates=(GateRule("auc", max_regression=0.5),),
+                checkpoint_every=2)
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+def _run(workdir, chaos=None, epochs=N_PAGES, server=None, **kw):
+    pipe = Pipeline(_config(workdir, **kw), server=server,
+                    holdout=HOLDOUT, chaos=chaos)
+    for e in range(epochs):
+        pipe.step(*_page(seed=e))
+    return pipe
+
+
+def _artifacts(workdir):
+    d = os.path.join(str(workdir), "models")
+    return {fn: open(os.path.join(d, fn), "rb").read()
+            for fn in sorted(os.listdir(d)) if fn.endswith(".ubj")}
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """Artifacts of the uninterrupted run — the byte-exactness oracle."""
+    wd = tmp_path_factory.mktemp("pipe_ref")
+    pipe = _run(wd)
+    assert pipe.status()["promotions"] == N_PAGES
+    return _artifacts(wd)
+
+
+# ---------------------------------------------------------------- happy path
+
+def test_promotes_and_serves_each_epoch(tmp_path):
+    srv = Server()
+    pipe = _run(tmp_path, server=srv, epochs=3)
+    st = pipe.status()
+    assert st["promotions"] == 3
+    assert st["decided_epoch"] == 2
+    assert st["rounds_behind"] == 0
+    assert srv.registry.get("model").version == st["active_version"] == 3
+    # the served model IS the promoted artifact
+    raw = open(pipe.manifest.active["path"], "rb").read()
+    oracle = xgb.Booster(model_file=bytearray(raw))
+    X = _page(seed=5)[0]
+    np.testing.assert_array_equal(np.asarray(srv.predict(X)),
+                                  np.asarray(oracle.predict(xgb.DMatrix(X))))
+    srv.close()
+
+
+def test_report_entries_carry_decisions(tmp_path):
+    pipe = Pipeline(_config(tmp_path), holdout=HOLDOUT)
+    rep = pipe.step(*_page(seed=0))
+    assert len(rep) == 1
+    assert rep[0]["action"] == "promoted"
+    assert rep[0]["version"] == 1
+    assert rep[0]["rounds"] == K
+    assert "auc" in rep[0]["scores"]
+
+
+# ------------------------------------------------- kill/restart, byte-exact
+
+@pytest.mark.parametrize("stage", STAGES)
+def test_kill_at_stage_recovers_byte_exact(stage, tmp_path, reference):
+    plan = PipelineFaultPlan(
+        kill_stage=stage, kill_epoch=1,
+        kill_round=K + 2 if stage == "mid_epoch" else None)
+    with pytest.raises(KilledByChaos):
+        _run(tmp_path, chaos=plan)
+    # recovery: a FRESH pipeline over the same workdir, no fault plan
+    pipe = Pipeline(_config(tmp_path), server=Server(), holdout=HOLDOUT)
+    pipe.run_pending()
+    for e in range(pipe.log.count(), N_PAGES):
+        pipe.step(*_page(seed=e))
+    assert _artifacts(tmp_path) == reference
+    assert pipe.server.registry.get("model").version == N_PAGES
+    assert pipe.status()["rounds_behind"] == 0
+    pipe.server.close()
+
+
+def test_kill_mid_epoch_with_corrupt_snapshot_falls_back(tmp_path,
+                                                         reference):
+    """The newest snapshot is torn at kill time: recovery must skip it
+    (CRC) and resume from an older one — still byte-exact."""
+    plan = PipelineFaultPlan(kill_stage="mid_epoch", kill_epoch=1,
+                             kill_round=2 * K - 1,
+                             corrupt_newest_snapshot=True)
+    with pytest.raises(KilledByChaos):
+        _run(tmp_path, chaos=plan)
+    pipe = Pipeline(_config(tmp_path), holdout=HOLDOUT)
+    pipe.run_pending()
+    assert _artifacts(tmp_path) == reference
+
+
+def test_replay_from_page_log_alone(tmp_path, reference):
+    """Delete EVERY snapshot after a post-gate kill: the page log alone
+    must reproduce the identical artifacts (snapshots are an
+    optimization, the log is the source of truth)."""
+    plan = PipelineFaultPlan(kill_stage="post_gate", kill_epoch=1)
+    with pytest.raises(KilledByChaos):
+        _run(tmp_path, chaos=plan)
+    ckdir = os.path.join(str(tmp_path), "checkpoints")
+    for fn in os.listdir(ckdir):
+        os.remove(os.path.join(ckdir, fn))
+    pipe = Pipeline(_config(tmp_path), holdout=HOLDOUT)
+    pipe.run_pending()
+    assert _artifacts(tmp_path) == reference
+
+
+def test_exactly_once_no_double_promotion(tmp_path):
+    """Kill between manifest commit and serve swap, then recover: the
+    epoch must NOT be re-decided (one history entry per epoch, version
+    numbers contiguous)."""
+    plan = PipelineFaultPlan(kill_stage="post_manifest", kill_epoch=1)
+    with pytest.raises(KilledByChaos):
+        _run(tmp_path, chaos=plan)
+    srv = Server()
+    pipe = Pipeline(_config(tmp_path), server=srv, holdout=HOLDOUT)
+    pipe.run_pending()
+    hist = pipe.manifest.history()
+    assert [h["version"] for h in hist] == [1, 2]
+    assert [h["epoch"] for h in hist] == [0, 1]
+    # recovery reconciled the serve registry from the manifest
+    assert srv.registry.get("model").version == 2
+    srv.close()
+
+
+# ----------------------------------------------- gate / corruption / canary
+
+def test_drift_gate_rejection_keeps_prior_serving(tmp_path):
+    srv = Server()
+    cfg = _config(tmp_path, gates=(GateRule("auc", min_value=0.55),))
+    pipe = Pipeline(cfg, server=srv, holdout=HOLDOUT)
+    pipe.step(*_page(seed=0))
+    assert srv.registry.get("model").version == 1
+    pipe.gates.rules[0].min_value = 1.1      # impossible floor
+    rep = pipe.step(*_page(seed=1))
+    assert rep[0]["action"] == "rejected"
+    assert isinstance(rep[0]["error"], DriftGateFailed)
+    assert rep[0]["error"].metric == "auc"
+    assert srv.registry.get("model").version == 1   # prior version live
+    assert pipe.manifest.decided_epoch == 1          # decision committed
+    # the lineage kept training: the next promotion carries all rounds
+    pipe.gates.rules[0].min_value = 0.55
+    rep = pipe.step(*_page(seed=2))
+    assert rep[0]["action"] == "promoted"
+    assert rep[0]["version"] == 2
+    assert rep[0]["rounds"] == 3 * K
+    srv.close()
+
+
+def test_corrupt_promoted_artifact_rejected_then_regenerated(tmp_path,
+                                                             reference):
+    srv = Server()
+    plan = PipelineFaultPlan(corrupt_artifact_version=2)
+    pipe = Pipeline(_config(tmp_path), server=srv, holdout=HOLDOUT,
+                    chaos=plan)
+    pipe.step(*_page(seed=0))
+    with pytest.raises(PromotionRejected) as ei:
+        pipe.step(*_page(seed=1))
+    assert ei.value.version == 2
+    assert srv.registry.get("model").version == 1    # previous stays live
+    assert pipe.manifest.decided_epoch == 0          # epoch 1 undecided
+    # recovery regenerates the byte-identical artifact and promotes it
+    pipe2 = Pipeline(_config(tmp_path), server=srv, holdout=HOLDOUT)
+    pipe2.run_pending()
+    assert _artifacts(tmp_path) == reference
+    assert srv.registry.get("model").version == 2
+    srv.close()
+
+
+def test_canary_regression_rolls_back(tmp_path):
+    srv = Server()
+    # a negative allowance demands an improvement no candidate delivers:
+    # deterministic rollback trigger
+    pipe = Pipeline(_config(tmp_path, canary_max_regression=-0.9),
+                    server=srv, holdout=HOLDOUT)
+    pipe.step(*_page(seed=0))
+    oracle = np.asarray(srv.predict(_page(seed=5)[0]))
+    rep = pipe.step(*_page(seed=1))
+    assert rep[0]["action"] == "rolled_back"
+    canary = rep[0]["canary"]
+    assert canary["rolled_back"] and canary["restored_version"] == 1
+    assert isinstance(canary["error"], CanaryRolledBack)
+    # serving restored bit-exactly; manifest agrees; version burned
+    assert srv.registry.get("model").version == 1
+    np.testing.assert_array_equal(
+        np.asarray(srv.predict(_page(seed=5)[0])), oracle)
+    assert pipe.manifest.active["version"] == 1
+    assert pipe.manifest.state["rolled_back"] == [2]
+    srv.close()
+
+
+def test_flaky_ingest_absorbed_by_retry(tmp_path, monkeypatch, reference):
+    monkeypatch.setenv("XTPU_IO_RETRIES", "5")
+    plan = PipelineFaultPlan(flaky_ingest_p=0.3, seed=3)
+    pipe = _run(tmp_path, chaos=plan)
+    assert pipe.status()["promotions"] == N_PAGES
+    assert _artifacts(tmp_path) == reference
+
+
+# ------------------------------------------------------------ zero downtime
+
+def test_zero_downtime_across_promotion_and_rollback(tmp_path):
+    """A streaming client hammering the server across a promotion AND a
+    canary rollback sees zero failed requests, and every response maps
+    to a well-defined version."""
+    srv = Server()
+    pipe = Pipeline(_config(tmp_path, canary_max_regression=-0.9),
+                    server=srv, holdout=HOLDOUT)
+    pipe.step(*_page(seed=0))
+    X = _page(seed=7)[0]
+    failures, versions, stop = [], set(), threading.Event()
+
+    def stream():
+        while not stop.is_set():
+            try:
+                out = srv.predict(X[:4])
+                versions.add(out.version)
+            except Exception as err:  # noqa: BLE001 - the assertion target
+                failures.append(err)
+
+    threads = [threading.Thread(target=stream) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        pipe.step(*_page(seed=1))    # promote v2, canary rolls back to v1
+        pipe.step(*_page(seed=2))    # promote v3, canary rolls back again
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not failures
+    assert versions <= {1, 2, 3}
+    srv.close()
+
+
+# ------------------------------------------------------------------ page log
+
+def test_page_log_torn_write_not_counted(tmp_path):
+    log = PageLog(str(tmp_path))
+    X, y = _page(seed=0)
+    log.append(X, y)
+    # simulate a kill between data and sidecar: data present, no sidecar
+    torn = os.path.join(str(tmp_path), "page_000001.ubj")
+    with open(torn, "wb") as fh:
+        fh.write(b"\x00" * 100)
+    assert log.count() == 1
+    # the next append overwrites the torn slot, no gap
+    idx = log.append(*_page(seed=1))
+    assert idx == 1 and log.count() == 2
+    np.testing.assert_array_equal(log.read(1)["X"], _page(seed=1)[0])
+
+
+def test_page_log_crc_failure_typed(tmp_path):
+    log = PageLog(str(tmp_path))
+    log.append(*_page(seed=0))
+    path = os.path.join(str(tmp_path), "page_000000.ubj")
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(PageCorrupt):
+        log.read(0)
+
+
+def test_page_log_roundtrip_with_weights(tmp_path):
+    log = PageLog(str(tmp_path))
+    X, y = _page(seed=0)
+    w = np.linspace(0.5, 2.0, len(y)).astype(np.float32)
+    log.append(X, y, w)
+    page = log.read(0)
+    np.testing.assert_array_equal(page["X"], X)
+    np.testing.assert_array_equal(page["y"], y)
+    np.testing.assert_array_equal(page["w"], w)
+
+
+# --------------------------------------------------------------- drift gates
+
+def test_parse_gate_forms():
+    g = parse_gate("auc:0.01")
+    assert (g.metric, g.max_regression, g.min_value) == ("auc", 0.01, None)
+    g = parse_gate("logloss:0.05:")
+    assert (g.max_regression, g.min_value, g.max_value) == (0.05, None, None)
+    g = parse_gate("auc::0.7")
+    assert (g.max_regression, g.min_value) == (None, 0.7)
+
+
+def test_gate_orientation_from_metric_registry():
+    # auc: higher is better -> a DROP is a regression
+    with pytest.raises(DriftGateFailed):
+        GateRule("auc", max_regression=0.01).check(0.80, 0.95, epoch=0)
+    GateRule("auc", max_regression=0.01).check(0.95, 0.80, epoch=0)
+    # logloss: lower is better -> a RISE is a regression
+    with pytest.raises(DriftGateFailed):
+        GateRule("logloss", max_regression=0.01).check(0.60, 0.40, epoch=0)
+    GateRule("logloss", max_regression=0.01).check(0.40, 0.60, epoch=0)
+
+
+# ----------------------------------------------- NaN guard (divergence)
+
+def test_poisoned_labels_raise_typed_divergence():
+    X, y = _page(seed=0)
+    y = y.copy()
+    y[3] = np.nan                      # poisoned label -> NaN gradient
+    with pytest.raises(xgb.NumericalDivergence):
+        xgb.train({**PARAMS, "tree_method": "hist"},
+                  xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+
+
+def test_nan_policy_zero_degrades_gracefully(monkeypatch):
+    monkeypatch.setenv("XTPU_NAN_POLICY", "zero")
+    X, y = _page(seed=0)
+    y = y.copy()
+    y[3] = np.nan
+    bst = xgb.train(PARAMS, xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+    preds = np.asarray(bst.predict(xgb.DMatrix(X)))
+    assert np.isfinite(preds).all()
+
+
+def test_pipeline_survives_poisoned_page_with_zero_policy(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("XTPU_NAN_POLICY", "zero")
+    pipe = Pipeline(_config(tmp_path), holdout=HOLDOUT)
+    X, y = _page(seed=0)
+    y = y.copy()
+    y[:2] = np.nan
+    rep = pipe.step(X, y)
+    assert rep[0]["action"] in ("promoted", "rejected")
+
+
+# ------------------------------------- checkpoint writer mid-write crash
+
+def test_mid_write_kill_leaves_resumable_state(tmp_path):
+    """Tear the newest snapshot the way a kill between data and sidecar
+    writes would (data truncated, sidecar stale): resume must skip it,
+    fall back to the previous valid snapshot, and still converge to the
+    straight run bit-exactly."""
+    from xgboost_tpu.utils.checkpoint import latest_valid_snapshot
+
+    X, y = _page(200, seed=4)
+    dm = xgb.DMatrix(X, label=y)
+    straight = xgb.train(PARAMS, dm, 8, verbose_eval=False)
+
+    ckdir = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError, match="boom"):
+        xgb.train(PARAMS, xgb.DMatrix(X, label=y), 8, verbose_eval=False,
+                  checkpoint=xgb.CheckpointConfig(directory=ckdir,
+                                                  every_n_rounds=2, keep=4),
+                  callbacks=[xgb.callback.AbortAtRound(
+                      6, RuntimeError("boom"))])
+    snaps = sorted(fn for fn in os.listdir(ckdir) if fn.endswith(".ubj"))
+    assert len(snaps) >= 2
+    newest = os.path.join(ckdir, snaps[-1])
+    with open(newest, "r+b") as fh:
+        fh.truncate(os.path.getsize(newest) // 2)
+
+    found = latest_valid_snapshot(ckdir)
+    assert found is not None and found[1] != newest   # torn one skipped
+    resumed = xgb.train(PARAMS, xgb.DMatrix(X, label=y), 8,
+                        verbose_eval=False,
+                        checkpoint=xgb.CheckpointConfig(
+                            directory=ckdir, every_n_rounds=2))
+    assert bytes(resumed.save_raw("ubj")) == bytes(straight.save_raw("ubj"))
+
+
+def test_prune_never_deletes_inflight_snapshot(tmp_path):
+    """A data file without its sidecar (a write in flight) must not count
+    toward ``keep`` nor be deleted when it is the newest file."""
+    from xgboost_tpu.utils.checkpoint import (_crc_path, prune_snapshots,
+                                              snapshot_path)
+
+    d = str(tmp_path)
+    complete = []
+    for r in (2, 4):
+        p = snapshot_path(d, r)
+        open(p, "wb").write(b"data")
+        open(_crc_path(p), "w").write("0 4\n")
+        complete.append(p)
+    inflight = snapshot_path(d, 6)          # newest, sidecar not yet landed
+    open(inflight, "wb").write(b"partial")
+    debris = snapshot_path(d, 1)            # old kill debris, no sidecar
+    open(debris, "wb").write(b"junk")
+
+    prune_snapshots(d, keep=2)
+    assert os.path.exists(inflight)          # in-flight protected
+    assert all(os.path.exists(p) for p in complete)  # both count toward keep
+    assert not os.path.exists(debris)        # old debris collected
+
+
+# ----------------------------------------------- serve health endpoints
+
+def test_healthz_and_metrics_endpoints(tmp_path):
+    import urllib.request
+
+    from xgboost_tpu.serve.frontend import make_http_server
+
+    srv = Server()
+    pipe = Pipeline(_config(tmp_path), server=srv, holdout=HOLDOUT)
+    pipe.step(*_page(seed=0))
+    httpd = make_http_server(srv, 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as resp:
+            assert resp.status == 200
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert health["models"] == [{"name": "model", "version": 1}]
+        met = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics").read())
+        assert "counters" in met
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+
+def test_health_snapshot_counts_swaps_and_rollbacks(tmp_path):
+    srv = Server()
+    pipe = Pipeline(_config(tmp_path, canary_max_regression=-0.9),
+                    server=srv, holdout=HOLDOUT)
+    pipe.step(*_page(seed=0))
+    pipe.step(*_page(seed=1))               # promote + canary rollback
+    h = srv.health_snapshot()
+    assert h["status"] == "ok"
+    assert h["swaps"] >= 1
+    assert h["rollbacks"] == 1
+    srv.close()
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_cli_pipeline_dispatch(tmp_path, capsys):
+    from xgboost_tpu.cli import main
+
+    X, y = _page(seed=0)
+    data = tmp_path / "train.libsvm"
+    with open(data, "w") as fh:
+        for i in range(len(y)):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(X.shape[1]))
+            fh.write(f"{int(y[i])} {feats}\n")
+    wd = tmp_path / "wd"
+    rc = main(["pipeline", f"workdir={wd}", f"data={data}",
+               f"holdout={data}", "gate=auc:0.5", "rounds_per_epoch=2",
+               "objective=binary:logistic", "max_depth=2", "max_bin=32"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["action"] == "promoted"
+    assert lines[-1]["status"]["active_version"] == 1
+
+    rc = main(["pipeline", f"workdir={wd}", "command=status"])
+    assert rc == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["promotions"] == 1 and st["pages"] == 1

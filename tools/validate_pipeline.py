@@ -1,0 +1,241 @@
+"""Promotion gate for the continuous train->serve pipeline (ISSUE 7).
+
+Before the self-healing pipeline counts as shipped, a grid over
+
+    gate-outcome (all-pass / drift-reject)  x  kill-point
+
+must prove the recovery contract BYTE-EXACTLY: each cell runs the
+3-epoch loop with a chaos kill armed at one stage boundary, recovers
+with a FRESH pipeline over the same workdir, and compares every
+promoted artifact byte-for-byte against the uninterrupted reference
+run for that gate outcome — plus the decision sequence (which epochs
+promoted / rejected) and the finally-served version.
+
+The drift-reject outcome is produced by DATA, not by configuration:
+epoch 1's page carries shuffled labels, so the candidate regresses on
+the fixed holdout and the ``auc`` gate rejects it while the lineage
+keeps training — recovery must reproduce the same rejection without
+re-litigating it. Two adversarial cells ride along:
+
+- corrupt-snapshot: the newest training snapshot is truncated at kill
+  time; resume must fall back to an older valid one (or full page-log
+  replay) and still converge byte-exactly.
+- corrupt-artifact: a promoted model file is truncated the moment it
+  lands; read-back verification must reject the promotion (typed
+  ``PromotionRejected``, previous version keeps serving) and recovery
+  must regenerate the byte-identical artifact.
+
+Run from the repo root: ``python tools/validate_pipeline.py``.
+Shrink for a smoke run: VALIDATE_PIPELINE_SCALE=0.5 (fraction of rows).
+Exits non-zero and prints FAIL on any violated cell.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+SCALE = float(os.environ.get("VALIDATE_PIPELINE_SCALE", "1.0"))
+ROWS = max(int(120 * SCALE), 40)
+F = 6
+K = 3            # rounds per epoch
+EPOCHS = 3
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+          "max_bin": 32}
+
+STAGES = ["post_ingest", "mid_epoch", "post_train", "post_gate",
+          "post_artifact", "post_manifest", "post_promote"]
+# stages on the promote path never fire during a rejected epoch; in the
+# drift-reject outcome (epoch 1 rejected, epoch 2 promoted) arm them at
+# epoch 2 instead
+PROMOTE_ONLY = {"post_gate", "post_artifact", "post_manifest",
+                "post_promote"}
+
+
+def _page(outcome, e):
+    rng = np.random.RandomState(e)
+    X = rng.randn(ROWS, F).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(ROWS) > 0
+         ).astype(np.float32)
+    if outcome == "reject" and e == 1:
+        # drifted garbage: shuffled labels sink the holdout AUC past the
+        # gate's allowance
+        rng.shuffle(y)
+    return X, y
+
+
+HOLDOUT = None  # filled in main() (needs xgboost_tpu importable first)
+
+
+def _config(workdir):
+    from xgboost_tpu.pipeline import GateRule, PipelineConfig
+
+    return PipelineConfig(
+        workdir=str(workdir), params=PARAMS, rounds_per_epoch=K,
+        gates=(GateRule("auc", max_regression=0.02),),
+        checkpoint_every=2)
+
+
+def _artifacts(workdir):
+    d = os.path.join(str(workdir), "models")
+    if not os.path.isdir(d):
+        return {}
+    return {fn: open(os.path.join(d, fn), "rb").read()
+            for fn in sorted(os.listdir(d)) if fn.endswith(".ubj")}
+
+
+def _decisions(pipe):
+    return [(ev["type"], ev["epoch"]) for ev in pipe.manifest.events()
+            if ev["type"] in ("promoted", "rejected")]
+
+
+def _run(workdir, outcome, chaos=None, server=None):
+    from xgboost_tpu.pipeline import Pipeline
+
+    pipe = Pipeline(_config(workdir), server=server, holdout=HOLDOUT,
+                    chaos=chaos)
+    for e in range(EPOCHS):
+        pipe.step(*_page(outcome, e))
+    return pipe
+
+
+def _recover(workdir, outcome, server=None):
+    from xgboost_tpu.pipeline import Pipeline
+
+    pipe = Pipeline(_config(workdir), server=server, holdout=HOLDOUT)
+    pipe.run_pending()
+    for e in range(pipe.log.count(), EPOCHS):
+        pipe.step(*_page(outcome, e))
+    return pipe
+
+
+def _cell(tmp, outcome, kill, ref, corrupt_snapshot=False):
+    from xgboost_tpu.pipeline import KilledByChaos, PipelineFaultPlan
+    from xgboost_tpu.serve import Server
+
+    wd = os.path.join(tmp, f"{outcome}_{kill or 'none'}"
+                           f"{'_corrsnap' if corrupt_snapshot else ''}")
+    if kill is None:
+        pipe = _run(wd, outcome, server=Server())
+    else:
+        epoch = 2 if (outcome == "reject" and kill in PROMOTE_ONLY) else 1
+        plan = PipelineFaultPlan(
+            kill_stage=kill, kill_epoch=epoch,
+            kill_round=epoch * K + 2 if kill == "mid_epoch" else None,
+            corrupt_newest_snapshot=corrupt_snapshot)
+        try:
+            _run(wd, outcome, chaos=plan)
+            return False, "chaos kill never fired"
+        except KilledByChaos:
+            pass
+        pipe = _recover(wd, outcome, server=Server())
+
+    problems = []
+    if _artifacts(wd) != ref["artifacts"]:
+        problems.append("artifacts differ from uninterrupted reference")
+    if _decisions(pipe) != ref["decisions"]:
+        problems.append(f"decision sequence {_decisions(pipe)} != "
+                        f"{ref['decisions']}")
+    served = pipe.server.registry.get("model").version
+    if served != ref["served"]:
+        problems.append(f"serving v{served}, expected v{ref['served']}")
+    if pipe.status()["rounds_behind"] != 0:
+        problems.append(f"rounds_behind={pipe.status()['rounds_behind']}")
+    pipe.server.close()
+    return (not problems), "; ".join(problems) or "ok"
+
+
+def _corrupt_artifact_cell(tmp, ref):
+    from xgboost_tpu.pipeline import (Pipeline, PipelineFaultPlan,
+                                      PromotionRejected)
+    from xgboost_tpu.serve import Server
+
+    wd = os.path.join(tmp, "pass_corrupt_artifact")
+    srv = Server()
+    plan = PipelineFaultPlan(corrupt_artifact_version=2)
+    pipe = Pipeline(_config(wd), server=srv, holdout=HOLDOUT, chaos=plan)
+    pipe.step(*_page("pass", 0))
+    try:
+        pipe.step(*_page("pass", 1))
+        return False, "corrupt artifact was not rejected"
+    except PromotionRejected:
+        pass
+    if srv.registry.get("model").version != 1:
+        return False, "previous version not serving after rejection"
+    pipe2 = _recover(wd, "pass", server=srv)
+    ok = _artifacts(wd) == ref["artifacts"] \
+        and srv.registry.get("model").version == ref["served"]
+    srv.close()
+    return ok, "ok" if ok else "recovery did not regenerate byte-identical"
+
+
+def main():
+    global HOLDOUT
+    from xgboost_tpu.serve import Server
+
+    rng = np.random.RandomState(99)
+    Xh = rng.randn(2 * ROWS, F).astype(np.float32)
+    yh = (Xh[:, 0] + 0.5 * Xh[:, 1] + 0.1 * rng.randn(2 * ROWS) > 0
+          ).astype(np.float32)
+    HOLDOUT = (Xh, yh)
+
+    tmp = tempfile.mkdtemp(prefix="validate_pipeline_")
+    failures = []
+    try:
+        refs = {}
+        for outcome in ("pass", "reject"):
+            wd = os.path.join(tmp, f"ref_{outcome}")
+            pipe = _run(wd, outcome, server=Server())
+            refs[outcome] = {
+                "artifacts": _artifacts(wd),
+                "decisions": _decisions(pipe),
+                "served": pipe.server.registry.get("model").version,
+            }
+            pipe.server.close()
+            print(f"# reference[{outcome}]: decisions="
+                  f"{refs[outcome]['decisions']} "
+                  f"serving=v{refs[outcome]['served']}")
+        if refs["reject"]["decisions"].count(("rejected", 1)) != 1:
+            failures.append("reference[reject] did not reject epoch 1 — "
+                            "drift scenario broken")
+
+        for outcome in ("pass", "reject"):
+            for kill in [None] + STAGES:
+                ok, why = _cell(tmp, outcome, kill, refs[outcome])
+                tag = f"outcome={outcome} kill={kill or 'none'}"
+                print(f"{'PASS' if ok else 'FAIL'} {tag} [{why}]")
+                if not ok:
+                    failures.append(tag)
+
+        ok, why = _cell(tmp, "pass", "mid_epoch", refs["pass"],
+                        corrupt_snapshot=True)
+        print(f"{'PASS' if ok else 'FAIL'} outcome=pass "
+              f"kill=mid_epoch+corrupt_snapshot [{why}]")
+        if not ok:
+            failures.append("corrupt_snapshot")
+
+        ok, why = _corrupt_artifact_cell(tmp, refs["pass"])
+        print(f"{'PASS' if ok else 'FAIL'} outcome=pass "
+              f"kill=corrupt_artifact [{why}]")
+        if not ok:
+            failures.append("corrupt_artifact")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    print(json.dumps({"cells": 2 * (1 + len(STAGES)) + 2,
+                      "failures": failures}))
+    if failures:
+        print("FAIL")
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

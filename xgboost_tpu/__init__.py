@@ -37,6 +37,7 @@ from .context import Context, make_data_mesh
 from .core import Booster, train
 from .data.dmatrix import DataIter, DMatrix, QuantileDMatrix
 from .interop import load_xgboost_model, save_xgboost_model
+from .objective.base import NumericalDivergence
 from .parallel import collective
 from .plotting import plot_importance, plot_tree, to_graphviz
 from .sklearn import (XGBClassifier, XGBModel, XGBRanker, XGBRegressor,
@@ -82,5 +83,6 @@ __all__ = [
     "plot_importance", "plot_tree", "to_graphviz",
     "config_context", "set_config", "get_config",
     "load_xgboost_model", "save_xgboost_model",
-    "CheckpointConfig", "TrainingSnapshot", "__version__",
+    "CheckpointConfig", "TrainingSnapshot", "NumericalDivergence",
+    "__version__",
 ]

@@ -191,6 +191,28 @@ class LearningRateScheduler(TrainingCallback):
         return False
 
 
+class AbortAtRound(TrainingCallback):
+    """Raise ``exc`` immediately BEFORE boosting round ``round_`` (global
+    round numbering, matching checkpoint snapshots) — a deterministic
+    crash-injection point for the chaos harness (``pipeline/chaos.py``)
+    and the fault-tolerance tests. The exception propagates through
+    ``train()``'s cleanup path, so snapshots written before the abort
+    are flushed exactly as a real kill would leave them."""
+
+    def __init__(self, round_: int, exc: Union[BaseException,
+                                               Callable[[], BaseException],
+                                               None] = None) -> None:
+        self.round_ = int(round_)
+        self._exc = exc
+
+    def before_iteration(self, model, epoch: int, evals_log) -> bool:
+        if epoch >= self.round_:
+            exc = self._exc() if callable(self._exc) else self._exc
+            raise exc if exc is not None else RuntimeError(
+                f"AbortAtRound: aborted before round {epoch}")
+        return False
+
+
 class TrainingCheckPoint(TrainingCallback):
     """Periodic model checkpoints (reference callback.py TrainingCheckPoint).
 

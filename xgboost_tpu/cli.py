@@ -20,6 +20,11 @@ Beyond the reference tasks there is an inference-serving mode (no config
 file — key=value args only; see ``serve/frontend.py`` / docs/serving.md):
 
     python -m xgboost_tpu serve model=PATH [http_port=8080] [key=value ...]
+
+and a continuous train->serve pipeline mode (``pipeline/cli.py`` /
+docs/pipeline.md — drift-gated promotion, rollback, byte-exact replay):
+
+    python -m xgboost_tpu pipeline workdir=DIR data=URI [key=value ...]
 """
 
 from __future__ import annotations
@@ -174,6 +179,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .serve.frontend import serve_main
 
         return serve_main(argv[1:])
+    if argv[0] == "pipeline":
+        from .pipeline.cli import pipeline_main
+
+        return pipeline_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:  # command-line key=value overrides, last wins
         if "=" not in extra:

@@ -26,6 +26,7 @@ from .data.dmatrix import DMatrix
 from .logging_utils import console, logger
 from .metric import get_metric
 from .objective import get_objective
+from .objective.base import _nan_policy
 from .tree.param import TrainParam
 from .utils import observer
 from .utils.timer import Monitor
@@ -53,6 +54,32 @@ _LEARNER_KEYS = {
 
 
 import functools as _functools
+
+
+def _check_margin_finite(margin, n_valid: int, objective: str,
+                         first_round: int, n_rounds: int = 1) -> None:
+    """Post-round half of the NaN guard for the TRACED gradient paths
+    (``objective.base.guard_gradient`` raises eagerly on the general path,
+    but cannot raise from inside the fused programs). Called on the fused
+    round's output margin BEFORE its trees are committed, so under the
+    default ``XTPU_NAN_POLICY=raise`` a divergence aborts with the model
+    still clean. One scalar device pull per fused round/batch — overlapped
+    with the per-round host work that already exists on those paths."""
+    from .objective.base import NumericalDivergence, _nan_policy
+
+    if _nan_policy() != "raise":
+        return
+    bad = int(jnp.sum(~jnp.isfinite(margin[:n_valid]).all(axis=-1)))
+    if not bad:
+        return
+    where = (f"round {first_round}" if n_rounds == 1 else
+             f"rounds {first_round}..{first_round + n_rounds - 1}")
+    raise NumericalDivergence(
+        f"objective {objective!r} diverged at {where}: {bad} row(s) have "
+        "non-finite margins — check labels/weights for NaN/Inf. The "
+        "offending tree(s) were NOT committed; set XTPU_NAN_POLICY=zero "
+        "to drop the bad rows and continue instead.",
+        iteration=first_round, objective=objective, bad_rows=bad)
 
 
 def _fused_round_body(margin, seed, iteration, bins, labels, weights,
@@ -109,18 +136,24 @@ def _fused_round_body(margin, seed, iteration, bins, labels, weights,
     jax.jit,
     donate_argnums=(1,),  # margin: updated in place, caller rebinds
     static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
-                     "hist_method", "has_missing"))
+                     "hist_method", "has_missing", "nan_policy"))
 def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
                     monotone, constraint_sets, cat, *,
                     obj_cls, obj_params, param, max_nbins, hist_method,
-                    has_missing):
+                    has_missing, nan_policy="raise"):
     """One boosting round as a single compiled program. Module-level so the
     compile cache is shared across Booster instances.
 
     ``seed``/``iteration`` arrive as traced scalars and the key is derived
     INSIDE the program: deriving it eagerly cost two extra device dispatches
     per round, which is material against a remote TPU (the tunnel adds tens
-    of ms of enqueue latency per eager op)."""
+    of ms of enqueue latency per eager op).
+
+    ``nan_policy`` is never read in the body: XTPU_NAN_POLICY is consulted
+    at TRACE time (``objective.base.guard_gradient`` bakes the zero-policy
+    ``where`` into the program, or omits it), so the active policy must be
+    part of the compile-cache key or a policy change after the first
+    compile would silently keep running the old program."""
     return _fused_round_body(
         margin, seed, iteration, bins, labels, weights, n_real, monotone,
         constraint_sets, cat, obj_cls=obj_cls, obj_params=obj_params,
@@ -132,11 +165,11 @@ def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
     jax.jit,
     donate_argnums=(1,),  # margin: updated in place, caller rebinds
     static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
-                     "hist_method", "has_missing"))
+                     "hist_method", "has_missing", "nan_policy"))
 def _fused_multi_round_fn(bins, margin, labels, weights, n_real, seeds,
                           iterations, monotone, constraint_sets, cat, *,
                           obj_cls, obj_params, param, max_nbins, hist_method,
-                          has_missing):
+                          has_missing, nan_policy="raise"):
     """K boosting rounds as ONE dispatch (``lax.scan`` over the shared
     round body — byte-identical numerics to K sequential
     ``_fused_round_fn`` calls), batching away per-dispatch host/enqueue
@@ -491,6 +524,14 @@ class Booster:
         key = id(dm)
         tm = getattr(self.gbm, "tree_method", "hist")
         needs_binned = tm not in ("approx", "exact")
+        if key in self._caches \
+                and self._caches[key]["n_valid"] != dm.num_row():
+            # rows appended since this entry was built (DMatrix.append):
+            # the cached margin/labels/bins are all row-count-dependent.
+            # Rebuild from scratch — the continuation bootstrap in
+            # update()/update_batch() re-folds the committed trees' margin
+            # over the grown matrix, so training continues correctly.
+            del self._caches[key]
         if key in self._caches and is_train and (
                 not self._caches[key]["is_train"]
                 or (needs_binned and self._caches[key]["binned"] is None)):
@@ -785,6 +826,9 @@ class Booster:
                         margin.shape),
                      jnp.asarray(hess, dtype=jnp.float32).reshape(
                          margin.shape)], axis=-1)
+                from .objective.base import guard_gradient
+
+                gpair = guard_gradient(gpair, "custom objective", iteration)
         if observer.enabled():
             observer.observe("gpair", gpair, iteration)
         key = self.ctx.make_key(iteration)
@@ -824,7 +868,8 @@ class Booster:
                 obj_cls=type(self.obj), obj_params=obj_params,
                 param=grower.param, max_nbins=grower.max_nbins,
                 hist_method=grower.hist_method,
-                has_missing=grower.has_missing)
+                has_missing=grower.has_missing,
+                nan_policy=_nan_policy())
         except Exception:
             logger.warning("fused boosting round failed; falling back to "
                            "the general path permanently", exc_info=True)
@@ -832,6 +877,8 @@ class Booster:
             self._fused_round = None
             self._recover_donated_margin(state)
             return False
+        _check_margin_finite(new_margin, state["n_valid"], self.obj.name,
+                             iteration)
         if isinstance(grown, dict):     # multiclass: stacked [K] class axis
             for k in range(gbm.n_groups):
                 gbm._trees.append(
@@ -961,13 +1008,16 @@ class Booster:
                 obj_cls=type(self.obj), obj_params=obj_params,
                 param=grower.param, max_nbins=grower.max_nbins,
                 hist_method=grower.hist_method,
-                has_missing=grower.has_missing)
+                has_missing=grower.has_missing,
+                nan_policy=_nan_policy())
         except Exception:
             logger.warning("batched fused rounds failed; falling back to "
                            "per-round training", exc_info=True)
             self._batch_blocked = True  # single-round fused path stays live
             self._recover_donated_margin(state)
             return False
+        _check_margin_finite(new_margin, state["n_valid"], self.obj.name,
+                             int(iters[0]), len(iters))
         # all R x Kc trees share ONE stacked-array dict; _flush fetches it
         # once and slices host-side (multiclass axes arrive pre-flattened
         # to [R * Kc] by _fused_multi_round_fn)
@@ -1889,12 +1939,19 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
                 ck.maybe_save(bst, dtrain, i, force=(stop or i == end))
             if stop:
                 break
-    finally:
-        # flush pending background snapshot writes even when the round
-        # loop dies — the snapshot being flushed is exactly what the
-        # relaunched run will resume from
+    except BaseException:
+        # flush + join the background writer even when the round loop dies
+        # (the snapshot being flushed is exactly what the relaunched run
+        # will resume from) — but never let a secondary write failure mask
+        # the original error
         if ck is not None:
             ck.close()
+        raise
+    else:
+        # normal exit: a silently-failed background write would leave the
+        # newest snapshot stale, so here write failures DO surface
+        if ck is not None:
+            ck.close(raise_errors=True)
     bst = container.after_training(bst)
     bst._monitor.maybe_print()  # one cumulative table (reference: destructor)
 

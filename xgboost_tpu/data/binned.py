@@ -790,3 +790,43 @@ class PagedBinnedMatrix:
             bins_host=out, cuts=cuts, max_nbins=max_nbins,
             has_missing=self.has_missing, page_rows=self.page_rows,
             cache_budget_bytes=self.cache_budget_bytes)
+
+    def append_rows(self, X: np.ndarray) -> None:
+        """Quantize and append fresh raw rows IN PLACE using the EXISTING
+        cuts (the continuous-training ingest path, docs/pipeline.md): the
+        bin vocabulary the trained trees index into stays frozen, so every
+        committed split keeps its meaning and replay over the same page
+        log re-bins to identical ids. A memmap-backed matrix regrows its
+        backing file (truncate + remap — the disk-spill tier keeps
+        spilling); an in-RAM matrix reallocates. Device-side page caches
+        are invalidated: page boundaries shift only for the tail page,
+        but a stale resident collapse or mesh layout would silently train
+        on the pre-append row count."""
+        X = np.ascontiguousarray(X, np.float32)
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"append_rows expects [n, {self.n_features}] features, "
+                f"got {X.shape}")
+        if not self.has_missing and np.isnan(X).any():
+            raise ValueError(
+                "appended rows contain missing values but this matrix was "
+                "quantized without a missing slot; rebuild it from data "
+                "that includes missing values (or impute the new rows)")
+        old_n, F = self.bins_host.shape
+        new_n = old_n + X.shape[0]
+        host = self.bins_host
+        if isinstance(host, np.memmap):
+            path, dtype = host.filename, host.dtype
+            host.flush()
+            with open(path, "r+b") as fh:
+                fh.truncate(new_n * F * dtype.itemsize)
+            grown = np.memmap(path, mode="r+", dtype=dtype,
+                              shape=(new_n, F))
+        else:
+            grown = np.empty((new_n, F), host.dtype)
+            grown[:old_n] = host
+        search_bin_into(X, self.cuts, self.max_nbins - 1, grown[old_n:])
+        self.bins_host = grown
+        self._device_cache.clear()
+        self._mesh_cache.clear()
+        self._resident = None

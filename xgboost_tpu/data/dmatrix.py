@@ -552,6 +552,91 @@ class DMatrix:
             return self._binned.to_values_host()
         return np.asarray(self._binned.to_values())
 
+    def append(self, data: Any, label: Any = None, *,
+               weight: Any = None, missing: float = np.nan) -> int:
+        """Append fresh rows IN PLACE — the continuous-training ingest path
+        (docs/pipeline.md). The quantized representation, when already
+        built, grows INCREMENTALLY against its existing cuts (the bin
+        vocabulary the live booster's trees index into must stay frozen;
+        re-sketching would silently reinterpret every committed split), so
+        only the new rows are binned: O(page) work per ingest, not O(n).
+        Label/weight arrays are REPLACED (not mutated) so the
+        identity-keyed device caches invalidate. Returns the new row
+        count. An append fingerprint chain (CRC over the appended
+        features+labels, chained over the sequence of appends) rides on
+        ``dmatrix_fingerprint`` so a training snapshot can never resume
+        against a matrix at a different ingest position."""
+        import zlib
+
+        X, _, _ = to_dense(data, missing, None, None)
+        X = np.ascontiguousarray(X, np.float32)
+        if X.shape[1] != self.num_col():
+            raise ValueError(
+                f"append expects {self.num_col()} features, got {X.shape[1]}")
+        info = self.info
+        for name in ("base_margin", "group_ptr",
+                     "label_lower_bound", "label_upper_bound"):
+            if getattr(info, name) is not None:
+                raise ValueError(
+                    f"append does not support matrices carrying {name}")
+        n_new = X.shape[0]
+        y = w = None
+        if label is not None:
+            y = np.asarray(label, np.float32)
+            if y.shape[0] != n_new:
+                raise ValueError(
+                    f"label has {y.shape[0]} entries, expected {n_new}")
+        elif info.labels is not None:
+            raise ValueError(
+                "matrix has labels; append needs label= for the new rows")
+        if weight is not None:
+            w = np.asarray(weight, np.float32)
+        elif info.weights is not None:
+            raise ValueError(
+                "matrix has weights; append needs weight= for the new rows")
+        # grow the quantized representation FIRST — it can reject the rows
+        # (e.g. NaNs into a no-missing-slot layout) and must do so before
+        # any raw/meta state mutates
+        if self._binned is not None:
+            b = self._binned
+            if getattr(b, "is_paged", False):
+                b.append_rows(X)
+            else:
+                if not b.has_missing and np.isnan(X).any():
+                    raise ValueError(
+                        "appended rows contain missing values but the "
+                        "quantized matrix has no missing slot; rebuild "
+                        "from data that includes missing values")
+                from .binned import _dtype_for, search_bin_into
+                import jax.numpy as jnp
+
+                local = np.empty((n_new, b.n_features),
+                                 _dtype_for(max(b.max_nbins - 1, 0)))
+                search_bin_into(X, b.cuts, b.max_nbins - 1, local)
+                self._binned = BinnedMatrix(
+                    bins=jnp.concatenate(
+                        [b.bins, jnp.asarray(local).astype(b.bins.dtype)],
+                        axis=0),
+                    cuts=b.cuts, max_nbins=b.max_nbins,
+                    has_missing=b.has_missing)
+        if self.X is not None:
+            self.X = np.concatenate([self.X, X], axis=0)
+        else:
+            self._n_rows += n_new
+        if y is not None:
+            info.labels = (np.array(y) if info.labels is None
+                           else np.concatenate([info.labels, y], axis=0))
+        if w is not None:
+            info.weights = (np.array(w) if info.weights is None
+                            else np.concatenate([info.weights, w]))
+        crc = zlib.crc32(X.tobytes(), getattr(self, "_append_chain", 0))
+        if y is not None:
+            crc = zlib.crc32(np.ascontiguousarray(y).tobytes(), crc)
+        self._append_chain = crc
+        self._n_appends = getattr(self, "_n_appends", 0) + 1
+        self.info.validate(self.num_row())
+        return self.num_row()
+
     def slice(self, rindex: np.ndarray) -> "DMatrix":
         if self.X is None:
             raise ValueError(

@@ -2,13 +2,80 @@
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..registry import OBJECTIVES
+
+
+class NumericalDivergence(RuntimeError):
+    """Non-finite gradients detected (reference: silent — a NaN gradient
+    poisons histogram sums, every split gain, and finally the committed
+    leaf values, and the run "succeeds" with an all-NaN model). Raised
+    BEFORE the offending round's tree is committed, so the model on the
+    booster stays clean. ``XTPU_NAN_POLICY=zero`` degrades gracefully
+    instead (offending gpairs are zeroed with a warning — the bad rows
+    simply stop contributing, like zero-weight rows); ``off`` disables
+    the check entirely for maximum throughput."""
+
+    def __init__(self, message: str, *, iteration: Optional[int] = None,
+                 objective: Optional[str] = None,
+                 bad_rows: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.iteration = iteration
+        self.objective = objective
+        self.bad_rows = bad_rows
+
+
+def _nan_policy() -> str:
+    p = os.environ.get("XTPU_NAN_POLICY", "raise").strip().lower()
+    if p not in ("raise", "zero", "off"):
+        raise ValueError(
+            f"XTPU_NAN_POLICY must be raise|zero|off, got {p!r}")
+    return p
+
+
+def guard_gradient(gpair: jnp.ndarray, objective: str,
+                   iteration: int) -> jnp.ndarray:
+    """Finite-check one [n, k, 2] gradient matrix under XTPU_NAN_POLICY.
+
+    Eager gradients (the general per-round path, custom ``fobj``) raise a
+    typed :class:`NumericalDivergence` or zero-and-warn host-side. Inside
+    a trace (the fused round programs) the ``zero`` policy applies as an
+    in-trace ``where`` — bit-free for finite inputs — while the ``raise``
+    policy defers to the round-loop margin check (``core._assert_finite``)
+    which fires before the tree is committed."""
+    policy = _nan_policy()
+    if policy == "off":
+        return gpair
+    # a (grad, hess) pair is "offending" when either half is non-finite
+    pair_ok = jnp.isfinite(gpair).all(axis=-1, keepdims=True)  # [n, k, 1]
+    if isinstance(gpair, jax.core.Tracer):
+        if policy == "zero":
+            return jnp.where(pair_ok, gpair, jnp.zeros_like(gpair))
+        return gpair  # raise policy: caught post-round, pre-commit
+    bad_rows = int(jnp.sum(~pair_ok.all(axis=1)[:, 0]))
+    if bad_rows == 0:
+        return gpair
+    if policy == "zero":
+        from ..logging_utils import logger
+
+        logger.warning(
+            "objective %r produced non-finite gradients for %d rows at "
+            "round %d; XTPU_NAN_POLICY=zero drops their contribution",
+            objective, bad_rows, iteration)
+        return jnp.where(pair_ok, gpair, jnp.zeros_like(gpair))
+    raise NumericalDivergence(
+        f"objective {objective!r} produced non-finite gradients for "
+        f"{bad_rows} row(s) at round {iteration} — check labels/weights "
+        "for NaN/Inf (or a diverging custom objective). Set "
+        "XTPU_NAN_POLICY=zero to drop the offending rows and continue.",
+        iteration=iteration, objective=objective, bad_rows=bad_rows)
 
 
 @dataclass
@@ -69,7 +136,7 @@ class Objective:
             w = (wdev() if wdev is not None
                  else jnp.asarray(info.weights, dtype=jnp.float32))
             gpair = gpair * w[:, None, None]
-        return gpair
+        return guard_gradient(gpair, self.name, iteration)
 
     def pred_transform(self, margin: jnp.ndarray) -> jnp.ndarray:
         return margin

@@ -1,0 +1,24 @@
+"""xgboost_tpu.pipeline — self-healing continuous train->serve loop.
+
+Fresh rows enter a durable page log; each page becomes one training
+epoch that continues the live boosting lineage; candidates pass drift
+gates before being promoted into the serve registry with automatic
+canary rollback. Every stage is crash-safe and byte-exact on replay.
+See docs/pipeline.md for the architecture and the exactly-once
+argument; ``python -m xgboost_tpu.cli pipeline --help`` for the CLI.
+"""
+
+from .chaos import PipelineFaultPlan
+from .driver import Pipeline, PipelineConfig
+from .errors import (CanaryRolledBack, DriftGateFailed, KilledByChaos,
+                     PageCorrupt, PipelineError, PromotionRejected)
+from .gates import DriftGates, GateRule, parse_gate
+from .manifest import PromotionManifest
+from .pagelog import PageLog
+
+__all__ = [
+    "Pipeline", "PipelineConfig", "PageLog", "PromotionManifest",
+    "DriftGates", "GateRule", "parse_gate", "PipelineFaultPlan",
+    "PipelineError", "PageCorrupt", "DriftGateFailed",
+    "PromotionRejected", "CanaryRolledBack", "KilledByChaos",
+]

@@ -28,7 +28,9 @@ the micro-batcher:
 
     POST /v1/predict   {"data": ..., "model":?, "output":?}
     GET  /v1/models    registry listing
-    GET  /v1/metrics   ServeMetrics snapshot
+    GET  /v1/metrics   ServeMetrics snapshot (alias: /metrics)
+    GET  /healthz      liveness + versions/queue/shed counters
+                       (503 once the server stops accepting)
 """
 
 from __future__ import annotations
@@ -144,7 +146,12 @@ def make_http_server(server: Server, port: int,
             self.wfile.write(body)
 
         def do_GET(self) -> None:  # noqa: N802 (stdlib API)
-            if self.path == "/v1/metrics":
+            if self.path == "/healthz":
+                # external probes and the pipeline's canary watcher read
+                # the same signals; 503 once the server stopped accepting
+                h = server.health_snapshot()
+                self._send(200 if h["status"] == "ok" else 503, h)
+            elif self.path in ("/metrics", "/v1/metrics"):
                 self._send(200, server.metrics_snapshot())
             elif self.path == "/v1/models":
                 self._send(200, server.registry.describe())

@@ -111,10 +111,16 @@ class ServedModel:
 
 
 class ModelRegistry:
+    # rolled-over ServedModels kept per name for rollback — still fully
+    # built (pinned forest, warm executables), so a rollback is as atomic
+    # and downtime-free as the swap that displaced them
+    HISTORY_DEPTH = 4
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._models: Dict[str, ServedModel] = {}
         self._versions: Dict[str, int] = {}
+        self._history: Dict[str, List[ServedModel]] = {}
 
     def load(self, name: str, source, *, version: Optional[int] = None,
              replace: bool = False) -> ServedModel:
@@ -147,9 +153,38 @@ class ModelRegistry:
         return sm
 
     def _publish(self, sm: ServedModel) -> None:
+        prev = self._models.get(sm.name)
+        if prev is not None and prev is not sm:
+            hist = self._history.setdefault(sm.name, [])
+            hist.append(prev)
+            del hist[:-self.HISTORY_DEPTH]
         self._models[sm.name] = sm  # one assignment = the atomic swap
         self._versions[sm.name] = max(
             self._versions.get(sm.name, 0), sm.version)
+
+    def previous(self, name: str) -> Optional[ServedModel]:
+        """The version a :meth:`rollback` would restore (None if none)."""
+        with self._lock:
+            hist = self._history.get(name)
+            return hist[-1] if hist else None
+
+    def rollback(self, name: str) -> ServedModel:
+        """Atomically restore the previously-published version (the
+        pipeline's canary regression / corrupt-promotion path). The
+        restored ServedModel is the SAME object that was serving before
+        the displacing swap — still device-pinned and jit-warm — so the
+        restore is one dict assignment with zero downtime, exactly like
+        the swap it undoes. ``_versions`` keeps its high-water mark: the
+        next promoted candidate takes a fresh number, never the
+        rolled-back one."""
+        with self._lock:
+            hist = self._history.get(name)
+            if not hist:
+                raise UnknownModel(
+                    f"no prior version to roll back to for model '{name}'")
+            prev = hist.pop()
+            self._models[name] = prev
+            return prev
 
     def unload(self, name: str) -> None:
         with self._lock:

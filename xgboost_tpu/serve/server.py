@@ -121,6 +121,16 @@ class Server:
         self.metrics.inc("swaps")
         return sm
 
+    def rollback_model(self, name: str) -> ServedModel:
+        """Restore the previously-served version (post-promotion canary
+        regression, corrupt promoted artifact — docs/pipeline.md). The
+        prior ServedModel is still device-pinned and jit-warm, so the
+        restore is one atomic registry assignment: in-flight batches
+        finish on whichever version they resolved and no request fails."""
+        sm = self.registry.rollback(name)
+        self.metrics.inc("rollbacks")
+        return sm
+
     def unload_model(self, name: str) -> None:
         self.registry.unload(name)
         self.metrics.inc("evictions")
@@ -283,6 +293,26 @@ class Server:
         logger.info(self.metrics.report_line(
             {"queue_rows": self.batcher.queue_depth_rows(),
              "models": len(self.registry.models())}))
+
+    def health_snapshot(self) -> Dict[str, object]:
+        """The ``/healthz`` payload: liveness plus the signals an external
+        probe and the pipeline's canary watcher both read — served
+        versions, queue depth, and the shed/deadline/error counters whose
+        RATE of change is the regression signal."""
+        c = self.metrics.counters
+        return {
+            "status": "closed" if self._closed else "ok",
+            "warmed": self._warmed,
+            "models": [{"name": m.name, "version": m.version}
+                       for m in self.registry.models()],
+            "queue_rows": self.batcher.queue_depth_rows(),
+            "requests": int(c.get("requests", 0)),
+            "sheds": int(c.get("sheds", 0)),
+            "deadline_exceeded": int(c.get("deadline_exceeded", 0)),
+            "errors": int(c.get("errors", 0)),
+            "swaps": int(c.get("swaps", 0)),
+            "rollbacks": int(c.get("rollbacks", 0)),
+        }
 
     def metrics_snapshot(self) -> Dict[str, object]:
         snap = self.metrics.snapshot()

@@ -76,6 +76,10 @@ class CheckpointConfig:
     background: bool = False
     resume: Any = "auto"          # "auto" | True | False
     name: str = "snapshot"
+    # caller-owned state merged into every snapshot's ``extra`` dict — the
+    # pipeline driver rides its epoch/page bookkeeping on the same durable
+    # artifact instead of inventing a second state file (docs/pipeline.md)
+    extra: Optional[Dict[str, Any]] = None
 
     def __post_init__(self) -> None:
         if self.every_n_rounds < 1:
@@ -252,13 +256,29 @@ def latest_valid_snapshot(
 
 def prune_snapshots(directory: str, keep: int,
                     name: str = "snapshot") -> None:
-    """Delete all but the newest ``keep`` snapshots (+ sidecars, stray
-    tmps)."""
+    """Delete all but the newest ``keep`` COMPLETE snapshots (+ sidecars,
+    stray tmps). Only snapshots whose CRC sidecar landed count toward
+    ``keep``: a data file without its sidecar is either a write still in
+    flight (always newer than every complete snapshot — the writer lands
+    data before sidecar) or debris from a kill between the two writes.
+    Counting such a file toward ``keep`` would push a complete, resumable
+    snapshot into the delete range — exactly the state a mid-write crash
+    needs to fall back to — so in-flight files are left alone and only
+    debris OLDER than the newest complete snapshot is collected."""
     snaps = list_snapshots(directory, name)
-    for _, path in snaps[keep:]:
+    complete = [(r, p) for r, p in snaps if os.path.exists(_crc_path(p))]
+    for _, path in complete[keep:]:
         for p in (path, _crc_path(path)):
             try:
                 os.remove(p)
+            except OSError:
+                pass
+    newest_complete = complete[0][0] if complete else None
+    for r, path in snaps:
+        if newest_complete is not None and r < newest_complete \
+                and not os.path.exists(_crc_path(path)):
+            try:
+                os.remove(path)
             except OSError:
                 pass
     try:
@@ -284,6 +304,14 @@ def dmatrix_fingerprint(dm: Any) -> Dict[str, Any]:
         if arr is not None:
             a = np.ascontiguousarray(np.asarray(arr, np.float32))
             fp[f"{key}_crc"] = int(zlib.crc32(a.tobytes()))
+    # append-evolution identity (DMatrix.append): the chained CRC over
+    # every appended (features, labels) block pins WHICH ingest position
+    # this matrix is at — labels_crc alone cannot distinguish two streams
+    # whose labels agree but whose features differ
+    chain = getattr(dm, "_append_chain", None)
+    if chain is not None:
+        fp["append_chain"] = int(chain)
+        fp["n_appends"] = int(getattr(dm, "_n_appends", 0))
     return fp
 
 
@@ -335,9 +363,16 @@ class SnapshotWriter:
             raise SnapshotError(
                 f"a background snapshot write failed: {err}") from err
 
-    def close(self) -> None:
-        self.flush()
-        self._ex.shutdown(wait=True)
+    def close(self, raise_errors: bool = False) -> None:
+        """Flush pending writes and JOIN the worker thread. Always safe to
+        call on an exception path (``raise_errors=False`` keeps a
+        secondary disk failure from masking the original error); the
+        normal-exit path passes ``raise_errors=True`` so a silently-failed
+        final snapshot surfaces instead of leaving stale state behind."""
+        try:
+            self.flush(raise_errors=raise_errors)
+        finally:
+            self._ex.shutdown(wait=True)
 
 
 # ------------------------------------------------------------------- manager
@@ -407,6 +442,8 @@ class CheckpointManager:
         snap = bst.make_snapshot(dtrain, fingerprint=self.fingerprint,
                                  round_=rounds_done)
         cfg = self.config
+        if cfg.extra:
+            snap.extra.update(cfg.extra)
         if self._writer is not None:
             self._writer.submit(cfg.directory, snap, cfg.name, cfg.keep)
         else:
@@ -415,6 +452,6 @@ class CheckpointManager:
                 prune_snapshots(cfg.directory, cfg.keep, cfg.name)
         return True
 
-    def close(self) -> None:
+    def close(self, raise_errors: bool = False) -> None:
         if self._writer is not None:
-            self._writer.close()
+            self._writer.close(raise_errors=raise_errors)
