@@ -1,0 +1,22 @@
+"""Known-bad twin for the collective-symmetry checker.
+
+Collectives under rank-dependent branches: ranks taking the other path
+never reach the rendezvous and the world desyncs (the runtime half of
+this defense is PR 4's in-band framing).
+"""
+
+
+def leader_only_reduce(comm, x):
+    if comm.get_rank() == 0:
+        return comm.allreduce(x)  # LINT[collective-symmetry]
+    return x
+
+
+def rank_gated_barrier(comm, rank, pending):
+    while rank == 0 and pending:
+        comm.barrier()  # LINT[collective-symmetry]
+        pending -= 1
+
+
+def ternary_broadcast(comm, x, is_leader):
+    return comm.broadcast(x) if is_leader else None  # LINT[collective-symmetry]

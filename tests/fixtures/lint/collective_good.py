@@ -1,0 +1,24 @@
+"""Known-good twin for the collective-symmetry checker.
+
+The symmetric idioms: every rank executes the collective; only the
+PAYLOAD is rank-dependent (tree/updaters.py ``sync_trees``), and a
+collective RESULT may gate a branch (the test position is not a body).
+"""
+
+
+def payload_dependent_broadcast(comm, x):
+    payload = x if comm.get_rank() == 0 else None
+    return comm.broadcast(payload)
+
+
+def leader_side_logging(comm, rank, x):
+    total = comm.allreduce(x)
+    if rank == 0:
+        print("total", total)  # host-side work, not a rendezvous
+    return total
+
+
+def collective_in_test_position(comm, flag):
+    if comm.allreduce(flag):
+        return "all ranks agreed"
+    return "disagreement"

@@ -1,0 +1,39 @@
+"""Known-bad twin for the donation-misuse checker.
+
+``donate_argnums`` lets XLA destroy the input buffer; the Python name
+still looks alive afterwards. Covers the decorator form, the
+``**{"donate_argnums": ...}`` dict form used by data/binned.py, and the
+donate-in-a-loop-without-rebinding shape.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused(margin, delta):
+    return margin + delta
+
+
+def _raw_step(margin, delta):
+    return margin + delta
+
+
+_step = jax.jit(_raw_step, **{"donate_argnums": (0,)})
+
+
+def use_after_donate(margin, delta):
+    out = fused(margin, delta)
+    return out + margin  # LINT[donation-misuse]
+
+
+def donate_in_loop(margin, deltas):
+    for d in deltas:
+        fused(margin, d)  # LINT[donation-misuse]
+    return None
+
+
+def subscript_use_after_donate(state, delta):
+    out = _step(state["margin"], delta)
+    return out, state["margin"]  # LINT[donation-misuse]

@@ -1,0 +1,36 @@
+"""Known-good twin for the donation-misuse checker.
+
+The repo idiom: the donated slot is rebound BY the donating call's own
+assignment (including tuple targets and subscript slots, the
+``state["margin"], grown = _fused_round_fn(...)`` pattern from core.py).
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused(margin, delta):
+    return margin + delta
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused_pair(margin, delta):
+    return margin + delta, delta * 2
+
+
+def rebind_immediately(margin, delta):
+    margin = fused(margin, delta)
+    return margin
+
+
+def rebind_tuple_slot(state, delta):
+    state["margin"], grown = fused_pair(state["margin"], delta)
+    return state["margin"], grown
+
+
+def rebind_in_loop(margin, deltas):
+    for d in deltas:
+        margin = fused(margin, d)
+    return margin

@@ -1,0 +1,43 @@
+"""Known-bad twin for the host-sync checker.
+
+Per-iteration device->host materialization in a loop: each ``.item()``
+/ ``float()`` / ``np.asarray()`` on a traced value blocks the dispatch
+pipeline for a full device round-trip, which is exactly the per-level
+stall the page-major schedule (PR 3) was built to avoid.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def grow_levels(hist, max_depth):
+    gains = []
+    for depth in range(max_depth):
+        level = jnp.sum(hist[depth])
+        gains.append(level.item())  # LINT[host-sync]
+    return gains
+
+
+def accumulate_loss(batches):
+    total = 0.0
+    for b in batches:
+        total += float(jnp.mean(jnp.square(b)))  # LINT[host-sync]
+    return total
+
+
+def pull_masks(masks):
+    out = []
+    for m in masks:
+        host = np.asarray(jnp.asarray(m) > 0)  # LINT[host-sync]
+        out.append(host)
+    return out
+
+
+def drain(rounds, margin):
+    while rounds > 0:
+        margin = margin * 2
+        margin.block_until_ready()  # LINT[host-sync]
+        jax.device_get(margin)  # LINT[host-sync]
+        rounds -= 1
+    return margin

@@ -1,0 +1,29 @@
+"""Known-good twin for the host-sync checker.
+
+The same computations with the sync hoisted out of the loop (one pull
+for the whole batch) or kept on device (carried state / ``jnp.where``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def grow_levels(hist, max_depth):
+    # one batched pull AFTER the loop instead of one per level
+    gains = [jnp.sum(hist[d]) for d in range(max_depth)]
+    return np.asarray(jnp.stack(gains)).tolist()
+
+
+def accumulate_loss(batches):
+    total = jnp.float32(0.0)
+    for b in batches:
+        total = total + jnp.mean(jnp.square(b))  # stays on device
+    return float(total)  # single sync at the end
+
+
+def drain(rounds, margin):
+    def body(_, m):
+        return m * 2
+
+    return jax.lax.fori_loop(0, rounds, body, margin)

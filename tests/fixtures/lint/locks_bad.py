@@ -1,0 +1,69 @@
+"""Known-bad twin for the lock-discipline checker.
+
+One class per violation shape:
+
+- R1 (inconsistent guard): ``Counter.total`` mutated under the lock in
+  ``inc`` and bare in ``reset``.
+- R2 (unguarded write on a thread entrypoint): ``Writer.last_error``
+  written from the executor-submitted ``work`` while ``flush`` reads it
+  — the SnapshotWriter bug fixed in this PR.
+- R3 (cross-object mutation of a guarded attribute): ``Reporter``
+  assigns ``metrics.counters[...]`` directly although ``Metrics`` only
+  ever mutates ``counters`` under its lock — the serve ``_maybe_log``
+  bug fixed in this PR.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def inc(self):
+        with self._lock:
+            self.total += 1
+
+    def reset(self):
+        self.total = 0  # LINT[lock-discipline]
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ex = ThreadPoolExecutor(max_workers=1)
+        self.last_error = None
+
+    def submit(self, payload):
+        def work():
+            try:
+                payload()
+            except Exception as e:
+                self.last_error = e  # LINT[lock-discipline]
+
+        self._ex.submit(work)
+
+    def flush(self):
+        if self.last_error is not None:
+            raise RuntimeError(str(self.last_error))
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+
+    def inc(self, name):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.counters)
+
+
+class Reporter:
+    def tick(self, metrics, value):
+        metrics.counters["recompiles"] = value  # LINT[lock-discipline]

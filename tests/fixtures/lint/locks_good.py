@@ -1,0 +1,79 @@
+"""Known-good twin for the lock-discipline checker.
+
+The same three classes with the discipline restored, plus the two
+caller-holds-lock conventions the checker must honor: ``*_locked``
+methods (serve/batcher.py) and private methods whose every intra-class
+call site is under the lock (serve/registry.py ``_publish``).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def inc(self):
+        with self._lock:
+            self.total += 1
+
+    def reset(self):
+        with self._lock:
+            self.total = 0
+
+    def drain_locked(self):
+        # caller-holds-lock contract: name says so
+        out, self.total = self.total, 0
+        return out
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ex = ThreadPoolExecutor(max_workers=1)
+        self.last_error = None
+
+    def submit(self, payload):
+        def work():
+            try:
+                payload()
+            except Exception as e:
+                with self._lock:
+                    self.last_error = e
+
+        self._ex.submit(work)
+
+    def flush(self):
+        with self._lock:
+            err, self.last_error = self.last_error, None
+        if err is not None:
+            raise RuntimeError(str(err))
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+
+    def inc(self, name):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + 1
+
+    def set(self, name, value):
+        with self._lock:
+            self.counters[name] = value
+
+    def rotate(self):
+        with self._lock:
+            self._publish()
+
+    def _publish(self):
+        # every intra-class call site holds the lock (fixpoint inference)
+        self.counters["published"] = 1
+
+
+class Reporter:
+    def tick(self, metrics, value):
+        metrics.set("recompiles", value)  # locked accessor, not a bypass

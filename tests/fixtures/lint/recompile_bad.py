@@ -1,0 +1,35 @@
+"""Known-bad twin for the recompile-hazard checker.
+
+Three ways to build a compile cache that cannot hit: a fresh ``jax.jit``
+wrapper per loop iteration, a wrapper created and thrown away after one
+call, and a size-derived static argument that makes the compile-key
+space grow with the data.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def padded_step(x, n):
+    return x[:n] * 2
+
+
+def fresh_wrapper_per_iteration(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # LINT[recompile-hazard]
+        outs.append(f(x))
+    return outs
+
+
+def throwaway_wrapper(x):
+    return jax.jit(lambda v: v + 1)(x)  # LINT[recompile-hazard]
+
+
+def unbounded_key_space(batches):
+    outs = []
+    for b in batches:
+        outs.append(padded_step(b, n=len(b)))  # LINT[recompile-hazard]
+    return outs

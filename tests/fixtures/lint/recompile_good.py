@@ -1,0 +1,42 @@
+"""Known-good twin for the recompile-hazard checker.
+
+The wrapper is bound once at module import and reused, and the static
+argument is bucketed to a bounded ladder (the serve ``BucketLadder``
+idiom) before it reaches the jitted callee.
+"""
+
+import functools
+
+import jax
+
+
+def _double(v):
+    return v * 2
+
+
+fast_step = jax.jit(_double)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def padded_step(x, n):
+    return x[:n] * 2
+
+
+def _bucket(n):
+    # pow2 ladder: bounded number of distinct compile keys
+    size = 1
+    while size < n:
+        size *= 2
+    return size
+
+
+def reuse_wrapper(xs):
+    return [fast_step(x) for x in xs]
+
+
+def bounded_key_space(batches):
+    outs = []
+    for b in batches:
+        n = _bucket(len(b))
+        outs.append(padded_step(b, n=n))
+    return outs

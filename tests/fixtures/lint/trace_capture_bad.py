@@ -1,0 +1,47 @@
+"""Known-bad twin for the trace-capture checker.
+
+Distills the PR-5 ``XTPU_NAN_POLICY`` bug: an env var read while jax is
+tracing gets baked into the compiled program, so later changes to the
+variable are silently ignored by every cached executable. Both the
+direct read (inside a jitted function) and the indirect one (a helper
+reachable from the traced region through the call graph) must be
+flagged.
+
+Never imported — parsed only by tests/test_xtpulint.py. Lines expected
+to be flagged carry a marker comment (same convention in every twin).
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _guard_mode():
+    # helper reachable from the traced region below -> trace-time read
+    return os.environ.get("XTPU_FIXTURE_GUARD", "raise")  # LINT[trace-capture]
+
+
+@jax.jit
+def guarded_update(margin, delta):
+    if _guard_mode() == "zero":
+        delta = jnp.nan_to_num(delta)
+    return margin + delta
+
+
+@functools.partial(jax.jit, static_argnames=("lr",))
+def direct_read_step(x, lr=0.1):
+    if os.environ.get("XTPU_FIXTURE_FAST") == "1":  # LINT[trace-capture]
+        return x * lr
+    return x * lr * 0.5
+
+
+def scanned_body(carry, x):
+    if os.getenv("XTPU_FIXTURE_SCAN"):  # LINT[trace-capture]
+        carry = carry + x
+    return carry, carry
+
+
+def run_scan(xs):
+    return jax.lax.scan(scanned_body, 0.0, xs)

@@ -1,0 +1,36 @@
+"""Known-good twin for the trace-capture checker — the PR-5 fix pattern.
+
+Regression fixture for the ``XTPU_NAN_POLICY`` repair: the env var is
+read OUTSIDE the traced region (host-side, per call) and threaded into
+the jitted function through ``static_argnames``, so the value is part of
+the compile key and a changed env var produces a fresh trace instead of
+a stale cached program. The checker must stay silent here.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _nan_policy():
+    # host-side read: runs per call, never under trace
+    return os.environ.get("XTPU_FIXTURE_NAN_POLICY", "raise")
+
+
+@functools.partial(jax.jit, static_argnames=("nan_policy",))
+def fused_round(margin, delta, nan_policy="raise"):
+    if nan_policy == "zero":
+        delta = jnp.nan_to_num(delta)
+    return margin + delta
+
+
+def train_round(margin, delta):
+    # the value rides into the compile key as a static argument
+    return fused_round(margin, delta, nan_policy=_nan_policy())
+
+
+def configure_logging():
+    # env read in plain host code, unreachable from any traced region
+    return os.environ.get("XTPU_FIXTURE_LOG_LEVEL", "info")

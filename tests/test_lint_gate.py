@@ -1,0 +1,70 @@
+"""Tier-1 gate: the repo is xtpulint-clean modulo the reviewed baseline.
+
+This is the enforcement half of tools/xtpulint (docs/static_analysis.md):
+
+- zero NEW findings — every finding either gets fixed or gets a
+  baseline entry with a written justification;
+- every baseline entry is justified — an empty justification fails the
+  build, so suppressions cannot be waved through;
+- zero STALE entries — when a baselined finding is fixed, its entry
+  must be deleted so the suppression cannot silently mask a future
+  regression at the same fingerprint.
+
+Pure ast analysis: no jax import, no device, sub-second.
+"""
+
+import os
+
+from tools.xtpulint import lint_repo
+from tools.xtpulint.baseline import DEFAULT_BASELINE, load_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _result():
+    return lint_repo(REPO)
+
+
+def test_repo_has_no_new_findings():
+    result = _result()
+    report = "\n".join(f.render() for f in result.new)
+    assert result.ok, (
+        f"{len(result.new)} new xtpulint finding(s) — fix them or add a "
+        f"justified baseline entry (python -m tools.xtpulint "
+        f"--write-baseline):\n{report}")
+
+
+def test_repo_parses_clean():
+    from tools.xtpulint.engine import LintConfig, RepoIndex
+    index = RepoIndex(LintConfig(root=REPO))
+    assert not index.errors, index.errors
+    assert len(index.modules) > 20  # sanity: the walk found the package
+
+
+def test_every_baseline_entry_is_justified():
+    bl = load_baseline(DEFAULT_BASELINE)
+    unjustified = [e for e in bl.entries if not e.justification.strip()]
+    assert not unjustified, (
+        "baseline entries without a written justification: "
+        + ", ".join(f"{e.path}:{e.line} [{e.checker}]"
+                    for e in unjustified))
+
+
+def test_no_stale_baseline_entries():
+    result = _result()
+    assert not result.stale, (
+        "baseline entries whose finding no longer exists (delete them): "
+        + ", ".join(f"{e.fingerprint} {e.path}:{e.line} [{e.checker}]"
+                    for e in result.stale))
+
+
+def test_fixed_defects_stay_fixed():
+    """The two real defects this analyzer surfaced and PR 6 fixed must
+    never come back: SnapshotWriter.last_error races (checkpoint.py) and
+    the ServeMetrics.counters lock bypass (serve/server.py)."""
+    result = _result()
+    for f in result.all_findings:
+        assert not (f.checker == "lock-discipline"
+                    and f.path in ("xgboost_tpu/utils/checkpoint.py",
+                                   "xgboost_tpu/serve/server.py")), \
+            f.render()

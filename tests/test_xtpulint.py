@@ -1,0 +1,248 @@
+"""xtpulint unit tests: fixture twins, suppressions, baseline mechanics.
+
+The fixtures under tests/fixtures/lint/ are bad/good twins per checker.
+Bad twins carry a ``LINT[<slug>]`` marker comment on every line the
+checker must flag — the test derives its expectations from the markers,
+so fixture and expectation can never drift apart. Good twins must be
+completely clean; trace_capture_good.py is the regression fixture for
+the PR-5 ``XTPU_NAN_POLICY`` fix pattern (host-side read + static-arg
+compile key).
+
+Everything here is pure ``ast`` work — no jax import, no device.
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tools.xtpulint import lint_repo
+from tools.xtpulint.baseline import (Baseline, Suppression, format_baseline,
+                                     load_baseline, suppression_of)
+from tools.xtpulint.engine import (Finding, LintConfig, RepoIndex,
+                                   run_checkers)
+from tools.xtpulint.envdoc import classify_sites
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+_MARKER = re.compile(r"#\s*LINT\[([a-z-]+)\]")
+
+
+def _fixture_findings():
+    cfg = LintConfig(root=FIXTURES, paths=(".",),
+                     host_sync_scope=("",), lock_scope=("",))
+    findings = run_checkers(RepoIndex(cfg))
+    by_file = {}
+    for f in findings:
+        by_file.setdefault(f.path, set()).add((f.line, f.checker))
+    return by_file
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return _fixture_findings()
+
+
+def _markers(path):
+    expected = set()
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            m = _MARKER.search(line)
+            if m:
+                expected.add((lineno, m.group(1)))
+    return expected
+
+
+def _twins(suffix):
+    names = [os.path.basename(p)
+             for p in glob.glob(os.path.join(FIXTURES, f"*_{suffix}.py"))]
+    assert names, f"no *_{suffix}.py fixtures found"
+    return sorted(names)
+
+
+@pytest.mark.parametrize("name", _twins("bad"))
+def test_bad_twin_flags_exactly_marked_lines(name, fixture_findings):
+    expected = _markers(os.path.join(FIXTURES, name))
+    assert expected, f"{name} has no LINT markers — not a bad twin"
+    got = fixture_findings.get(name, set())
+    assert got == expected, (
+        f"{name}: missed={sorted(expected - got)} "
+        f"unexpected={sorted(got - expected)}")
+
+
+@pytest.mark.parametrize("name", _twins("good"))
+def test_good_twin_is_clean(name, fixture_findings):
+    assert _markers(os.path.join(FIXTURES, name)) == set()
+    assert fixture_findings.get(name, set()) == set()
+
+
+def test_every_checker_has_a_twin_pair():
+    from tools.xtpulint.checkers import CHECKERS
+    covered = set()
+    for name in _twins("bad"):
+        covered.update(slug for _, slug in
+                       _markers(os.path.join(FIXTURES, name)))
+    assert covered == set(CHECKERS), (
+        f"checkers without a bad-twin fixture: {set(CHECKERS) - covered}")
+
+
+# ------------------------------------------------------------- suppressions
+
+def test_inline_suppression_comment(tmp_path):
+    src = (
+        "import os\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # xtpulint: disable=trace-capture -- fixture\n"
+        "    if os.environ.get('K'):\n"
+        "        return x * 2\n"
+        "    return x\n"
+        "@jax.jit\n"
+        "def g(x):\n"
+        "    if os.environ.get('K'):  # not suppressed\n"
+        "        return x * 2\n"
+        "    return x\n")
+    (tmp_path / "m.py").write_text(src)
+    cfg = LintConfig(root=str(tmp_path), paths=("m.py",))
+    findings = run_checkers(RepoIndex(cfg))
+    assert [(f.line, f.checker) for f in findings] == \
+        [(11, "trace-capture")]
+
+
+def test_inline_disable_all(tmp_path):
+    src = (
+        "import os\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if os.environ.get('K'):  # xtpulint: disable=all\n"
+        "        return x * 2\n"
+        "    return x\n")
+    (tmp_path / "m.py").write_text(src)
+    cfg = LintConfig(root=str(tmp_path), paths=("m.py",))
+    assert run_checkers(RepoIndex(cfg)) == []
+
+
+# ------------------------------------------------------------- fingerprints
+
+def test_fingerprint_survives_line_drift():
+    a = Finding(checker="c", path="p.py", line=10, symbol="f",
+                message="m", line_text="x = os.environ.get('K')")
+    b = Finding(checker="c", path="p.py", line=99, symbol="f",
+                message="m", line_text="x  =  os.environ.get('K')")
+    assert a.fingerprint == b.fingerprint
+
+
+def test_fingerprint_distinguishes_occurrences():
+    a = Finding(checker="c", path="p.py", line=10, symbol="f",
+                message="m", line_text="t", occurrence=0)
+    b = Finding(checker="c", path="p.py", line=11, symbol="f",
+                message="m", line_text="t", occurrence=1)
+    assert a.fingerprint != b.fingerprint
+
+
+# ----------------------------------------------------------------- baseline
+
+def test_baseline_roundtrip(tmp_path):
+    entries = [
+        Suppression(fingerprint="abc123", checker="trace-capture",
+                    path="x/y.py", symbol="C.m", line=5,
+                    justification='tricky "quoted"\nmultiline \\ text'),
+        Suppression(fingerprint="def456", checker="host-sync",
+                    path="a.py", symbol="f", line=1, justification="ok"),
+    ]
+    p = tmp_path / "baseline.toml"
+    p.write_text(format_baseline(entries))
+    loaded = load_baseline(str(p))
+    by_fp = loaded.by_fingerprint()
+    assert set(by_fp) == {"abc123", "def456"}
+    e = by_fp["abc123"]
+    assert e.justification == 'tricky "quoted"\nmultiline \\ text'
+    assert e.line == 5 and e.checker == "trace-capture"
+
+
+def test_baseline_split_new_suppressed_stale():
+    f1 = Finding(checker="c", path="p.py", line=1, symbol="f",
+                 message="m", line_text="aaa")
+    f2 = Finding(checker="c", path="p.py", line=2, symbol="f",
+                 message="m", line_text="bbb")
+    bl = Baseline(entries=[
+        suppression_of(f1, "why"),
+        Suppression(fingerprint="gone000", checker="c", path="q.py"),
+    ])
+    new, suppressed, stale = bl.split([f1, f2])
+    assert [f.line_text for f in new] == ["bbb"]
+    assert [f.line_text for f in suppressed] == ["aaa"]
+    assert [e.fingerprint for e in stale] == ["gone000"]
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    bl = load_baseline(str(tmp_path / "nope.toml"))
+    assert bl.entries == []
+
+
+# ------------------------------------------------------------------ env doc
+
+def test_env_classification(tmp_path):
+    src = (
+        "import os\n"
+        "import jax\n"
+        "LEVEL = os.environ.get('E_IMPORT', 'x')\n"
+        "def _setup():\n"
+        "    return os.environ.get('E_HELPER')\n"
+        "_setup()\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.v = os.environ.get('E_CTOR')\n"
+        "    def step(self):\n"
+        "        return os.environ.get('E_CALL')\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if os.environ.get('E_TRACE'):\n"
+        "        return x\n"
+        "    return x * 2\n")
+    (tmp_path / "m.py").write_text(src)
+    cfg = LintConfig(root=str(tmp_path), paths=("m.py",))
+    sites = {s.var: s.klass for s in classify_sites(RepoIndex(cfg))}
+    assert sites == {
+        "E_IMPORT": "import-time",
+        "E_HELPER": "import-time",
+        "E_CTOR": "construction-time",
+        "E_CALL": "call-time",
+        "E_TRACE": "trace-time (compile-key)",
+    }
+
+
+# ---------------------------------------------------------------------- CLI
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.xtpulint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_json_reports_fixture_findings():
+    proc = _run_cli("--root", FIXTURES, "--no-baseline", "--json",
+                    "--select", "trace-capture", ".")
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["counts"]["new"] == 3
+    assert {f["path"] for f in report["new"]} == {"trace_capture_bad.py"}
+    assert all(f["fingerprint"] for f in report["new"])
+
+
+def test_cli_clean_exit_zero():
+    proc = _run_cli("--root", FIXTURES, "--no-baseline",
+                    "--select", "trace-capture", "trace_capture_good.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_repo_api_matches_cli():
+    result = lint_repo(FIXTURES, paths=("trace_capture_bad.py",),
+                       baseline_path=None, select=("trace-capture",))
+    assert len(result.new) == 3 and not result.ok

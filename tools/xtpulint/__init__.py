@@ -1,0 +1,43 @@
+"""xtpulint — a whole-repo static analyzer for this codebase's jax/TPU
+failure modes: trace-time env capture, host syncs in hot loops, recompile
+hazards, donation misuse, lock discipline, and collective symmetry.
+
+Run ``python -m tools.xtpulint --help`` or see docs/static_analysis.md.
+The tier-1 gate (tests/test_lint_gate.py) keeps the repo at
+zero-new-findings against tools/xtpulint/baseline.toml.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .baseline import Baseline, DEFAULT_BASELINE, load_baseline
+from .engine import Finding, LintConfig, RepoIndex, run_checkers
+
+__all__ = ["Finding", "LintConfig", "RepoIndex", "run_checkers",
+           "lint_repo", "LintResult"]
+
+
+class LintResult:
+    def __init__(self, findings: List[Finding], baseline: Baseline) -> None:
+        self.all_findings = findings
+        self.new, self.suppressed, self.stale = baseline.split(findings)
+        self.baseline = baseline
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def lint_repo(root: str, *, paths: Optional[Tuple[str, ...]] = None,
+              baseline_path: Optional[str] = DEFAULT_BASELINE,
+              select: Optional[Tuple[str, ...]] = None) -> LintResult:
+    """Programmatic entry point used by the tier-1 gate and the tests."""
+    cfg = LintConfig(root=root, select=select)
+    if paths is not None:
+        cfg.paths = paths
+    index = RepoIndex(cfg)
+    findings = run_checkers(index)
+    baseline = (load_baseline(baseline_path) if baseline_path
+                else Baseline())
+    return LintResult(findings, baseline)

@@ -1,0 +1,131 @@
+"""CLI: ``python -m tools.xtpulint [--json] [--baseline FILE] ...``
+
+Exit codes: 0 = clean (no findings outside the baseline), 1 = new
+findings, 2 = usage/internal error. See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from . import lint_repo
+from .baseline import (DEFAULT_BASELINE, format_baseline, load_baseline,
+                       suppression_of)
+from .checkers import CHECKERS
+from .engine import LintConfig, RepoIndex
+from .envdoc import render_env_doc
+
+
+def _repo_root() -> str:
+    # tools/xtpulint/__main__.py -> repo root two levels up
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.xtpulint",
+        description="Domain-specific static analyzer for xgboost_tpu "
+                    "(trace-capture, host-sync, recompile-hazard, "
+                    "donation-misuse, lock-discipline, "
+                    "collective-symmetry).")
+    ap.add_argument("paths", nargs="*",
+                    help="paths to scan, relative to --root "
+                         "(default: xgboost_tpu)")
+    ap.add_argument("--root", default=_repo_root(),
+                    help="repository root (default: autodetected)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: "
+                         "tools/xtpulint/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write skeleton suppressions for all CURRENT "
+                         "findings to --baseline (justifications for new "
+                         "entries are left empty and MUST be filled in "
+                         "by hand — the gate rejects empty ones)")
+    ap.add_argument("--env-doc", nargs="?", const="docs/env_knobs.md",
+                    default=None, metavar="FILE",
+                    help="write the generated env-knob inventory "
+                         "(default target: docs/env_knobs.md) and exit")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated checker slugs to run")
+    ap.add_argument("--list-checkers", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for slug in CHECKERS:
+            print(slug)
+        return 0
+
+    select = tuple(s.strip() for s in args.select.split(",")) \
+        if args.select else None
+    paths = tuple(args.paths) if args.paths else None
+
+    if args.env_doc is not None:
+        cfg = LintConfig(root=args.root)
+        if paths:
+            cfg.paths = paths
+        index = RepoIndex(cfg)
+        target = os.path.join(args.root, args.env_doc)
+        doc = render_env_doc(index)
+        with open(target, "w", encoding="utf-8") as fh:
+            fh.write(doc)
+        print(f"wrote {args.env_doc} "
+              f"({doc.count(chr(10))} lines)")
+        return 0
+
+    baseline_path = None if args.no_baseline else args.baseline
+    result = lint_repo(args.root, paths=paths,
+                       baseline_path=baseline_path, select=select)
+
+    if args.write_baseline:
+        existing = load_baseline(args.baseline).by_fingerprint()
+        entries = []
+        for f in result.all_findings:
+            old = existing.get(f.fingerprint)
+            entries.append(suppression_of(
+                f, old.justification if old else ""))
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.write(format_baseline(entries))
+        empty = sum(1 for e in entries if not e.justification)
+        print(f"wrote {len(entries)} suppressions to {args.baseline} "
+              f"({empty} need justifications)")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in result.new],
+            "suppressed": [f.to_dict() for f in result.suppressed],
+            "stale_baseline": [e.fingerprint for e in result.stale],
+            "counts": {
+                "new": len(result.new),
+                "suppressed": len(result.suppressed),
+                "stale": len(result.stale),
+            },
+        }, indent=2))
+        return 0 if result.ok else 1
+
+    for f in result.new:
+        print(f.render())
+    if result.stale:
+        print(f"note: {len(result.stale)} stale baseline entr"
+              f"{'y' if len(result.stale) == 1 else 'ies'} (fixed "
+              "findings still suppressed) — run --write-baseline and "
+              "review:")
+        for e in result.stale:
+            print(f"  {e.fingerprint}  {e.path}:{e.line} [{e.checker}]")
+    print(f"xtpulint: {len(result.new)} new, "
+          f"{len(result.suppressed)} baselined, "
+          f"{len(result.stale)} stale baseline entries")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

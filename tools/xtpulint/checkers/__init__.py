@@ -1,0 +1,27 @@
+"""Checker registry: slug -> check(index) -> [Finding].
+
+Checker ids are stable API — they appear in baseline entries, inline
+suppressions (``# xtpulint: disable=<slug>``) and docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..engine import Finding, RepoIndex
+
+from .trace_capture import check_trace_capture
+from .host_sync import check_host_sync
+from .recompile import check_recompile
+from .donation import check_donation
+from .locks import check_locks
+from .collectives import check_collectives
+
+CHECKERS: Dict[str, Callable[[RepoIndex], List[Finding]]] = {
+    "trace-capture": check_trace_capture,
+    "host-sync": check_host_sync,
+    "recompile-hazard": check_recompile,
+    "donation-misuse": check_donation,
+    "lock-discipline": check_locks,
+    "collective-symmetry": check_collectives,
+}

@@ -1,0 +1,97 @@
+"""collective-symmetry: communicator ops under rank-dependent branches.
+
+Collectives are rendezvous points: EVERY rank must execute the same
+sequence, or the world deadlocks / reduces mismatched payloads. PR 4's
+in-band framing detects such desyncs at runtime; this checker prevents
+the textbook cause statically — a collective call lexically inside a
+branch whose condition depends on the rank::
+
+    if comm.get_rank() == 0:
+        comm.allreduce(x)          # ranks != 0 never arrive: desync
+
+Rank-dependent *payloads* feeding a symmetric call are fine and common
+(``payload = x if rank == 0 else None; comm.broadcast(payload)``) — the
+checker only looks at the call's enclosing ``if``/``while``/ternary
+tests, not its arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..engine import Finding, RepoIndex, dotted
+
+HINT = ("hoist the collective out of the rank branch so every rank "
+        "executes it (make the PAYLOAD rank-dependent instead, like "
+        "tree/updaters.py sync_trees), or document why all ranks provably "
+        "take the same branch and baseline it")
+
+COLLECTIVE_NAMES = {
+    "allreduce", "allgather", "allgather_objects", "broadcast", "barrier",
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "global_sum", "global_ratio", "apply_with_labels",
+    "agree_round", "reduce_scatter",
+}
+
+_RANK_CALLS = {"get_rank", "get_world_size"}
+_RANK_NAMES = {"rank", "world_rank", "local_rank", "is_leader", "is_root",
+               "is_coordinator", "label_rank"}
+
+
+def _rank_dependent(test: ast.AST) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func) or ""
+            if d.rsplit(".", 1)[-1] in _RANK_CALLS:
+                return True
+        elif isinstance(sub, ast.Name) and sub.id in _RANK_NAMES:
+            return True
+        elif isinstance(sub, ast.Attribute) and sub.attr in _RANK_NAMES:
+            return True
+    return False
+
+
+def _rank_branch(mod, node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing If/While/IfExp with a rank-dependent test that
+    the node sits in the BODY (not the test) of. Stops at def boundaries.
+    """
+    cur = node
+    parent = mod.parents.get(cur)
+    while parent is not None:
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return None
+        if isinstance(parent, (ast.If, ast.While)) \
+                and cur is not parent.test and _rank_dependent(parent.test):
+            return parent
+        if isinstance(parent, ast.IfExp) and cur is not parent.test \
+                and _rank_dependent(parent.test):
+            return parent
+        cur, parent = parent, mod.parents.get(parent)
+    return None
+
+
+def check_collectives(index: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name not in COLLECTIVE_NAMES:
+                continue
+            branch = _rank_branch(mod, node)
+            if branch is None:
+                continue
+            out.append(mod.finding(
+                "collective-symmetry", node,
+                f"collective {name!r} executes under a rank-dependent "
+                f"branch (line {branch.lineno}) — ranks taking the other "
+                "path never reach the rendezvous and the world desyncs",
+                HINT))
+    return out

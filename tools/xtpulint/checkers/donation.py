@@ -1,0 +1,217 @@
+"""donation-misuse: a donated buffer referenced after the donating call.
+
+``donate_argnums`` tells XLA it may destroy the input buffer in place.
+After the call returns, the Python reference still LOOKS alive — reading
+it raises a deleted-buffer error at best, and on some backends silently
+reads garbage. The safe idiom is immediate rebinding::
+
+    margin = fused(bins, margin)        # donated slot rebound: OK
+    out = fused(bins, margin)
+    use(margin)                         # <-- flagged
+
+The checker tracks donation bindings three ways: ``@partial(jax.jit,
+donate_argnums=...)`` decorators, ``x = jax.jit(f, donate_argnums=...)``
+assignments (including ``self._fn = ...`` attributes, resolved by attr
+name), and ``**{"donate_argnums": ...}`` kwarg dicts. A donated argument
+expression (compared by source text, so ``state["margin"]`` works like a
+bare name) must be rebound by the call statement or never loaded again;
+a donating call inside a loop whose donated slot is not rebound each
+iteration is also flagged — iteration 2 would pass a deleted buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import (Finding, JIT_WRAPPERS, PARTIAL_NAMES, RepoIndex,
+                      dotted, matches)
+
+HINT = ("rebind the donated slot at the call site (``x = f(..., x)``) or "
+        "drop donate_argnums for this argument; if the later reference is "
+        "provably dead code, delete it")
+
+
+def _jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    if matches(d, JIT_WRAPPERS):
+        return True
+    if matches(d, PARTIAL_NAMES) and node.args:
+        return matches(dotted(node.args[0]), JIT_WRAPPERS)
+    return False
+
+
+def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
+    """Ints mentioned in donate_argnums (kwarg, or inside a **dict)."""
+    ints: Set[int] = set()
+
+    def ints_of(node: ast.AST) -> Set[int]:
+        return {sub.value for sub in ast.walk(node)
+                if isinstance(sub, ast.Constant)
+                and type(sub.value) is int}
+
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            ints |= ints_of(kw.value)
+        elif kw.arg is None:  # **kwargs: look for dicts carrying the key
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Dict):
+                    for k, v in zip(sub.keys, sub.values):
+                        if isinstance(k, ast.Constant) \
+                                and k.value == "donate_argnums":
+                            ints |= ints_of(v)
+    return tuple(sorted(ints))
+
+
+def _collect_bindings(mod) -> Dict[str, Tuple[int, ...]]:
+    """callable-name (bare name or attribute leaf) -> donated positions."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _jit_call(dec):
+                    pos = _donated_positions(dec)
+                    if pos:
+                        out[node.name] = pos
+        elif isinstance(node, ast.Assign) and _jit_call(node.value):
+            pos = _donated_positions(node.value)
+            if not pos:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = pos
+                elif isinstance(tgt, ast.Attribute):
+                    out[tgt.attr] = pos
+    return out
+
+
+def _stmt_of(node: ast.AST, parents) -> Optional[ast.stmt]:
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = parents.get(cur)
+    return cur
+
+
+def _targets_texts(stmt: ast.stmt) -> Set[str]:
+    """Source texts rebound by an assignment statement (tuple-aware)."""
+    texts: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            texts.update(ast.unparse(e) for e in t.elts)
+        else:
+            texts.add(ast.unparse(t))
+    return texts
+
+
+def check_donation(index: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in index.modules.values():
+        bindings = _collect_bindings(mod)
+        if not bindings:
+            continue
+        for info in mod.functions.values():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            stmts = list(ast.walk(info.node))
+            for node in stmts:
+                if not isinstance(node, ast.Call):
+                    continue
+                if mod.symbol_of(node) != info.symbol:
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee = node.func.attr
+                pos = bindings.get(callee or "")
+                if not pos:
+                    continue
+                stmt = _stmt_of(node, mod.parents)
+                if stmt is None:
+                    continue
+                rebound = _targets_texts(stmt)
+                for p in pos:
+                    if p >= len(node.args):
+                        continue
+                    arg = node.args[p]
+                    if isinstance(arg, ast.Constant):
+                        continue
+                    text = ast.unparse(arg)
+                    if text in rebound:
+                        continue
+                    out.extend(_uses_after(
+                        mod, info, node, stmt, callee, text))
+    return out
+
+
+def _uses_after(mod, info, call: ast.Call, stmt: ast.stmt, callee: str,
+                text: str) -> List[Finding]:
+    """Findings for loads of ``text`` after the donating call (or the call
+    itself when it donates un-rebound inside a loop)."""
+    findings: List[Finding] = []
+    call_line = call.lineno
+    stores: List[int] = []
+    loads: List[ast.AST] = []
+    for node in ast.walk(info.node):
+        if mod.symbol_of(node) != info.symbol:
+            continue
+        if isinstance(node, ast.stmt):
+            if node is not stmt and text in _targets_texts(node):
+                stores.append(node.lineno)
+        if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            try:
+                if ast.unparse(node) == text:
+                    loads.append(node)
+            except Exception:  # pragma: no cover
+                continue
+    for load in loads:
+        if load.lineno <= call_line:
+            continue
+        # an intervening rebinding clears the hazard
+        if any(call_line < s <= load.lineno for s in stores):
+            continue
+        # the load inside the donating call itself (multi-line call)
+        if call_line <= load.lineno <= getattr(call, "end_lineno",
+                                               call_line):
+            continue
+        findings.append(mod.finding(
+            "donation-misuse", load,
+            f"{text!r} was donated to {callee!r} at line {call_line} and "
+            "is referenced afterwards — the buffer may already be "
+            "deleted (or silently reused) by XLA", HINT))
+        break  # one finding per donating call is enough signal
+    # donated inside a loop without rebinding: next iteration re-donates
+    # a deleted buffer even with no later textual load
+    if not findings:
+        loop = _loop_between(mod, info, stmt)
+        if loop is not None and not any(
+                loop.lineno <= s <= getattr(loop, "end_lineno", s)
+                for s in stores):
+            findings.append(mod.finding(
+                "donation-misuse", call,
+                f"{text!r} is donated to {callee!r} inside a loop without "
+                "being rebound — the next iteration passes an "
+                "already-deleted buffer", HINT))
+    return findings
+
+
+def _loop_between(mod, info, stmt: ast.stmt):
+    cur = mod.parents.get(stmt)
+    while cur is not None and cur is not info.node:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        cur = mod.parents.get(cur)
+    return None

@@ -1,0 +1,324 @@
+"""lock-discipline: shared attributes mutated without the owning lock.
+
+Scope: the thread-bearing subsystems (``serve/``, ``pipeline/``,
+``utils/checkpoint.py``, ``data/binned.py``, ``parallel/`` by default).
+For every class that OWNS a lock (assigns ``threading.Lock`` / ``RLock``
+/ ``Condition`` / ``Semaphore`` to an attribute), the checker infers a
+GuardedBy discipline and flags three violation shapes:
+
+R1 **inconsistent guard** — an attribute mutated under the lock in one
+   method and outside any lock in another (excluding ``__init__`` /
+   ``__new__``, which happen-before publication).
+R2 **unguarded write in a thread entrypoint** — an attribute written
+   without the lock inside a function that runs on another thread
+   (``threading.Thread(target=...)``, ``executor.submit(fn)``) while
+   other methods of the class also touch it. This is the
+   ``SnapshotWriter.last_error`` class of bug: a lost update needs no
+   guarded twin to be real.
+R3 **cross-object mutation of a guarded attribute** — code outside the
+   owning class directly mutates an attribute that the owning class
+   only ever touches under its lock (``server.metrics.counters[...] =``
+   while ``ServeMetrics`` guards ``counters``).
+
+"Under the lock" means lexically inside ``with self.<lock>:`` — or inside
+a private method whose every intra-class call site is itself under the
+lock (one fixpoint pass), or a method following the ``*_locked`` naming
+convention (the caller-holds-lock contract used by serve/batcher.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, RepoIndex, dotted
+
+HINT = ("take the owning lock around the mutation (or add a small locked "
+        "accessor on the owning class); if the attribute is genuinely "
+        "single-threaded or write-once-before-publish, baseline with that "
+        "argument")
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add", "remove",
+             "discard", "pop", "popleft", "clear", "update", "setdefault"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func) or ""
+    leaf = d.rsplit(".", 1)[-1]
+    return leaf in _LOCK_TYPES
+
+
+@dataclass
+class _Mutation:
+    attr: str
+    node: ast.AST
+    method: str            # qualified method symbol within the class
+    guarded: bool
+    is_store: bool         # plain store vs container mutation
+
+
+@dataclass
+class _ClassModel:
+    name: str
+    mod: object
+    node: ast.ClassDef
+    locks: Set[str] = field(default_factory=set)
+    mutations: List[_Mutation] = field(default_factory=list)
+    # attr -> methods that read it (Load on self.attr)
+    reads: Dict[str, Set[str]] = field(default_factory=dict)
+    entrypoints: Set[str] = field(default_factory=set)  # method symbols
+    locked_methods: Set[str] = field(default_factory=set)
+    # attrs that are ONLY ever mutated under the lock inside this class
+    def guarded_only_attrs(self) -> Set[str]:
+        guarded = {m.attr for m in self.mutations
+                   if m.guarded and not m.method.endswith("__init__")}
+        unguarded = {m.attr for m in self.mutations
+                     if not m.guarded and not m.method.endswith("__init__")}
+        return guarded - unguarded
+
+
+def _method_symbol(mod, node: ast.AST) -> str:
+    return mod.symbol_of(node)
+
+
+def _under_lock_with(mod, node: ast.AST, locks: Set[str],
+                     cls_node: ast.ClassDef) -> bool:
+    """Lexically inside ``with self.<lock>`` (stops at the class body)."""
+    lock_texts = {f"self.{name}" for name in locks}
+    cur = mod.parents.get(node)
+    while cur is not None and cur is not cls_node:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                try:
+                    if ast.unparse(item.context_expr) in lock_texts:
+                        return True
+                except Exception:  # pragma: no cover
+                    pass
+        cur = mod.parents.get(cur)
+    return False
+
+
+def _build_class_model(index: RepoIndex, mod, cls: ast.ClassDef
+                       ) -> Optional[_ClassModel]:
+    model = _ClassModel(name=cls.name, mod=mod, node=cls)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    model.locks.add(tgt.attr)
+    if not model.locks:
+        return None
+
+    # thread entrypoints: Thread(target=X) / executor.submit(X)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        target = None
+        d = dotted(node.func) or ""
+        if d.rsplit(".", 1)[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "submit" and node.args:
+            target = node.args[0]
+        if target is None:
+            continue
+        t = dotted(target)
+        if not t:
+            continue
+        leaf = t.rsplit(".", 1)[-1]
+        for qual, info in mod.functions.items():
+            if info.name == leaf and info.symbol.startswith(cls.name + "."):
+                model.entrypoints.add(info.symbol)
+
+    # mutations + reads of self.<attr>
+    for node in ast.walk(cls):
+        method = _method_symbol(mod, node)
+        attr: Optional[str] = None
+        is_store = True
+        rec: Optional[ast.AST] = None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                tgts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                for t in tgts:
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                        store_kind = False
+                    else:
+                        store_kind = True
+                    if isinstance(base, ast.Attribute) \
+                            and isinstance(base.value, ast.Name) \
+                            and base.value.id == "self" \
+                            and base.attr not in model.locks:
+                        guarded = _under_lock_with(mod, node, model.locks,
+                                                   cls)
+                        model.mutations.append(_Mutation(
+                            base.attr, t, method, guarded, store_kind))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            rec = node.func.value
+            if isinstance(rec, ast.Attribute) \
+                    and isinstance(rec.value, ast.Name) \
+                    and rec.value.id == "self":
+                guarded = _under_lock_with(mod, node, model.locks, cls)
+                model.mutations.append(_Mutation(
+                    rec.attr, node, method, guarded, False))
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr not in model.locks:
+            model.reads.setdefault(node.attr, set()).add(method)
+
+    _infer_locked_methods(mod, cls, model)
+    return model
+
+
+def _infer_locked_methods(mod, cls: ast.ClassDef,
+                          model: _ClassModel) -> None:
+    """Methods whose callers always hold the lock count as locked context:
+    the ``*_locked`` naming convention, plus private methods whose every
+    intra-class call site is under the lock (iterated to fixpoint)."""
+    methods = {info.name: info for info in mod.functions.values()
+               if info.symbol.startswith(cls.name + ".")
+               and info.symbol.count(".") == 1}
+    for name in methods:
+        if name.endswith("_locked"):
+            model.locked_methods.add(f"{cls.name}.{name}")
+    changed = True
+    while changed:
+        changed = False
+        for name, info in methods.items():
+            sym = f"{cls.name}.{name}"
+            if sym in model.locked_methods or not name.startswith("_") \
+                    or name.startswith("__"):
+                continue
+            call_sites = []
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == name \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    call_sites.append(node)
+            if not call_sites:
+                continue
+            if all(_under_lock_with(mod, c, model.locks, cls)
+                   or _method_symbol(mod, c) in model.locked_methods
+                   for c in call_sites):
+                model.locked_methods.add(sym)
+                changed = True
+
+
+def _effective_guarded(model: _ClassModel, m: _Mutation) -> bool:
+    return m.guarded or m.method in model.locked_methods
+
+
+def check_locks(index: RepoIndex) -> List[Finding]:
+    scope = index.config.lock_scope
+    out: List[Finding] = []
+    models: List[_ClassModel] = []
+    for mod in index.modules.values():
+        if not index.in_scope(mod.relpath, scope):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                model = _build_class_model(index, mod, node)
+                if model is not None:
+                    models.append(model)
+
+    guarded_attr_owner: Dict[str, List[_ClassModel]] = {}
+    for model in models:
+        for attr in model.guarded_only_attrs():
+            guarded_attr_owner.setdefault(attr, []).append(model)
+
+    flagged: Set[Tuple[str, int]] = set()
+
+    def emit(mod, node, msg) -> None:
+        f = mod.finding("lock-discipline", node, msg, HINT)
+        key = (f.path, f.line)
+        if key not in flagged:
+            flagged.add(key)
+            out.append(f)
+
+    for model in models:
+        mod = model.mod
+        init_syms = (f"{model.name}.__init__", f"{model.name}.__new__",
+                     f"{model.name}.__post_init__")
+        by_attr: Dict[str, List[_Mutation]] = {}
+        for m in model.mutations:
+            if m.method in init_syms:
+                continue
+            by_attr.setdefault(m.attr, []).append(m)
+        for attr, muts in by_attr.items():
+            guarded = [m for m in muts if _effective_guarded(model, m)]
+            unguarded = [m for m in muts
+                         if not _effective_guarded(model, m)]
+            if not unguarded:
+                continue
+            # R1: inconsistently guarded within the class
+            if guarded:
+                for m in unguarded:
+                    emit(mod, m.node,
+                         f"{model.name}.{attr} is mutated under "
+                         f"self.{sorted(model.locks)[0]} elsewhere but "
+                         f"without the lock here ({m.method})")
+                continue
+            # R2: unguarded write on a thread entrypoint, attr shared
+            for m in unguarded:
+                on_thread = any(m.method == e or m.method.startswith(e + ".")
+                                for e in model.entrypoints)
+                other_methods = (model.reads.get(attr, set())
+                                 | {x.method for x in muts}) - {m.method}
+                other_methods -= set(init_syms)
+                if on_thread and other_methods:
+                    emit(mod, m.node,
+                         f"{model.name}.{attr} is written without the lock "
+                         f"on thread entrypoint {m.method} while "
+                         f"{sorted(other_methods)} also access it from "
+                         "other threads — lost updates possible")
+
+    # R3: cross-object mutation of an attribute its owner always guards
+    for mod in index.modules.values():
+        if not index.in_scope(mod.relpath, scope):
+            continue
+        for node in ast.walk(mod.tree):
+            attr = None
+            base = None
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    t = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                    if isinstance(t, ast.Attribute):
+                        attr, base = t.attr, t.value
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS \
+                    and isinstance(node.func.value, ast.Attribute):
+                attr = node.func.value.attr
+                base = node.func.value.value
+            if attr is None or attr not in guarded_attr_owner:
+                continue
+            # skip the owner's own accesses (self.<attr>)
+            if isinstance(base, ast.Name) and base.id == "self":
+                continue
+            owners = guarded_attr_owner[attr]
+            owner_names = sorted({m.name for m in owners})
+            emit(mod, node,
+                 f"direct mutation of {attr!r}, which "
+                 f"{'/'.join(owner_names)} only ever mutates under its "
+                 "lock — this bypasses the owning lock from outside the "
+                 "class")
+    return out

@@ -1,0 +1,141 @@
+"""recompile-hazard: compile caches that cannot hit.
+
+``jax.jit`` caches by (function identity, static args, arg shapes).
+Two ways this codebase has burned itself:
+
+1. **Fresh wrapper per iteration** — ``jax.jit(f)`` (or a jitted lambda)
+   created inside a loop, or created-and-immediately-called inside a
+   function body: every execution builds a new wrapper with an empty
+   cache, so every call retraces and recompiles.
+2. **Unbounded compile-key space** — a jitted callee fed a static
+   argument (or a Python scalar that jax hashes into the key) derived
+   from data sizes (``len(...)`` / ``.shape``) inside a loop: the key
+   space grows with the data instead of being bounded like the serve
+   ``BucketLadder`` bounds batch shapes.
+
+The checker flags jit-wrapper *creation* inside ``for``/``while`` bodies,
+immediate-invoke jits inside functions, and loop calls passing
+``len(..)``/``.shape``-derived values to known static argnames.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..engine import (Finding, JIT_WRAPPERS, PARTIAL_NAMES, RepoIndex,
+                      dotted, enclosing_loop, matches)
+
+HINT_FRESH = ("hoist the jax.jit() call out of the loop (bind it once at "
+              "module import / __init__ / first use and reuse the wrapper) "
+              "— a fresh wrapper has an empty compile cache, so every call "
+              "retraces")
+HINT_KEY = ("bound the static/key space the way serve's BucketLadder "
+            "bounds batch shapes (pad to pow2, clamp, or precompute the "
+            "distinct values); a len()/.shape-derived static arg makes the "
+            "number of compiled programs grow with the data")
+
+
+def _jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    if matches(d, JIT_WRAPPERS):
+        return True
+    if matches(d, PARTIAL_NAMES) and node.args:
+        return matches(dotted(node.args[0]), JIT_WRAPPERS)
+    return False
+
+
+def _static_argnames(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = []
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    names.append(sub.value)
+            return tuple(names)
+    return ()
+
+
+def _size_derived(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and dotted(sub.func) == "len":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+    return False
+
+
+def _collect_jitted_statics(index: RepoIndex) -> Dict[str, Set[str]]:
+    """Map of callable name (bare or attr, e.g. ``_fused_round_fn`` or
+    ``_fn``) -> static argnames, from decorators and jit assignments."""
+    out: Dict[str, Set[str]] = {}
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _jit_call(dec):
+                        names = _static_argnames(dec)
+                        if names:
+                            out.setdefault(node.name, set()).update(names)
+            elif isinstance(node, ast.Assign) and _jit_call(node.value):
+                names = _static_argnames(node.value)
+                if not names:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, set()).update(names)
+                    elif isinstance(tgt, ast.Attribute):
+                        out.setdefault(tgt.attr, set()).update(names)
+    return out
+
+
+def check_recompile(index: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    statics = _collect_jitted_statics(index)
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _jit_call(node):
+                if enclosing_loop(node, mod.parents) is not None:
+                    out.append(mod.finding(
+                        "recompile-hazard", node,
+                        "jax.jit wrapper created inside a loop — a new "
+                        "wrapper (and empty compile cache) per iteration "
+                        "means every call retraces", HINT_FRESH))
+                    continue
+                parent = mod.parents.get(node)
+                if isinstance(parent, ast.Call) and parent.func is node \
+                        and mod.symbol_of(node) != "<module>":
+                    out.append(mod.finding(
+                        "recompile-hazard", node,
+                        "jax.jit(...)(...) created and immediately called "
+                        "— the wrapper (and its compile cache) is thrown "
+                        "away after one call, so every execution of this "
+                        "statement recompiles", HINT_FRESH))
+                continue
+            # loop call feeding size-derived values into static argnames
+            loop = enclosing_loop(node, mod.parents)
+            if loop is None:
+                continue
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            known = statics.get(callee or "", ())
+            if not known:
+                continue
+            for kw in node.keywords:
+                if kw.arg in known and _size_derived(kw.value):
+                    out.append(mod.finding(
+                        "recompile-hazard", node,
+                        f"jitted callee {callee!r} is fed the size-derived "
+                        f"static arg {kw.arg!r} inside a loop — the "
+                        "compile-key space grows with the data",
+                        HINT_KEY))
+                    break
+    return out

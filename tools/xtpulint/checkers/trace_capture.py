@@ -1,0 +1,54 @@
+"""trace-capture: environment reads baked into traced programs.
+
+The PR-5 bug class: ``XTPU_NAN_POLICY`` was consulted at trace time, so a
+jit-cached program compiled under one policy silently served another. Any
+``os.environ`` / ``os.getenv`` read executed while jax is tracing is
+captured as a CONSTANT in the compiled program — changing the variable
+later does nothing until an unrelated retrace, which is the worst kind of
+staleness (nondeterministic, cache-shaped).
+
+Flagged: an env read lexically inside a traced region (a function handed
+to ``jax.jit`` / ``shard_map`` / ``pallas_call`` / ``lax.scan`` / ...), or
+inside any function reachable from one through the call graph.
+
+Fix pattern (core.py ``nan_policy``): read the variable OUTSIDE the trace,
+pass the value in as an argument — as a ``static_argnames`` entry when it
+changes the program structure, so the compile-cache key carries it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, RepoIndex, is_env_read
+
+HINT = ("read the env var outside the traced region and pass the value in "
+        "as an argument (static_argnames if it changes program structure) "
+        "so the compile-cache key carries it — the XTPU_NAN_POLICY fix "
+        "pattern (docs/static_analysis.md)")
+
+
+def check_trace_capture(index: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in index.modules.values():
+        for info in mod.functions.values():
+            if info.qualname not in index.traced_reachable:
+                continue
+            for node in ast.walk(info.node):
+                if mod.symbol_of(node) != info.symbol:
+                    continue
+                hit = is_env_read(node)
+                if hit is None:
+                    continue
+                _, var, _ = hit
+                what = f"env var {var!r}" if var else "an env var"
+                via = ("traced function" if info.traced
+                       else "function reachable from a traced region")
+                out.append(mod.finding(
+                    "trace-capture", node,
+                    f"{what} is read inside a {via}: the value is baked "
+                    "into the compiled program at trace time and later "
+                    "changes are silently ignored by cached executables",
+                    HINT))
+    return out

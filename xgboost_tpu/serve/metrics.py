@@ -103,6 +103,13 @@ class ServeMetrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + by
 
+    def set(self, name: str, value: int) -> None:
+        """Overwrite a gauge-style counter (e.g. ``recompiles``) under the
+        same lock that :meth:`inc`/:meth:`snapshot` hold — a bare
+        ``metrics.counters[k] = v`` from another thread races them."""
+        with self._lock:
+            self.counters[name] = value
+
     def hit_bucket(self, size: int, padded_rows: int) -> None:
         with self._lock:
             self.bucket_hits[size] = self.bucket_hits.get(size, 0) + 1

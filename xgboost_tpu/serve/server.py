@@ -289,7 +289,7 @@ class Server:
             if now < self._next_log:
                 return
             self._next_log = now + self.config.log_every_s
-        self.metrics.counters["recompiles"] = self.recompiles_after_warmup
+        self.metrics.set("recompiles", self.recompiles_after_warmup)
         logger.info(self.metrics.report_line(
             {"queue_rows": self.batcher.queue_depth_rows(),
              "models": len(self.registry.models())}))

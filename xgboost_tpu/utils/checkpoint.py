@@ -346,7 +346,8 @@ class SnapshotWriter:
                 if keep is not None:
                     prune_snapshots(directory, keep, name)
             except BaseException as e:  # noqa: BLE001 - surfaced at flush
-                self.last_error = e
+                with self._lock:
+                    self.last_error = e
                 logger.warning("background snapshot write failed: %s", e)
 
         with self._lock:
@@ -358,10 +359,12 @@ class SnapshotWriter:
             pending, self._pending = self._pending, []
         for f in pending:
             f.result()
-        if raise_errors and self.last_error is not None:
-            err, self.last_error = self.last_error, None
-            raise SnapshotError(
-                f"a background snapshot write failed: {err}") from err
+        if raise_errors:
+            with self._lock:
+                err, self.last_error = self.last_error, None
+            if err is not None:
+                raise SnapshotError(
+                    f"a background snapshot write failed: {err}") from err
 
     def close(self, raise_errors: bool = False) -> None:
         """Flush pending writes and JOIN the worker thread. Always safe to
