@@ -1,0 +1,34 @@
+"""Known-bad twin: donation misuse on the scan formulation's per-level
+sort buffers.
+
+The segmented-scan build re-sorts rows every level, so the natural
+optimisation is donating the previous level's permutation / sorted-gather
+buffers to the next level's call (they are dead the moment the new order
+exists). Donating WITHOUT rebinding in the level loop leaves the Python
+name pointing at a destroyed buffer on the second iteration — the exact
+shape the r12 scan wiring must avoid (tree/grow.py rebinds positions from
+the boundary sweep's own return).
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3,))
+def level_sort_step(perm, positions, gpair, n_level):
+    order = jax.numpy.argsort(positions, stable=True)
+    return order, 2 * positions + 1, gpair.sum()
+
+
+def scan_levels_no_rebind(perm, positions, gpair, depth):
+    total = 0.0
+    for d in range(depth):
+        _, _, s = level_sort_step(perm, positions, gpair, 2 ** d)  # LINT[donation-misuse]
+        total += s
+    return total
+
+
+def scan_level_use_after_donate(perm, positions, gpair):
+    new_perm, new_pos, s = level_sort_step(perm, positions, gpair, 1)
+    return new_perm, positions + 1  # LINT[donation-misuse]

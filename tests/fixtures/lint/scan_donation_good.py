@@ -1,0 +1,25 @@
+"""Known-good twin: the scan per-level sort buffers donated AND rebound.
+
+The r12 idiom (tree/grow.py): the boundary sweep's own assignment rebinds
+every donated slot — the permutation and positions names always point at
+the buffers the call returned, so the level loop never touches a
+destroyed input.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3,))
+def level_sort_step(perm, positions, gpair, n_level):
+    order = jax.numpy.argsort(positions, stable=True)
+    return order, 2 * positions + 1, gpair.sum()
+
+
+def scan_levels_rebound(perm, positions, gpair, depth):
+    total = 0.0
+    for d in range(depth):
+        perm, positions, s = level_sort_step(perm, positions, gpair, 2 ** d)
+        total += s
+    return total

@@ -1,0 +1,417 @@
+"""Segmented-scan histogram formulation parity (hist_method="scan", r12).
+
+The scan scheme REORDERS the rows feeding the two-level histogram: a
+stable counting sort by level node id (``ops/partition.py
+counting_sort_by_node``) turns every (node, feature, bin) segment into a
+contiguous run, the level's FULL fine histogram streams as sorted
+segment sums, and the coarse slots / refine window fall out of the one
+build (``ops/histogram.py scan_level_hists``; on TPU the Pallas kernel
+folds coarse from the fine INTEGER accumulators by integral slice-diffs
+— ``ops/pallas/histogram.py scan_hist_pallas``). The contract mirrors
+the round-6 fused promotion and is pinned at three altitudes:
+
+- kernel:   ``scan_hist_pallas(interpret=True)`` — EXACT in the
+            quantised integer domain (the int32 accumulators recover the
+            ground-truth integer sums to the 0.5 rounding quantum) and
+            within fixed-point tolerance of the f32 segment build. NOT
+            asserted bitwise against a hand-built float reference: under
+            jit XLA reassociates the dequant multiply chain
+            (``x * (1/(32512/m))`` -> ``x * m * (1/32512)``), one ulp
+            off any numpy-built reference — docs/performance.md r12;
+- op:       ``build_hist_scan`` / ``scan_level_hists`` on the XLA path
+            against the unsorted ``build_hist_segment`` — BITWISE (the
+            stable sort preserves within-segment row order and
+            ``segment_sum`` accumulates in operand order);
+- model:    trains with hist_method 'scan' vs 'fused' — resident
+            depthwise (+missing), lossguide, paged external memory,
+            mesh row split, mesh col split x lossguide — identical
+            dumps and predictions (the same grid test_fused_hist.py
+            runs, one method pair over).
+
+Plus the split-accumulator satellite: the bf16 head + f32 residual
+fix-up build must beat raw bf16 accumulation and stay within a pinned
+bound of exact f32 — while acc='f32' stays bitwise.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import xgboost_tpu as xgb
+from xgboost_tpu.ops.histogram import (_segment_hist_acc, build_hist,
+                                       build_hist_scan, build_hist_segment,
+                                       scan_advance_level, scan_level_hists)
+from xgboost_tpu.ops.pallas.histogram import scan_hist_pallas
+from xgboost_tpu.ops.partition import counting_sort_by_node
+from xgboost_tpu.ops.split import COARSE_B, coarse_bin_ids
+
+
+def _rows(n, F, max_nbins, n_nodes, seed=0, empty_node=None):
+    """Random level rows with ~10% strays; optionally one empty node."""
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, max_nbins, (n, F)).astype(np.uint8)
+    gpair = rng.randn(n, 2).astype(np.float32)
+    gpair[:, 1] = np.abs(gpair[:, 1])
+    rel = rng.randint(0, n_nodes, n).astype(np.int32)
+    rel[rng.rand(n) < 0.1] = n_nodes  # strays
+    if empty_node is not None:
+        rel[rel == empty_node] = n_nodes
+    return jnp.asarray(bins), jnp.asarray(gpair), jnp.asarray(rel)
+
+
+# ---- kernel: Pallas interpret mode --------------------------------------
+
+def _int8x2_ground_truth(gpair, bins, rel, n_nodes, max_nbins):
+    """The kernel's own quantisation replayed in numpy — in f32, exactly
+    as the wrapper computes it (IEEE multiply + round-half-even are
+    deterministic, so q matches bit for bit). Returns (int64 per-bucket
+    q sums, scale [2] f32)."""
+    g = np.asarray(gpair, np.float32)
+    absmax = np.maximum(np.abs(g).max(axis=0), np.float32(1e-30))
+    scale = (np.float32(32512.0) / absmax).astype(np.float32)
+    q = np.rint(g * scale[None, :]).astype(np.int64)
+    sums = np.zeros((n_nodes, bins.shape[1], max_nbins, 2), np.int64)
+    b = np.asarray(bins)
+    r = np.asarray(rel)
+    for i in range(len(r)):
+        if r[i] < n_nodes:
+            for f in range(bins.shape[1]):
+                sums[r[i], f, b[i, f]] += q[i]
+    return sums, scale
+
+
+@pytest.mark.parametrize("n,n_nodes,empty", [(1500, 4, None), (900, 5, 2)])
+def test_scan_pallas_interpret_integer_exact(n, n_nodes, empty):
+    F, max_nbins = 5, 64
+    missing_bin = max_nbins - 1
+    bins, gpair, rel = _rows(n, F, max_nbins, n_nodes, seed=n,
+                             empty_node=empty)
+    fine, coarse = scan_hist_pallas(bins.T, gpair, rel, n_nodes, max_nbins,
+                                    missing_bin=missing_bin,
+                                    with_coarse=True, block_rows=256,
+                                    interpret=True)
+    assert fine.shape == (n_nodes, F, max_nbins, 2)
+    assert coarse.shape == (n_nodes, F, COARSE_B, 2)
+
+    # EXACT in the integer domain: dequantised output x scale lands on
+    # the ground-truth int sums within the 0.5 rounding quantum (plus an
+    # ulp allowance for the scale product itself)
+    qsums, scale = _int8x2_ground_truth(gpair, bins, rel, n_nodes,
+                                        max_nbins)
+    recov = np.asarray(fine, np.float64) * scale
+    tol = 0.5 + 1e-6 * np.abs(qsums)
+    assert np.all(np.abs(recov - qsums) <= tol)
+
+    # empty node rows are zero-initialised by their min-one-block visit,
+    # never left as garbage
+    if empty is not None:
+        assert np.all(np.asarray(fine)[empty] == 0)
+        assert np.all(np.asarray(coarse)[empty] == 0)
+
+    # fixed-point tolerance vs the exact f32 segment build (bitwise float
+    # equality vs a numpy reference is NOT the contract — XLA legally
+    # reassociates the dequant multiply chain under jit)
+    ref = np.asarray(build_hist_segment(bins, gpair, rel, n_nodes,
+                                        max_nbins))
+    s = max(float(np.abs(ref).max()), 1.0)
+    np.testing.assert_allclose(np.asarray(fine) / s, ref / s,
+                               rtol=2e-3, atol=2e-3)
+
+    # coarse = integral slice-diffs over the SAME integer accumulators:
+    # exact per-slot match with the coarse-key ground truth, integer side
+    cb = coarse_bin_ids(bins.astype(jnp.int32), missing_bin)
+    cref = np.asarray(build_hist_segment(cb, gpair, rel, n_nodes,
+                                         COARSE_B))
+    sc = max(float(np.abs(cref).max()), 1.0)
+    np.testing.assert_allclose(np.asarray(coarse) / sc, cref / sc,
+                               rtol=2e-3, atol=2e-3)
+
+
+# ---- op: XLA path is bitwise --------------------------------------------
+
+@pytest.mark.parametrize("n,F,max_nbins,n_nodes",
+                         [(3000, 6, 64, 4), (999, 3, 128, 5), (512, 8, 32, 1)])
+def test_scan_op_bitwise_vs_segment(n, F, max_nbins, n_nodes):
+    bins, gpair, rel = _rows(n, F, max_nbins, n_nodes, seed=n_nodes)
+    ref = build_hist_segment(bins, gpair, rel, n_nodes, max_nbins)
+    out = build_hist_scan(bins, gpair, rel, n_nodes, max_nbins)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # and through the build_hist dispatcher
+    out2 = build_hist(bins, gpair, rel, n_nodes, max_nbins, method="scan")
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+
+
+def test_scan_level_hists_bitwise_fine_and_coarse():
+    n, F, max_nbins, n_level = 2500, 5, 64, 4
+    missing_bin = max_nbins - 1
+    bins, gpair, rel = _rows(n, F, max_nbins, n_level, seed=21)
+    fine, coarse = scan_level_hists(bins, gpair, rel, n_level, max_nbins,
+                                    missing_bin)
+    np.testing.assert_array_equal(
+        np.asarray(fine),
+        np.asarray(build_hist_segment(bins, gpair, rel, n_level,
+                                      max_nbins)))
+    cb = coarse_bin_ids(bins.astype(jnp.int32), missing_bin)
+    np.testing.assert_array_equal(
+        np.asarray(coarse),
+        np.asarray(build_hist_segment(cb, gpair, rel, n_level, COARSE_B)))
+
+
+def test_scan_advance_level_matches_sequential():
+    """The boundary sweep: same advance ops as fused (bit-identical
+    positions), then the level's builds — bitwise vs the segment refs."""
+    from xgboost_tpu.ops.partition import advance_positions_level
+
+    n, F, max_nbins = 1200, 5, 32
+    missing_bin = max_nbins - 1
+    n_prev, lo_prev, n_level, lo = 2, 1, 4, 3
+    rng = np.random.RandomState(9)
+    bins = jnp.asarray(rng.randint(0, max_nbins, (n, F)).astype(np.uint8))
+    gpair = jnp.asarray(np.abs(rng.randn(n, 2)).astype(np.float32))
+    positions = jnp.asarray(
+        rng.randint(lo_prev, lo_prev + n_prev, n).astype(np.int32))
+    feat = jnp.asarray(rng.randint(0, F, n_prev).astype(np.int32))
+    thr = jnp.asarray(rng.randint(0, max_nbins - 1, n_prev).astype(np.int32))
+    dleft = jnp.asarray(rng.rand(n_prev) < 0.5)
+    cs = jnp.asarray(np.ones(n_prev, bool))
+    prev = {"kind": "dense", "lo": lo_prev, "n_level": n_prev,
+            "arrs": (feat, thr, dleft, cs)}
+    pos_s, fine, coarse = scan_advance_level(
+        bins, gpair, positions, prev, lo, n_level, missing_bin,
+        max_nbins=max_nbins)
+    rel_prev = jnp.where(
+        (positions >= lo_prev) & (positions < lo_prev + n_prev),
+        positions - lo_prev, n_prev).astype(jnp.int32)
+    pos_ref = advance_positions_level(bins.astype(jnp.float32), positions,
+                                      rel_prev, feat, thr, dleft, cs,
+                                      missing_bin)
+    np.testing.assert_array_equal(np.asarray(pos_s), np.asarray(pos_ref))
+    rel = jnp.where((pos_ref >= lo) & (pos_ref < lo + n_level),
+                    pos_ref - lo, n_level).astype(jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(fine),
+        np.asarray(build_hist_segment(bins, gpair, rel, n_level,
+                                      max_nbins)))
+    cb = coarse_bin_ids(bins.astype(jnp.int32), missing_bin)
+    np.testing.assert_array_equal(
+        np.asarray(coarse),
+        np.asarray(build_hist_segment(cb, gpair, rel, n_level, COARSE_B)))
+
+
+# ---- counting sort layout ------------------------------------------------
+
+@pytest.mark.parametrize("n,n_nodes,R", [(5000, 8, 256), (100, 3, 128),
+                                         (999, 5, 128), (640, 1, 128)])
+def test_counting_sort_block_layout(n, n_nodes, R):
+    rng = np.random.RandomState(n_nodes)
+    rel = rng.randint(0, n_nodes + 1, n).astype(np.int32)
+    perm, block_node = counting_sort_by_node(jnp.asarray(rel), n_nodes,
+                                             block=R)
+    perm = np.asarray(perm)
+    block_node = np.asarray(block_node)
+    cap = perm.shape[0]
+    assert cap % R == 0 and block_node.shape[0] == cap // R
+    # every block holds rows of exactly its named node; pad slots carry
+    # the sentinel row id n
+    for b in range(cap // R):
+        rows = perm[b * R:(b + 1) * R]
+        real = rows[rows < n]
+        if block_node[b] < n_nodes:
+            assert np.all(rel[real] == block_node[b])
+        else:
+            assert real.size == 0 or np.all(rel[real] >= n_nodes)
+    # every in-level row appears exactly once; strays are dropped
+    real_all = np.sort(perm[perm < n])
+    expect = np.sort(np.nonzero(rel < n_nodes)[0])
+    np.testing.assert_array_equal(real_all, expect)
+    # every node owns >= 1 block (empty nodes still get zero-init visits)
+    for k in range(n_nodes):
+        assert np.any(block_node == k)
+    # stability: within each node the original row order is preserved
+    for k in range(n_nodes):
+        rows = perm[np.repeat(block_node, R) == k]
+        rows = rows[rows < n]
+        assert np.all(np.diff(rows) > 0)
+
+
+def test_counting_sort_order_is_stable_identity_for_one_node():
+    """n_nodes=1 (the root level): all real keys are equal, so the stable
+    sort is the identity — the op skips the sort outright (this is also
+    what keeps shard_map's replication checker off the constant-input
+    sort primitive at the root, ops/partition.py)."""
+    rel = jnp.asarray(np.array([0, 1, 0, 0, 1, 0], np.int32))
+    order = np.asarray(counting_sort_by_node(rel, 1))
+    np.testing.assert_array_equal(order, np.arange(6))
+
+
+# ---- split accumulators (bf16 head + f32 fix-up) ------------------------
+
+def test_scan_bf16_fixup_beats_raw_bf16():
+    n, F, max_nbins, n_nodes = 20000, 4, 64, 4
+    bins, gpair, rel = _rows(n, F, max_nbins, n_nodes, seed=3)
+    exact = np.asarray(build_hist_segment(bins, gpair, rel, n_nodes,
+                                          max_nbins), np.float64)
+    fix = np.asarray(_segment_hist_acc(bins, gpair, rel, n_nodes,
+                                       max_nbins, "bf16"), np.float64)
+    # raw bf16: accumulate the bf16-cast gpair with no residual pass
+    stride = F * max_nbins
+    seg = (rel.astype(jnp.int32)[:, None] * stride
+           + jnp.arange(F, dtype=jnp.int32)[None, :] * max_nbins
+           + bins.astype(jnp.int32)).reshape(-1)
+    raw = jax.ops.segment_sum(
+        jnp.broadcast_to(gpair.astype(jnp.bfloat16)[:, None, :],
+                         (n, F, 2)).reshape(-1, 2),
+        seg, num_segments=(n_nodes + 1) * stride)
+    raw = np.asarray(raw.astype(jnp.float32), np.float64)[
+        :n_nodes * stride].reshape(exact.shape)
+    scale = max(np.abs(exact).max(), 1.0)
+    # the f32 residual pass removes the REPRESENTATION error while the
+    # bf16 accumulation rounding remains in the head sum — and raw bf16
+    # shares that exact head, so the win is the residual term: compare in
+    # RMS (where the independent error terms add in quadrature), not max
+    # (a single bucket's accumulation noise can mask it); the absolute
+    # bound is a measured-class constant, not f32 eps
+    # (docs/performance.md r12)
+    rms_fix = np.sqrt(np.mean((fix - exact) ** 2)) / scale
+    rms_raw = np.sqrt(np.mean((raw - exact) ** 2)) / scale
+    assert rms_fix < rms_raw, (rms_fix, rms_raw)
+    assert np.abs(fix - exact).max() / scale < 0.05
+    # while acc='f32' IS the segment build, bitwise
+    np.testing.assert_array_equal(
+        np.asarray(_segment_hist_acc(bins, gpair, rel, n_nodes, max_nbins,
+                                     "f32")),
+        np.asarray(build_hist_segment(bins, gpair, rel, n_nodes,
+                                      max_nbins)))
+
+
+def test_scan_acc_env_validated_and_trains(monkeypatch):
+    X, y = _binary_data(n=1200, F=5, seed=31)
+    monkeypatch.setenv("XTPU_SCAN_ACC", "bf16")
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "max_bin": 64, "hist_method": "scan"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    p = bst.predict(xgb.DMatrix(X))
+    assert np.all(np.isfinite(p))
+    monkeypatch.setenv("XTPU_SCAN_ACC", "f16")  # not a valid accumulator
+    with pytest.raises(ValueError):
+        xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                   "hist_method": "scan"}, xgb.DMatrix(X, label=y), 1,
+                  verbose_eval=False)
+
+
+# ---- model: scan vs fused, the full tier grid ---------------------------
+
+def _binary_data(n=4000, F=8, missing=False, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(F) > 0).astype(np.float32)
+    if missing:
+        X[rng.rand(n, F) < 0.1] = np.nan
+    return X, y
+
+
+@pytest.mark.parametrize("missing", [False, True])
+def test_scan_train_depthwise_matches_fused(missing):
+    X, y = _binary_data(missing=missing)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 256,
+              "max_depth": 5}
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    b_s = xgb.train({**params, "hist_method": "scan"},
+                    xgb.DMatrix(X, label=y), 4, verbose_eval=False)
+    assert b_s.get_dump(with_stats=True) == b_f.get_dump(with_stats=True)
+
+
+def test_scan_train_lossguide_matches_fused():
+    X, y = _binary_data(n=3000, F=6, seed=12)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 10, "max_depth": 0}
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b_s = xgb.train({**params, "hist_method": "scan"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert b_s.get_dump(with_stats=True) == b_f.get_dump(with_stats=True)
+
+
+def test_scan_train_paged_matches_fused(tmp_path, monkeypatch):
+    """Paged external memory: 'scan' maps onto the page-major two-level
+    schedule (tree/paged.py) — the page pass already IS the integral-
+    histogram half of the formulation, so routing is trivially
+    bit-identical."""
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    X, y = _binary_data(n=3000, F=5, seed=13)
+
+    def make_dm():
+        class It(DataIter):
+            def __init__(self):
+                super().__init__()
+                self.parts = np.array_split(np.arange(len(X)), 3)
+                self.i = 0
+
+            def next(self, input_data):
+                if self.i >= len(self.parts):
+                    return 0
+                idx = self.parts[self.i]
+                input_data(data=X[idx], label=y[idx])
+                self.i += 1
+                return 1
+
+            def reset(self):
+                self.i = 0
+
+        it = It()
+        it.cache_prefix = str(tmp_path / "pc")
+        return xgb.QuantileDMatrix(it, max_bin=64)
+
+    monkeypatch.setenv("XTPU_PAGE_ROWS", "1024")
+    monkeypatch.setenv("XTPU_PAGED_COLLAPSE", "0")  # stay on page kernels
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "max_depth": 4}
+    b_f = xgb.train({**params, "hist_method": "fused"}, make_dm(), 3,
+                    verbose_eval=False)
+    b_s = xgb.train({**params, "hist_method": "scan"}, make_dm(), 3,
+                    verbose_eval=False)
+    assert b_s.get_dump(with_stats=True) == b_f.get_dump(with_stats=True)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (virtual) platform")
+    return xgb.make_data_mesh()
+
+
+def test_scan_mesh_row_split_matches_fused(mesh):
+    X, y = _binary_data(n=4096, F=6, seed=14)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 256,
+              "max_depth": 4, "mesh": mesh}
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b_s = xgb.train({**params, "hist_method": "scan"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert b_s.get_dump(with_stats=True) == b_f.get_dump(with_stats=True)
+
+
+def test_scan_mesh_col_split_lossguide_matches_fused(mesh):
+    X, y = _binary_data(n=3000, F=6, seed=15)
+    params = {"objective": "binary:logistic", "eta": 0.3, "max_bin": 64,
+              "grow_policy": "lossguide", "max_leaves": 8, "max_depth": 0,
+              "mesh": mesh, "data_split_mode": "col"}
+    b_f = xgb.train({**params, "hist_method": "fused"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    b_s = xgb.train({**params, "hist_method": "scan"},
+                    xgb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert b_s.get_dump(with_stats=True) == b_f.get_dump(with_stats=True)
+
+
+def test_scan_rejected_outside_hist_scalar():
+    X, y = _binary_data(n=400, F=4, seed=16)
+    dm = xgb.DMatrix(X, label=y)
+    with pytest.raises(NotImplementedError):
+        xgb.train({"objective": "binary:logistic", "tree_method": "approx",
+                   "hist_method": "scan"}, dm, 1, verbose_eval=False)

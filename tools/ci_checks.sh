@@ -18,6 +18,9 @@ python -m tools.xtpulint || exit $?
 
 [ "$1" = "--lint" ] && exit 0
 
+echo "== validate_scan (scan vs fused bit-parity grid, smoke scale) =="
+JAX_PLATFORMS=cpu python tools/validate_scan.py --scale 0.25 --seeds 1 || exit $?
+
 echo "== tier-1 tests =="
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

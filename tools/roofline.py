@@ -14,7 +14,20 @@ BOTH schedules:
 - ``fused``   (round 6): the advance and the NEXT level's coarse
   accumulation share one sweep (``ops/histogram.py
   fused_advance_coarse``), and the f32 copy / coarse-id copy are
-  computed in-trace — ~2 sweeps/level, ~1 at the boundary.
+  computed in-trace — ~2 sweeps/level, ~1 at the boundary;
+- ``scan``    (round 12): rows are counting-sorted by level node id
+  (ops/partition.py counting_sort_by_node) so every VMEM block feeds
+  exactly ONE node — the histogram contraction loses its x N node
+  factor and the PT4 node-scatter disappears — and the level builds the
+  FULL fine histogram once; the integral (prefix-summed) fine makes the
+  coarse slots and the refine window O(1) slice-diffs instead of a
+  second sweep. One advance+sort+fine sweep per level + the epilogue
+  advance: 7 passes vs fused's 13. The trade is explicit below: scan
+  STREAMS more (the bin matrix ~3x per level for the sorted gather) and
+  is VPU-bound on the factorised nibble one-hot, so its stream floor is
+  HIGHER than fused's — the win is that at the repo's measured per-pass
+  fixed overhead (the r5 finding that passes are overhead-bound) six
+  fewer passes buy more than the floor gives up.
 
 Peaks and their provenance:
 
@@ -48,6 +61,17 @@ REFINE_B = 36            # WINDOW + 4 pad slots
 SWAR_OPS_PER_ELEM = 1.75  # packed SWAR one-hot build (docs r3)
 SCATTER_OPS_PER_ELEM = 3.0  # PT4 node-scatter: select + 2 byte-plane ops
 
+# ---- scan-formulation constants (ops/pallas/histogram.py) ---------------
+FINE_B = 256             # full fine slots built per level (max_bin)
+NIBBLE_SLOTS = 32        # factorised one-hot: two 16-wide nibble one-hots
+# effective VPU element-ops per (row, feature, nibble slot): SWAR build
+# (1.75) + recombine/accumulate of the outer-product into the fine row
+# (~2) — calibrated against the r2/r3 measured one-hot rate the VPU_OPS
+# ceiling comes from, so the fine build floor scales from a MEASURED
+# point, not a guess
+FINE_NIBBLE_OPS = 3.75
+MXU_SUBLANES = 8         # q^T [4, R] x onehot [R, B] pads M=4 -> 8
+
 
 def pass_cost(n, F, B, n_nodes, *, gpair_bytes, pos_rw, advance=False,
               f32_bins=False):
@@ -75,12 +99,54 @@ def pass_cost(n, F, B, n_nodes, *, gpair_bytes, pos_rw, advance=False,
                          ("vpu", t_vpu), key=lambda kv: kv[1])[0]}
 
 
-def schedule(n, F, depth, fused):
+def scan_pass_cost(n, F, n_nodes, *, advance, block_rows=2048):
+    """One scan-formulation level sweep: counting-sort + sorted gather +
+    single-node-block fine build (+ the fused-in advance below the
+    previous level). The sort/gather streams the bin matrix twice on top
+    of the sweep read (3x total) plus the quantised gpair permute and the
+    per-block partial rows; the contraction is ``q^T [4, R] x onehot
+    [R, FINE_B]`` per feature — full 256-lane output, M padded to 8
+    sublanes, and NO x n_nodes factor (each block holds one node's rows);
+    the one-hot is the factorised nibble build on the VPU (the binding
+    resource at 11M x 28)."""
+    # gather read + permuted write + sweep read of the bin matrix; perm /
+    # rel / positions words; quantised gpair permute r/w (8 B/row each way)
+    bytes_ = 3 * n * F + 36 * n
+    # per-block [F, FINE_B, 4] int32 partials spilled for the look-back
+    n_blocks = -(-n // block_rows) + n_nodes
+    bytes_ += n_blocks * F * FINE_B * 4 * 4
+    mxu = 2.0 * MXU_SUBLANES * FINE_B * F * n
+    vpu = FINE_NIBBLE_OPS * F * NIBBLE_SLOTS * n
+    if advance:
+        mxu += 2.0 * F * n_nodes * n
+        vpu += 6.0 * n_nodes * n
+    t_hbm = bytes_ / HBM_BPS
+    t_mxu = mxu / MXU_INT8_OPS
+    t_vpu = vpu / VPU_OPS
+    return {"bytes": bytes_, "mxu": mxu, "vpu": vpu, "t_hbm": t_hbm,
+            "t_mxu": t_mxu, "t_vpu": t_vpu,
+            "floor": max(t_hbm, t_mxu, t_vpu),
+            "bound": max(("hbm", t_hbm), ("mxu", t_mxu),
+                         ("vpu", t_vpu), key=lambda kv: kv[1])[0]}
+
+
+def schedule(n, F, depth, mode):
     """Per-level pass list for one round. gpair streams as the int8x2
     kernel's quantised [2, n] int32 planes (8 bytes/row); positions are
     int32 (read every pass, written by advances)."""
+    fused = mode == "fused"
     gp = 8 * n
     levels = []
+    if mode == "scan":
+        for d in range(depth):
+            N = 2 ** d
+            levels.append((d, N, {
+                "sort+fine" if d == 0 else "adv+sort+fine":
+                    scan_pass_cost(n, F, N, advance=d > 0)}))
+        levels.append((depth, 2 ** depth, {
+            "advance": pass_cost(n, F, 0, 2 ** depth, gpair_bytes=0,
+                                 pos_rw=2, advance=True)}))
+        return levels
     for d in range(depth):
         N = 2 ** d
         passes = {}
@@ -127,8 +193,8 @@ def main():
     n, F, depth = args.rows, args.features, args.depth
 
     out = {}
-    for name, fused in (("twopass", False), ("fused", True)):
-        levels = schedule(n, F, depth, fused)
+    for name in ("twopass", "fused", "scan"):
+        levels = schedule(n, F, depth, name)
         print(f"\n### {name} schedule — per-level floors at "
               f"{n / 1e6:.0f}M x {F}, depth {depth}\n")
         print("| level (N) | pass | bytes | MXU int8 ops | VPU el-ops | "
@@ -168,18 +234,59 @@ def main():
     # overhead-bound, not stream-bound). Charging that residual per pass
     # predicts what the fused schedule should measure: fewer passes carry
     # fewer overheads on top of a smaller floor.
-    tp, fu = out["twopass"], out["fused"]
+    tp, fu, sc = out["twopass"], out["fused"], out["scan"]
     overhead_per_pass = max(
         0.0, (args.measured_ms - tp["floor_ms"]) / tp["passes"])
     pred = fu["floor_ms"] + fu["passes"] * overhead_per_pass
+    pred_scan = sc["floor_ms"] + sc["passes"] * overhead_per_pass
     print(f"\nper-pass fixed overhead implied by the twopass measurement: "
           f"{overhead_per_pass:.2f} ms; predicted fused round "
           f"{pred:.1f} ms ({1000.0 / pred:.2f} r/s, "
           f"{1000.0 / pred / 8.0:.2f} of the 8 r/s target)")
+    print(f"predicted scan round {pred_scan:.1f} ms "
+          f"({1000.0 / pred_scan:.2f} r/s, "
+          f"{1000.0 / pred_scan / 8.0:.2f} of the 8 r/s target; "
+          f"{pred / pred_scan:.2f}x vs fused — a HIGHER stream floor "
+          f"bought back by {fu['passes'] - sc['passes']} fewer "
+          f"overhead-bound passes)")
     out["overhead_ms_per_pass"] = round(overhead_per_pass, 3)
     out["predicted_fused_ms"] = round(pred, 1)
     out["predicted_fused_rounds_per_sec"] = round(1000.0 / pred, 2)
+    out["predicted_scan_ms"] = round(pred_scan, 1)
+    out["predicted_scan_rounds_per_sec"] = round(1000.0 / pred_scan, 2)
+    out["scan_vs_fused_pred_speedup"] = round(pred / pred_scan, 3)
     out["measured_ms"] = args.measured_ms
+
+    # predicted winner per dataset shape: the scan win is overhead-
+    # arbitrage, so its margin scales inversely with how much of the
+    # round the floors occupy — widest on small shards (floor <<
+    # overhead, ~1.8x at 100k rows), thinnest where streaming dominates
+    # (~1.06x at 110M rows, where scan's 3x bin-matrix stream nearly
+    # cancels the six saved passes)
+    shapes = [("higgs11m", 11_000_000, 28, 6),
+              ("shard1375k", 1_375_000, 28, 6),
+              ("airline110m-ish", 110_000_000, 13, 6),
+              ("wide1m-f256", 1_000_000, 256, 6),
+              ("small100k", 100_000, 28, 6)]
+    print("\n### predicted winner per dataset shape "
+          f"(overhead {overhead_per_pass:.2f} ms/pass from the "
+          "higgs11m twopass measurement)\n")
+    print("| shape (n x F, depth) | twopass | fused | scan | winner |")
+    print("|---|---|---|---|---|")
+    out["shape_predictions"] = {}
+    for sname, sn, sF, sd in shapes:
+        preds = {}
+        for mode in ("twopass", "fused", "scan"):
+            fl = sum(c["floor"] for _, _, ps in schedule(sn, sF, sd, mode)
+                     for c in ps.values()) * 1e3
+            np_ = sum(len(ps) for _, _, ps in schedule(sn, sF, sd, mode))
+            preds[mode] = fl + np_ * overhead_per_pass
+        win = min(preds, key=preds.get)
+        print(f"| {sname} ({sn / 1e6:g}M x {sF}, d{sd}) | "
+              f"{preds['twopass']:.1f} ms | {preds['fused']:.1f} ms | "
+              f"{preds['scan']:.1f} ms | **{win}** |")
+        out["shape_predictions"][sname] = {
+            k: round(v, 1) for k, v in preds.items()} | {"winner": win}
     out["peaks"] = {"hbm_bps": HBM_BPS, "mxu_int8_ops": MXU_INT8_OPS,
                     "vpu_ops_measured_sustained": VPU_OPS}
     print("\n" + json.dumps(out))

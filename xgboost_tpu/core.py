@@ -437,13 +437,14 @@ class Booster:
                 "multi_output_tree does not support monotone constraints "
                 "or the dart booster (the reference rejects both for "
                 "vector-leaf trees)")
-        if self.learner_params.get("hist_method") in ("coarse", "fused") \
+        if self.learner_params.get("hist_method") in ("coarse", "fused",
+                                                      "scan") \
                 and (tm in ("approx", "exact")
                      or ms == "multi_output_tree"):
             raise NotImplementedError(
-                "hist_method='coarse'/'fused' supports the hist updaters "
-                "(depthwise or lossguide, resident or external-memory "
-                "depthwise) with scalar trees only")
+                "hist_method='coarse'/'fused'/'scan' supports the hist "
+                "updaters (depthwise or lossguide, resident or "
+                "external-memory depthwise) with scalar trees only")
         dsm = self.learner_params.get("data_split_mode", "row")
         if dsm not in ("row", "col"):
             raise ValueError(f"unknown data_split_mode: {dsm}")
@@ -480,7 +481,11 @@ class Booster:
         kwargs = dict(
             num_parallel_tree=int(self.learner_params.get(
                 "num_parallel_tree", 1)),
-            hist_method=self.learner_params.get("hist_method", "auto"),
+            # XTPU_HIST_METHOD overrides the default kernel selection for
+            # harness A/Bs without touching params (construction-time env
+            # read, docs/env_knobs.md); an explicit param always wins
+            hist_method=self.learner_params.get(
+                "hist_method", os.environ.get("XTPU_HIST_METHOD", "auto")),
             mesh=self.ctx.mesh, monotone=mono, constraint_sets=ics,
             tree_method=tm if tm in ("approx", "exact") else "hist",
             multi_strategy=ms, split_mode=dsm)

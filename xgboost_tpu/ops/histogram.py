@@ -63,6 +63,86 @@ def build_hist_segment(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarr
     return hist[: n_nodes * stride].reshape(n_nodes, F, max_nbins, 2)
 
 
+def _segment_hist_acc(bins: jnp.ndarray, gpair: jnp.ndarray,
+                      rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
+                      acc: str) -> jnp.ndarray:
+    """``build_hist_segment`` with a selectable accumulator dtype.
+
+    ``acc="f32"`` is the exact default. ``acc="bf16"`` is the
+    reduced-precision split accumulator (ISSUE 9 tentpole c): the gpair is
+    split into a bf16 head and an f32 residual, the head accumulates in
+    bf16 (the cheap partial-accumulation stream the TPU scan kernel would
+    keep in VMEM at half the footprint) and the residual's f32 segment
+    sum is the fix-up pass — the recombined result carries f32-class
+    error, not bf16-class (tests/test_scan_hist.py pins the bound).
+    Opt-in via ``XTPU_SCAN_ACC=bf16`` and NOT bit-compatible with the
+    fused path, which is why ``auto`` never selects it and the
+    tools/validate_scan.py promotion grid runs the default."""
+    if acc == "f32":
+        return build_hist_segment(bins, gpair, rel_pos, n_nodes, max_nbins)
+    if acc != "bf16":
+        raise ValueError(f"unknown scan accumulator {acc!r}")
+    head16 = gpair.astype(jnp.bfloat16)
+    resid = gpair - head16.astype(jnp.float32)
+    n, F = bins.shape
+    stride = F * max_nbins
+    seg = (rel_pos.astype(jnp.int32)[:, None] * stride
+           + jnp.arange(F, dtype=jnp.int32)[None, :] * max_nbins
+           + bins.astype(jnp.int32)).reshape(-1)
+    nseg = (n_nodes + 1) * stride
+    h_head = jax.ops.segment_sum(
+        jnp.broadcast_to(head16[:, None, :], (n, F, 2)).reshape(-1, 2),
+        seg, num_segments=nseg)                        # bf16 accumulation
+    h_fix = jax.ops.segment_sum(
+        jnp.broadcast_to(resid[:, None, :], (n, F, 2)).reshape(-1, 2),
+        seg, num_segments=nseg)                        # f32 fix-up
+    hist = h_head.astype(jnp.float32) + h_fix
+    return hist[: n_nodes * stride].reshape(n_nodes, F, max_nbins, 2)
+
+
+def build_hist_scan(bins: jnp.ndarray, gpair: jnp.ndarray,
+                    rel_pos: jnp.ndarray, n_nodes: int, max_nbins: int,
+                    *, bins_t: jnp.ndarray = None, order: jnp.ndarray = None,
+                    axis_name=None, acc: str = "f32") -> jnp.ndarray:
+    """Sort-based segmented-scan histogram (``hist_method="scan"``).
+
+    Rows are stably counting-sorted by node id
+    (``ops/partition.py counting_sort_by_node``) so every (node, feature,
+    bin) segment becomes a contiguous run, and the per-segment gpair sums
+    stream sequentially instead of scatter-adding at random offsets — on
+    TPU the block-padded layout feeds the per-node-block Pallas kernel
+    (``ops/pallas/histogram.py scan_hist_pallas``), whose one-hot
+    contraction loses the ``[4N, R]`` node-scatter plane entirely (the
+    block's node is static, so the PT operand is ``[4, R]`` — N-free).
+
+    BITWISE equal to ``build_hist_segment`` on the XLA path: the stable
+    sort preserves within-segment row order and ``segment_sum``
+    accumulates in operand order, so only the segment numbering moves
+    (tests/test_scan_hist.py).
+
+    ``order``: precomputed sort permutation (callers building several
+    histograms per level — fine + coarse — sort once).
+    ``acc``: accumulator dtype, see ``_segment_hist_acc``.
+    """
+    from .partition import counting_sort_by_node
+
+    if (jax.default_backend() == "tpu" and acc == "f32"
+            and n_nodes <= 128 and order is None):
+        from .pallas.histogram import scan_hist_pallas
+
+        if bins_t is None:
+            bins_t = bins.T
+        fine, _ = scan_hist_pallas(bins_t, gpair, rel_pos, n_nodes,
+                                   max_nbins, axis_name=axis_name)
+        return fine
+    if order is None:
+        order = counting_sort_by_node(rel_pos, n_nodes)
+    bins_s = jnp.take(bins, order, axis=0)
+    gp_s = jnp.take(gpair, order, axis=0)
+    rel_s = jnp.take(rel_pos, order)
+    return _segment_hist_acc(bins_s, gp_s, rel_s, n_nodes, max_nbins, acc)
+
+
 def build_hist_onehot(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
                       n_nodes: int, max_nbins: int,
                       block_rows: int = 1 << 16) -> jnp.ndarray:
@@ -203,6 +283,12 @@ def build_hist(bins: jnp.ndarray, gpair: jnp.ndarray, rel_pos: jnp.ndarray,
             "growers only (tree/grow.py resident, tree/paged.py external "
             "memory); this code path (lossguide / vector-leaf / vertical) "
             "does not support it")
+    if method == "scan":
+        # the sort-based segmented-scan build is a drop-in histogram
+        # formulation (unlike coarse/fused, which are SCHEDULES) — any
+        # caller may request it; bitwise equal to the default build
+        return build_hist_scan(bins, gpair, rel_pos, n_nodes, max_nbins,
+                               bins_t=bins_t, axis_name=axis_name)
     if method == "auto":
         backend = jax.default_backend()
         # The fused Pallas kernel accumulates [F_blk, max_nbins, 2*n_nodes]
@@ -357,6 +443,97 @@ def fused_advance_coarse(bins: jnp.ndarray, gpair: jnp.ndarray,
     hist = build_hist(cb, gpair, rel, n_level, COARSE_B, method=method,
                       bins_t=cb_t, axis_name=axis_name)
     return positions, hist
+
+
+# ---- segmented-scan level scheme (hist_method="scan") ----------------------
+# Round 12: the scan formulation sorts the level's rows by node once, then
+# derives EVERY histogram the two-level scheme needs from that one ordering:
+# the full fine histogram streams as contiguous segment sums (no per-node
+# scatter), the coarse histogram is the same sorted pass over coarse keys
+# (bitwise equal to the fused path's direct coarse build), and the refine
+# window is an O(1) slice of the fine build (ops/split.py refine_from_fine's
+# bit-equality argument) — the refine DATA pass disappears. On TPU the
+# Pallas kernel additionally derives coarse from the fine INTEGER
+# accumulators by integral slice-diffs (exact: integer addition is
+# associative), so one block-streamed pass yields both.
+
+def scan_level_hists(bins: jnp.ndarray, gpair: jnp.ndarray,
+                     rel: jnp.ndarray, n_level: int, max_nbins: int,
+                     missing_bin: int, *, bins_t: jnp.ndarray = None,
+                     method: str = "auto", axis_name=None,
+                     acc: str = "f32"):
+    """One sorted ordering -> ``(fine [N,F,max_nbins,2],
+    coarse [N,F,COARSE_B,2])`` for a level.
+
+    CPU/XLA: both builds are sorted segment sums — each bitwise equal to
+    its unsorted ``build_hist_segment`` counterpart, which is exactly what
+    the fused schedule builds, so models are bit-identical
+    (tools/validate_scan.py). The coarse histogram is built DIRECTLY from
+    coarse keys rather than folded from the f32 fine build: f32 addition
+    is not associative, so only the direct build preserves bit-parity —
+    the integral fold is reserved for the TPU kernel's integer domain.
+    """
+    from .partition import counting_sort_by_node
+    from .split import coarse_bin_ids
+
+    if (jax.default_backend() == "tpu" and acc == "f32"
+            and method in ("auto", "pallas") and n_level <= 128):
+        from .pallas.histogram import scan_hist_pallas
+
+        if bins_t is None:
+            bins_t = bins.T
+        return scan_hist_pallas(bins_t, gpair, rel, n_level, max_nbins,
+                                missing_bin=missing_bin,
+                                with_coarse=True, axis_name=axis_name)
+    order = counting_sort_by_node(rel, n_level)
+    bins_s = jnp.take(bins, order, axis=0)
+    gp_s = jnp.take(gpair, order, axis=0)
+    rel_s = jnp.take(rel, order)
+    fine = _segment_hist_acc(bins_s, gp_s, rel_s, n_level, max_nbins, acc)
+    cb_s = coarse_bin_ids(bins_s.astype(jnp.int32), missing_bin)
+    from .split import COARSE_B
+
+    coarse = _segment_hist_acc(cb_s, gp_s, rel_s, n_level, COARSE_B, acc)
+    return fine, coarse
+
+
+def scan_advance_level(bins: jnp.ndarray, gpair: jnp.ndarray,
+                       positions: jnp.ndarray, prev: dict, lo: int,
+                       n_level: int, missing_bin: int, *, max_nbins: int,
+                       bins_t: jnp.ndarray = None, method: str = "auto",
+                       axis_name=None, decision_axis=None,
+                       acc: str = "f32"):
+    """Scan-formulation boundary sweep: advance rows below the previous
+    level's decoded splits, then ONE sorted ordering of the new level
+    yields its fine + coarse histograms
+    (the scan counterpart of ``fused_advance_coarse`` — same advance ops,
+    so positions are bit-identical; the builds are sorted segment sums,
+    bit-equal to the fused schedule's. Returns
+    ``(positions, fine, coarse)``)."""
+    from .partition import advance_positions_level, update_positions
+
+    kind = prev["kind"]
+    lo_prev, nl_prev = prev["lo"], prev["n_level"]
+    if kind == "dense":
+        feat, thr, dleft, cs = prev["arrs"]
+        rel_prev = jnp.where(
+            (positions >= lo_prev) & (positions < lo_prev + nl_prev),
+            positions - lo_prev, nl_prev).astype(jnp.int32)
+        positions = advance_positions_level(
+            bins.astype(jnp.float32), positions, rel_prev, feat, thr,
+            dleft, cs, missing_bin, decision_axis=decision_axis)
+    else:
+        sf, sb, dl, isf = prev["arrs"]
+        positions = update_positions(
+            bins, positions, sf, sb, dl, isf, missing_bin,
+            decision_axis=decision_axis,
+            feat_offset=prev.get("feat_offset"))
+    rel = jnp.where((positions >= lo) & (positions < lo + n_level),
+                    positions - lo, n_level).astype(jnp.int32)
+    fine, coarse = scan_level_hists(
+        bins, gpair, rel, n_level, max_nbins, missing_bin, bins_t=bins_t,
+        method=method, axis_name=axis_name, acc=acc)
+    return positions, fine, coarse
 
 
 def subtract_siblings(parent_hist: jnp.ndarray, child_hist: jnp.ndarray,

@@ -19,6 +19,7 @@ like the broadcast seed at ``src/tree/updater_gpu_hist.cu:786-789``).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -28,6 +29,7 @@ import numpy as np
 from ..context import shard_map as _shard_map
 from ..ops.histogram import (build_hist, build_hist_prehot,
                              build_onehot_plane, fused_advance_coarse,
+                             scan_advance_level, scan_level_hists,
                              subtract_siblings)
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import CatInfo, evaluate_splits
@@ -89,6 +91,17 @@ def _sample_features(key: jax.Array, base_mask: jnp.ndarray,
 AUTO_COARSE_MIN_ROWS = 1 << 16
 AUTO_COARSE_MIN_BINS = 128
 
+# Round 12: wherever "auto" promotes to the fused coarse schedule it now
+# promotes one step further, to the segmented-scan formulation
+# (hist_method="scan", ops/histogram.py scan_level_hists) — same two-level
+# search space, bit-identical models (tools/validate_scan.py grid gates
+# this), 7 data passes per level instead of fused's 13
+# (docs/performance.md round-12 table). XTPU_SCAN_PROMOTE=0 demotes auto
+# back to fused — the escape hatch if a validate_scan run ever fails on
+# new hardware. Read once at import (construction time), never traced.
+AUTO_SCAN_PROMOTE = os.environ.get("XTPU_SCAN_PROMOTE", "1").lower() \
+    not in ("0", "false", "off")
+
 
 def auto_selects_coarse(n_rows: int, max_nbins: int, has_missing: bool, *,
                         numeric: bool, col_split: bool,
@@ -147,7 +160,7 @@ def exchange_best_split(res, axis_name, F: int, *, with_cat: bool = False):
 @functools.partial(
     jax.jit,
     static_argnames=("param", "max_nbins", "hist_method", "axis_name",
-                     "has_missing", "split_mode"))
+                     "has_missing", "split_mode", "scan_acc"))
 def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
           tree_mask: jnp.ndarray, key: jax.Array,
           monotone: Optional[jnp.ndarray] = None,
@@ -156,7 +169,7 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
           param: TrainParam, max_nbins: int, hist_method: str = "auto",
           axis_name: Optional[str] = None,
           has_missing: bool = True,
-          split_mode: str = "row") -> GrownTree:
+          split_mode: str = "row", scan_acc: str = "f32") -> GrownTree:
     """``split_mode="row"``: rows sharded over ``axis_name``, histograms
     psum'd (reference ``DataSplitMode::kRow``). ``split_mode="col"``:
     FEATURES sharded, rows replicated — split finding is local per feature
@@ -310,6 +323,20 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     # stays measurable.
     use_fused = hist_kernel == "fused" or (hist_kernel == "auto"
                                            and use_coarse)
+    # Round 12: the segmented-scan formulation replaces the fused schedule's
+    # coarse+refine data passes with ONE sorted pass per level — rows are
+    # counting-sorted by node (ops/partition.py counting_sort_by_node), the
+    # fine histogram is a contiguous segment sum over the sorted runs, and
+    # the coarse + refine histograms are derived from it (integral
+    # slice-diffs on TPU, direct sorted builds on XLA) instead of being
+    # re-accumulated from the data. Search space and models are
+    # bit-identical to fused (tools/validate_scan.py pins the grid), so
+    # "auto" promotes scan wherever it promoted fused; explicit "fused"
+    # keeps the old schedule so the A/B stays measurable.
+    use_scan = hist_kernel == "scan" or (hist_kernel == "auto"
+                                         and use_coarse and AUTO_SCAN_PROMOTE)
+    use_coarse = use_coarse or use_scan
+    use_fused = use_fused and not use_scan
     if use_coarse:
         if cat is not None or max_nbins > 256 + int(has_missing):
             raise NotImplementedError(
@@ -324,7 +351,7 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         # is explicit opt-in.
         from ..ops.split import (assemble_two_level, choose_refine_window,
                                  coarse_bin_ids, decode_two_level_bin,
-                                 refine_bin_ids)
+                                 refine_bin_ids, refine_from_fine)
         cb_t = coarse_bin_ids(bins_t.astype(jnp.int32), missing_bin)
         cb = cb_t.T
 
@@ -335,7 +362,22 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         idx = lo + jnp.arange(n_level)
 
         hist_c = None
-        if use_fused and pending_adv is not None:
+        hist_f = None  # scan: this level's full fine histogram
+        if use_scan and pending_adv is not None:
+            # scan boundary sweep: advance rows below the previous level's
+            # decoded splits, then one sorted ordering of the new level
+            # yields BOTH its fine and coarse histograms
+            row_axis = axis_name if not col_split else None
+            positions, hist_f, hist_c = scan_advance_level(
+                bins, gpair, positions, pending_adv, lo, n_level,
+                missing_bin, max_nbins=max_nbins, bins_t=bins_t,
+                method="auto", axis_name=row_axis,
+                decision_axis=axis_name if col_split else None,
+                acc=scan_acc)
+            hist_f = allreduce(hist_f)
+            hist_c = allreduce(hist_c)
+            pending_adv = None
+        elif use_fused and pending_adv is not None:
             # cross-level fused sweep: advance rows below the previous
             # level's decoded splits AND build this level's coarse
             # histogram from the same bin-tile read
@@ -353,6 +395,15 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         span = None
         if use_coarse:
             row_axis = axis_name if not col_split else None
+            if use_scan and hist_f is None:
+                # root level (and any level not fed by a boundary sweep):
+                # one sorted pass builds fine + coarse together
+                hist_f, hist_c = scan_level_hists(
+                    bins, gpair, rel, n_level, max_nbins, missing_bin,
+                    bins_t=bins_t, method="auto", axis_name=row_axis,
+                    acc=scan_acc)
+                hist_f = allreduce(hist_f)
+                hist_c = allreduce(hist_c)
             if hist_c is None:
                 hist_c = allreduce(build_hist(cb, gpair, rel, n_level, 20,
                                               method="auto", bins_t=cb_t,
@@ -361,27 +412,36 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                                         node_sum[lo:lo + n_level],
                                         n_real_bins, param,
                                         has_missing)              # [N, F]
-            # per-row window of the row's node, via one [F,N+1]@[N+1,n]
-            # MXU matmul (rows outside the level hit the zero pad row;
-            # their kernel contribution is dropped by rel == n_level)
-            span_pad = jnp.concatenate(
-                [span.astype(jnp.float32),
-                 jnp.zeros((1, F), jnp.float32)]).T         # [F, N+1]
-            oh_rel = (rel[None, :] == jnp.arange(
-                n_level + 1, dtype=jnp.int32)[:, None]).astype(jnp.float32)
-            c_row_t = jax.lax.dot_general(
-                span_pad, oh_rel, (((1,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST)        # [F, n]
-            # out-of-window sentinel (refine_bin_ids) must be a VALID slot
-            # of the kernel — the flat-index segment path would bleed an
-            # out-of-range id into the next feature's bins; the pad slots
-            # of the WINDOW+4-wide pass are discarded below
-            from ..ops.split import WINDOW
-            rb_t = refine_bin_ids(bins_t.astype(jnp.int32),
-                                  c_row_t.astype(jnp.int32), missing_bin)
-            hist_r = allreduce(build_hist(
-                rb_t.T, gpair, rel, n_level, WINDOW + 4, method="auto",
-                bins_t=rb_t, axis_name=row_axis))[:, :, :WINDOW, :]
+            if use_scan:
+                # integral-histogram refine: the refine pass is an O(1)
+                # WINDOW-slice of the fine histogram already in hand —
+                # bit-equal to the direct refine build of the same rows
+                # (ops/split.py refine_from_fine docstring) — so the
+                # level needs NO second data sweep
+                hist_r = refine_from_fine(hist_f, span, missing_bin)
+            else:
+                # per-row window of the row's node, via one [F,N+1]@[N+1,n]
+                # MXU matmul (rows outside the level hit the zero pad row;
+                # their kernel contribution is dropped by rel == n_level)
+                span_pad = jnp.concatenate(
+                    [span.astype(jnp.float32),
+                     jnp.zeros((1, F), jnp.float32)]).T     # [F, N+1]
+                oh_rel = (rel[None, :] == jnp.arange(
+                    n_level + 1,
+                    dtype=jnp.int32)[:, None]).astype(jnp.float32)
+                c_row_t = jax.lax.dot_general(
+                    span_pad, oh_rel, (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST)    # [F, n]
+                # out-of-window sentinel (refine_bin_ids) must be a VALID
+                # slot of the kernel — the flat-index segment path would
+                # bleed an out-of-range id into the next feature's bins;
+                # the pad slots of the WINDOW+4-wide pass are discarded
+                from ..ops.split import WINDOW
+                rb_t = refine_bin_ids(bins_t.astype(jnp.int32),
+                                      c_row_t.astype(jnp.int32), missing_bin)
+                hist_r = allreduce(build_hist(
+                    rb_t.T, gpair, rel, n_level, WINDOW + 4, method="auto",
+                    bins_t=rb_t, axis_name=row_axis))[:, :, :WINDOW, :]
             hist, n_real_eval = assemble_two_level(
                 hist_c, hist_r, span, n_real_bins, has_missing)
         elif depth == 0 or not use_compaction:
@@ -528,8 +588,8 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             delta = delta + jnp.sum(
                 jnp.where(rel_oh, w_level[None, :], 0.0), axis=1)
 
-        if use_fused:
-            # defer this level's advance to the NEXT boundary's fused
+        if use_fused or use_scan:
+            # defer this level's advance to the NEXT boundary's fused/scan
             # sweep; categorical args never arise (coarse is numeric-only)
             if col_split and n_level <= DENSE_LEVEL_MAX:
                 pending_adv = {
@@ -602,7 +662,7 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
                 num_segments=n_next + 1)[:n_next]
             built_is_left = counts[0::2] <= counts[1::2]
 
-    if use_fused and pending_adv is not None:
+    if (use_fused or use_scan) and pending_adv is not None:
         # epilogue: route rows below the deepest level's splits — advance
         # only, there is no next coarse pass left to fuse with
         if pending_adv["kind"] == "dense":
@@ -745,6 +805,16 @@ class TreeGrower:
         self.split_mode = split_mode
         self.cuts = cuts
         self.hist_method = hist_method
+        # scan-formulation partial-accumulator dtype (construction-time env
+        # read; docs/env_knobs.md XTPU_SCAN_ACC): "bf16" accumulates the
+        # segment sums in bf16 with an f32 residual fix-up pass — an
+        # opt-in A/B knob, NOT bit-compatible with fused, never selected
+        # by "auto" (tools/validate_scan.py gates promotion on f32 only)
+        self.scan_acc = os.environ.get("XTPU_SCAN_ACC", "f32")
+        if self.scan_acc not in ("f32", "bf16"):
+            raise ValueError(
+                f"XTPU_SCAN_ACC must be 'f32' or 'bf16', got "
+                f"{self.scan_acc!r}")
         self.mesh = mesh
         self.monotone = (None if monotone is None
                          else jnp.asarray(monotone, jnp.int32))
@@ -797,7 +867,8 @@ class TreeGrower:
                       self.monotone, self.constraint_sets, self.cat,
                       param=self.param, max_nbins=self.max_nbins,
                       hist_method=self.hist_method, axis_name=None,
-                      has_missing=self.has_missing)
+                      has_missing=self.has_missing,
+                      scan_acc=self.scan_acc)
         else:
             g = self._sharded(bins, gpair, n_real_bins, tree_mask, key)
         if self.param.max_leaves > 0:
@@ -854,7 +925,8 @@ class TreeGrower:
                              hist_method=self.hist_method,
                              axis_name=DATA_AXIS,
                              has_missing=self.has_missing,
-                             split_mode=self.split_mode)
+                             split_mode=self.split_mode,
+                             scan_acc=self.scan_acc)
 
             if self.split_mode == "col":
                 # features sharded over the axis, rows replicated; every

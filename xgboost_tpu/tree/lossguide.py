@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import heapq
 import math
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -33,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..context import shard_map as _shard_map
-from ..ops.histogram import build_hist
+from ..ops.histogram import build_hist, scan_level_hists
 from ..ops.partition import cat_goes_right
 from ..ops.split import CatInfo, evaluate_splits
 from .param import TrainParam, calc_weight
@@ -54,7 +55,8 @@ def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
            node_lower, node_upper, n_real_bins, bins_t, cb_t, monotone,
            cat, *, param: TrainParam, max_nbins: int, hist_method: str,
            axis_name: Optional[str], has_missing: bool = True,
-           coarse: bool = False):
+           coarse: bool = False, scan: bool = False,
+           scan_acc: str = "f32"):
     """Histogram + split enumeration for (up to) two sibling nodes.
     ``bins_t`` is the loop-invariant [F, n] transpose, computed once per
     tree so every per-split program skips the relayout.
@@ -64,10 +66,16 @@ def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
     build pays the full 256-wide one-hot cost exactly like a depthwise
     level did, so the same ~2.8x kernel win applies). Both passes psum
     under a mesh; the final enumeration is exact over the assembled
-    synthetic layout and the winning slot decodes to a fine bin."""
+    synthetic layout and the winning slot decodes to a fine bin.
+
+    ``scan`` (implies the coarse search space): one sorted segment-sum
+    pass yields the pair's fine + coarse histograms and the refine pass
+    is an O(1) window slice of the fine build
+    (``ops/split.py refine_from_fine``) — bit-identical splits to the
+    coarse/fused builds (tests/test_scan_hist.py)."""
     rel = jnp.where(positions == id0, 0,
                     jnp.where(positions == id1, 1, 2)).astype(jnp.int32)
-    if not coarse:
+    if not (coarse or scan):
         hist = build_hist(bins, gpair, rel, 2, max_nbins,
                           method=hist_method, bins_t=bins_t)
         if axis_name is not None:
@@ -79,28 +87,40 @@ def _eval2(bins, gpair, positions, id0, id1, parent_sums, fmask,
                                cat=cat, has_missing=has_missing)
     from ..ops.split import (COARSE_B, WINDOW, assemble_two_level,
                              choose_refine_window, decode_two_level_bin,
-                             refine_bin_ids)
+                             refine_bin_ids, refine_from_fine)
 
     missing_bin = max_nbins - 1 if has_missing else max_nbins
-    # cb_t is hoisted per TREE by the grower (loop-invariant, like
-    # bins_t); the int32 view feeding refine_bin_ids stays in-jit so XLA
-    # fuses the upcast into the consumer instead of materialising [F,n]i32
-    bt_i32 = bins_t.astype(jnp.int32)
-    hist_c = build_hist(cb_t.T, gpair, rel, 2, COARSE_B, method="auto",
-                        bins_t=cb_t)
-    if axis_name is not None:
-        hist_c = jax.lax.psum(hist_c, axis_name)
-    span = choose_refine_window(hist_c, parent_sums, n_real_bins, param,
-                                has_missing)                  # [2, F]
-    # per-row window of the row's node (N=2: two selects, no matmul)
-    c_row_t = jnp.where(rel[None, :] == 0, span[0][:, None],
-                        jnp.where(rel[None, :] == 1, span[1][:, None],
-                                  0)).astype(jnp.int32)       # [F, n]
-    rb_t = refine_bin_ids(bt_i32, c_row_t, missing_bin)
-    hist_r = build_hist(rb_t.T, gpair, rel, 2, WINDOW + 4, method="auto",
-                        bins_t=rb_t)[:, :, :WINDOW, :]
-    if axis_name is not None:
-        hist_r = jax.lax.psum(hist_r, axis_name)
+    if scan:
+        hist_f, hist_c = scan_level_hists(
+            bins, gpair, rel, 2, max_nbins, missing_bin, bins_t=bins_t,
+            method="auto", axis_name=axis_name, acc=scan_acc)
+        if axis_name is not None:
+            hist_f = jax.lax.psum(hist_f, axis_name)
+            hist_c = jax.lax.psum(hist_c, axis_name)
+        span = choose_refine_window(hist_c, parent_sums, n_real_bins,
+                                    param, has_missing)       # [2, F]
+        hist_r = refine_from_fine(hist_f, span, missing_bin)
+    else:
+        # cb_t is hoisted per TREE by the grower (loop-invariant, like
+        # bins_t); the int32 view feeding refine_bin_ids stays in-jit so
+        # XLA fuses the upcast into the consumer instead of materialising
+        # [F,n]i32
+        bt_i32 = bins_t.astype(jnp.int32)
+        hist_c = build_hist(cb_t.T, gpair, rel, 2, COARSE_B, method="auto",
+                            bins_t=cb_t)
+        if axis_name is not None:
+            hist_c = jax.lax.psum(hist_c, axis_name)
+        span = choose_refine_window(hist_c, parent_sums, n_real_bins,
+                                    param, has_missing)       # [2, F]
+        # per-row window of the row's node (N=2: two selects, no matmul)
+        c_row_t = jnp.where(rel[None, :] == 0, span[0][:, None],
+                            jnp.where(rel[None, :] == 1, span[1][:, None],
+                                      0)).astype(jnp.int32)   # [F, n]
+        rb_t = refine_bin_ids(bt_i32, c_row_t, missing_bin)
+        hist_r = build_hist(rb_t.T, gpair, rel, 2, WINDOW + 4,
+                            method="auto", bins_t=rb_t)[:, :, :WINDOW, :]
+        if axis_name is not None:
+            hist_r = jax.lax.psum(hist_r, axis_name)
     hist, n_real_eval = assemble_two_level(hist_c, hist_r, span,
                                            n_real_bins, has_missing)
     res = evaluate_splits(hist, parent_sums, n_real_eval, param,
@@ -117,7 +137,8 @@ def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
                monotone, cat, *,
                param: TrainParam, max_nbins: int, hist_method: str,
                axis_name: str, has_missing: bool = True,
-               coarse: bool = False):
+               coarse: bool = False, scan: bool = False,
+               scan_acc: str = "f32"):
     """Column-split ``_eval2``: this shard's bins hold global features
     [off, off + F); rows replicate so the two-node histogram needs no
     psum, each shard evaluates ITS features (local slices of the
@@ -148,7 +169,8 @@ def _eval2_col(bins, gpair, positions, id0, id1, parent_sums, fmask,
                  node_lower, node_upper, n_real_bins, bins_t, cb_t,
                  mono_loc, cat_loc, param=param, max_nbins=max_nbins,
                  hist_method=hist_method, axis_name=None,
-                 has_missing=has_missing, coarse=coarse)
+                 has_missing=has_missing, coarse=coarse, scan=scan,
+                 scan_acc=scan_acc)
     from .grow import exchange_best_split
 
     res, _ = exchange_best_split(res, axis_name, F,
@@ -161,7 +183,8 @@ def _apply_eval2(bins, gpair, positions, nid, feat_a, sbin_a, dleft_a,
                  fmask, node_lower, node_upper, n_real_bins, bins_t, cb_t,
                  monotone, cat, *, param: TrainParam, max_nbins: int,
                  hist_method: str, axis_name: Optional[str],
-                 has_missing: bool = True, coarse: bool = False):
+                 has_missing: bool = True, coarse: bool = False,
+                 scan: bool = False, scan_acc: str = "f32"):
     """Cross-level fusion, lossguide form (hist_method="fused"): the popped
     node's one-column row advance and its fresh children's histogram +
     enumeration run as ONE jitted program — the greedy loop's two
@@ -176,7 +199,8 @@ def _apply_eval2(bins, gpair, positions, nid, feat_a, sbin_a, dleft_a,
                  fmask, node_lower, node_upper, n_real_bins, bins_t, cb_t,
                  monotone, cat, param=param, max_nbins=max_nbins,
                  hist_method=hist_method, axis_name=axis_name,
-                 has_missing=has_missing, coarse=coarse)
+                 has_missing=has_missing, coarse=coarse, scan=scan,
+                 scan_acc=scan_acc)
     return positions, res
 
 
@@ -185,7 +209,8 @@ def _apply_eval2_col(bins, gpair, positions, nid, feat_a, sbin_a, dleft_a,
                      fmask, node_lower, node_upper, n_real_bins, bins_t,
                      cb_t, monotone, cat, *, param: TrainParam,
                      max_nbins: int, hist_method: str, axis_name: str,
-                     has_missing: bool = True, coarse: bool = False):
+                     has_missing: bool = True, coarse: bool = False,
+                     scan: bool = False, scan_acc: str = "f32"):
     """Column-split ``_apply_eval2``: the owner-decision advance
     (``_apply1_col``) and the feature-local eval + winner exchange
     (``_eval2_col``) composed into one program."""
@@ -197,7 +222,7 @@ def _apply_eval2_col(bins, gpair, positions, nid, feat_a, sbin_a, dleft_a,
                      n_real_bins, bins_t, cb_t, monotone, cat, param=param,
                      max_nbins=max_nbins, hist_method=hist_method,
                      axis_name=axis_name, has_missing=has_missing,
-                     coarse=coarse)
+                     coarse=coarse, scan=scan, scan_acc=scan_acc)
     return positions, res
 
 
@@ -337,7 +362,7 @@ class LossguideGrower:
             if base_hm.endswith(_sfx):
                 base_hm = base_hm[: -len(_sfx)]
                 sfx = _sfx
-        if base_hm in ("coarse", "fused") and (
+        if base_hm in ("coarse", "fused", "scan") and (
                 self.cat is not None
                 or max_nbins > 256 + int(has_missing)):
             # warn-and-fall-back, matching the depthwise "auto" promotion
@@ -363,6 +388,17 @@ class LossguideGrower:
         # promotes it alongside the coarse promotion (bit-exact with the
         # two-dispatch schedule; tests/test_fused_hist.py)
         self._fused = None
+        # segmented-scan histogram formulation (decided with _coarse at
+        # first grow): one sorted pass per split instead of coarse+refine
+        # data passes, same search space, bit-identical splits
+        # (tests/test_scan_hist.py; promotion gated by
+        # tools/validate_scan.py — see tree/grow.py AUTO_SCAN_PROMOTE)
+        self._scan = None
+        self.scan_acc = os.environ.get("XTPU_SCAN_ACC", "f32")
+        if self.scan_acc not in ("f32", "bf16"):
+            raise ValueError(
+                f"XTPU_SCAN_ACC must be 'f32' or 'bf16', got "
+                f"{self.scan_acc!r}")
         if split_mode == "col":
             # bins pad the feature axis to a multiple of the mesh width;
             # the replicated GLOBAL constraint/cat arrays must match so
@@ -395,7 +431,8 @@ class LossguideGrower:
 
         kw = dict(param=self.param, max_nbins=self.max_nbins,
                   hist_method=self.hist_method,
-                  has_missing=self.has_missing)
+                  has_missing=self.has_missing,
+                  scan=bool(self._scan), scan_acc=self.scan_acc)
         if self.mesh is None:
             ev = functools.partial(_eval2, monotone=self.monotone,
                                    cat=self.cat, axis_name=None,
@@ -544,7 +581,7 @@ class LossguideGrower:
             world = (1 if self.mesh is None
                      else self.mesh.shape.get(DATA_AXIS, 1))
             n_local = n if self.split_mode == "col" else n // max(world, 1)
-            self._coarse = self._base_hm in ("coarse", "fused") or (
+            self._coarse = self._base_hm in ("coarse", "fused", "scan") or (
                 self._base_hm == "auto" and self.split_mode == "row"
                 and auto_selects_coarse(
                     n_local, self.max_nbins, self.has_missing,
@@ -552,9 +589,18 @@ class LossguideGrower:
             # the fused (one-dispatch apply+eval) schedule rides with the
             # coarse promotion — bit-exact, so "auto" takes it wherever
             # it took coarse; explicit "coarse" keeps the two-dispatch
-            # schedule measurable on its own
-            self._fused = self._base_hm == "fused" or (
+            # schedule measurable on its own. The scan formulation keeps
+            # the one-dispatch schedule too (it changes the histogram
+            # build inside the program, not the dispatch shape).
+            self._fused = self._base_hm in ("fused", "scan") or (
                 self._base_hm == "auto" and self._coarse)
+            # Round 12: "auto" promotes the scan formulation wherever it
+            # promoted coarse (tree/grow.py AUTO_SCAN_PROMOTE gate)
+            from .grow import AUTO_SCAN_PROMOTE
+
+            self._scan = self._base_hm == "scan" or (
+                self._base_hm == "auto" and bool(self._coarse)
+                and AUTO_SCAN_PROMOTE)
         fns = self._functions()
         eval2, apply1, root_sum_fn, gather = fns[:4]
         apply_eval = fns[4] if len(fns) > 4 else None

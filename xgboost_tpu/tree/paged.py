@@ -67,12 +67,20 @@ def _make_kernels(grower):
     missing_bin = (grower.max_nbins - 1 if grower.has_missing
                    else grower.max_nbins)
     method = _strip_hist_suffix(grower.hist_method)
-    if method in ("coarse", "fused") or getattr(grower, "_coarse", False):
+    if (method in ("coarse", "fused", "scan")
+            or getattr(grower, "_coarse", False)):
         # two-level scheme: the coarse/refine page passes are plain
         # narrow-width builds — let the per-backend auto selection pick
         # their kernel. "fused" names the cross-level fused sweep, which
         # the paged tier's adv_hist body has been structurally since r5
         # (advance + next coarse in one page read) — same machinery.
+        # "scan" maps here too: the page-major schedule already builds
+        # the full fine partial per page visit and slices the refine
+        # window from it (refine_from_fine) — structurally the integral-
+        # histogram half of the scan formulation, so the paged two-level
+        # schedule IS the scan schedule for out-of-core data and the two
+        # methods are trivially bit-identical (tests/test_scan_hist.py);
+        # the sorted in-VMEM segment build targets the resident tiers.
         method = "auto"
     if grower.mesh is not None:
         return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
@@ -1500,7 +1508,7 @@ class PagedGrower(TreeGrower):
             from .grow import auto_selects_coarse
 
             base = _strip_hist_suffix(self.hist_method)
-            if base in ("coarse", "fused") and (
+            if base in ("coarse", "fused", "scan") and (
                     self.cat is not None
                     or self.max_nbins > 256 + int(self.has_missing)):
                 raise NotImplementedError(
@@ -1516,8 +1524,11 @@ class PagedGrower(TreeGrower):
             else:
                 n_local = n
             # "fused" selects the same two-level scheme: the advance +
-            # coarse page pass has been one fused body here since r5
-            self._coarse = base in ("coarse", "fused") or (
+            # coarse page pass has been one fused body here since r5.
+            # "scan" does too — the page-major schedule's fine-partial +
+            # refine_from_fine slicing already IS the integral-histogram
+            # half of the scan formulation (_make_kernels comment)
+            self._coarse = base in ("coarse", "fused", "scan") or (
                 base == "auto" and auto_selects_coarse(
                     n_local, self.max_nbins, self.has_missing,
                     numeric=self.cat is None, col_split=False))
@@ -1729,13 +1740,14 @@ class PagedLossguideGrower(LossguideGrower):
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing)
-        if self._base_hm in ("coarse", "fused"):
+        if self._base_hm in ("coarse", "fused", "scan"):
             raise NotImplementedError(
                 f"hist_method='{self._base_hm}' with grow_policy="
                 "lossguide runs on resident matrices only (the paged "
                 "per-split kernels use the one-pass build)")
         self._coarse = False  # page kernels ignore the resident auto rule
         self._fused = False   # per-split page loops stay two-dispatch
+        self._scan = False    # sorted in-VMEM build is resident-only too
         self.mesh = mesh
         self._mk: Optional[_MeshPageKernels] = None
 
@@ -1961,12 +1973,12 @@ class PagedMultiLossguideGrower(MultiLossguideGrower):
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, has_missing=has_missing,
                          constraint_sets=constraint_sets)
-        if _strip_hist_suffix(hist_method) in ("coarse", "fused"):
+        if _strip_hist_suffix(hist_method) in ("coarse", "fused", "scan"):
             # same contract as the scalar PagedLossguideGrower (and the
             # core guard already rejects coarse/fused for vector leaves)
             raise NotImplementedError(
-                "hist_method='coarse'/'fused' with grow_policy=lossguide "
-                "runs on resident matrices only")
+                "hist_method='coarse'/'fused'/'scan' with grow_policy="
+                "lossguide runs on resident matrices only")
         self.mesh = mesh
         self._mk = None
 
