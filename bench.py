@@ -17,7 +17,8 @@ headline metric stays the 1M config for round-over-round comparability.
 
 Env knobs: BENCH_ROWS (default 1e6), BENCH_ROUNDS (default 20),
 BENCH_SKIP_BASELINE=1 to reuse the last stored baseline time,
-BENCH_11M=0 to skip the north-star shape.
+BENCH_11M=0 to skip the north-star shape, BENCH_OBS=0 to skip the
+xtpuobs tracing-overhead + stage-drift keys (tools/perf_report.py).
 """
 
 from __future__ import annotations
@@ -519,6 +520,21 @@ def main():
         result["pipeline_promotion_ms"] = promo_ms
         result["pipeline_rounds_behind"] = behind
         result["pipeline_replay_byte_equal"] = byte_equal
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        # xtpuobs drift report (tools/perf_report.py): whole-round cost
+        # of enabled tracing on the resident hot path (bar: <= 1.0%),
+        # plus per-stage measured ms/round from the streamed paged proxy
+        # joined against the roofline floors
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from perf_report import measure_overhead, stage_report
+
+        result["obs_overhead_pct"] = round(
+            measure_overhead(ROWS, COLS, DEPTH, rounds=10), 3)
+        rep = stage_report(
+            rows=int(os.environ.get("BENCH_OBS_ROWS", 200_000)),
+            features=COLS, depth=DEPTH, rounds=3)
+        result.update(rep["keys"])
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # inference-serving SLOs (tools/bench_serve.py): open-loop mixed
         # 1/8/64/512-row workload through the micro-batcher; the four

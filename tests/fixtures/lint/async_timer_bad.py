@@ -1,0 +1,56 @@
+"""Known-bad twin for the async-timer checker.
+
+Host timers bracketing an async jitted dispatch with no device sync
+before the clock stops: the delta times the dispatch (microseconds),
+not the computation — the classic source of kernel benchmarks that are
+10000x too fast.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda x: jnp.sum(x * x))
+fused = functools.partial(jax.jit, donate_argnums=(0,))(
+    lambda m, g: m + g)
+
+
+@jax.jit
+def decorated_step(x):
+    return x * 2.0
+
+
+def time_step(x):
+    t0 = time.perf_counter()
+    out = step(x)
+    del out
+    return time.perf_counter() - t0  # LINT[async-timer]
+
+
+def time_decorated(x):
+    start = time.monotonic()
+    y = decorated_step(x)
+    del y
+    elapsed = time.monotonic() - start  # LINT[async-timer]
+    return elapsed
+
+
+def time_method_bound(self_like, m, g):
+    self_like.update = jax.jit(lambda a, b: a + b)
+    t0 = time.perf_counter()
+    out = self_like.update(m, g)
+    del out
+    return time.perf_counter() - t0  # LINT[async-timer]
+
+
+def time_last_unsynced(x):
+    # the FIRST dispatch is synced, but a second one follows the sync —
+    # the bracket still times an un-synced dispatch
+    t0 = time.perf_counter()
+    a = step(x)
+    jax.block_until_ready(a)
+    b = fused(a, x)
+    del b
+    return time.perf_counter() - t0  # LINT[async-timer]

@@ -1,0 +1,43 @@
+"""Known-good twin for the async-timer checker.
+
+Every timed bracket either syncs on the dispatch's result before the
+clock stops, times pure host work, or coerces a scalar off the device
+(which blocks) — none of these should be flagged.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+step = jax.jit(lambda x: jnp.sum(x * x))
+
+
+def time_step_synced(x):
+    t0 = time.perf_counter()
+    out = step(x)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def time_step_scalar_pull(x):
+    t0 = time.perf_counter()
+    out = step(x)
+    v = float(np.asarray(out))
+    elapsed = time.perf_counter() - t0
+    return elapsed, v
+
+
+def time_host_work(rows):
+    t0 = time.perf_counter()
+    total = sum(r * r for r in rows)
+    return time.perf_counter() - t0, total
+
+
+def time_item_pull(x):
+    start = time.monotonic()
+    out = step(x)
+    v = out.item()
+    del v
+    return time.monotonic() - start

@@ -451,8 +451,15 @@ def test_healthz_and_metrics_endpoints(tmp_path):
             health = json.loads(resp.read())
         assert health["status"] == "ok"
         assert health["models"] == [{"name": "model", "version": 1}]
+        # GET /metrics is Prometheus text exposition since xtpuobs; the
+        # JSON snapshot moved to /v1/metrics
+        resp = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics")
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+        assert "# TYPE xtpu_serve_requests_total counter" in body
+        assert "xtpu_pipeline_pages" in body    # pipeline registered too
         met = json.loads(urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/metrics").read())
+            f"http://127.0.0.1:{port}/v1/metrics").read())
         assert "counters" in met
     finally:
         httpd.shutdown()

@@ -150,6 +150,62 @@ def test_serve_metrics_set_vs_inc_concurrent():
     assert snap["counters"]["recompiles"] == n_iter - 1
 
 
+def test_serve_metrics_readers_vs_writers_hammer():
+    """The xtpuobs read side under fire: threads hammering ``inc`` /
+    ``observe`` / ``hit_bucket`` while other threads concurrently take
+    the locked read paths — ``get_many`` (health_snapshot's cut),
+    ``get``, and the registry's ``_collect_obs`` -> Prometheus render.
+    No crash, no torn read (get_many cuts are internally consistent),
+    and the final totals are exact."""
+    from xgboost_tpu.obs.metrics import MetricsRegistry
+
+    m = ServeMetrics(register=False)
+    reg = MetricsRegistry()
+    reg.register(ServeMetrics._collect_obs, owner=m)
+    n_threads, n_iter = 4, 1500
+    stop = threading.Event()
+    errors = []
+
+    def write_worker(seed):
+        for i in range(n_iter):
+            m.inc("requests")
+            m.inc("rows", 8)
+            m.observe("e2e", 0.001 * ((seed + i) % 7 + 1))
+            m.hit_bucket(1 << (i % 4), padded_rows=i % 3)
+
+    def read_worker():
+        while not stop.is_set():
+            try:
+                cut = m.get_many(("requests", "rows"))
+                # torn-read check: rows is always 8x requests' increments
+                assert cut["rows"] <= 8 * cut["requests"] + 8 * n_threads
+                m.get("requests")
+                text = reg.render_prometheus()
+                assert "xtpu_serve_requests_total" in text
+                m.snapshot()
+            except Exception as e:  # pragma: no cover - failure surface
+                errors.append(e)
+                return
+
+    writers = [threading.Thread(target=write_worker, args=(s,))
+               for s in range(n_threads)]
+    readers = [threading.Thread(target=read_worker) for _ in range(2)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, errors
+    assert m.get("requests") == n_threads * n_iter
+    assert m.get("rows") == 8 * n_threads * n_iter
+    # histogram totals survived the concurrent exposition renders
+    fams = {f.name: f for f in m._collect_obs()}
+    hd = fams["xtpu_serve_stage_latency_seconds"].samples[0].value
+    assert hd.count == n_threads * n_iter
+
+
 # ----------------------------------------------- combined three-way stress
 
 def test_hot_swap_drain_and_checkpoint_concurrently(data, booster,

@@ -21,6 +21,9 @@ python -m tools.xtpulint || exit $?
 echo "== validate_scan (scan vs fused bit-parity grid, smoke scale) =="
 JAX_PLATFORMS=cpu python tools/validate_scan.py --scale 0.25 --seeds 1 || exit $?
 
+echo "== validate_obs (traced-vs-untraced byte equality + exposition lint) =="
+JAX_PLATFORMS=cpu python tools/validate_obs.py || exit $?
+
 echo "== tier-1 tests =="
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,309 @@
+"""Measured-vs-roofline drift report: join traced stage times to floors.
+
+``tools/roofline.py`` prints what each per-level pass of the two-level
+histogram SHOULD cost on v5e peaks; the xtpuobs tracer records what the
+paged driver's stages ACTUALLY cost (host spans around the only tree
+loop with real host-visible stage boundaries — ``tree/paged.py``; the
+resident path is one fused dispatch and is covered by the whole-round
+overhead check instead). This tool runs a small streamed training with
+tracing in measurement-sync mode (``obs.trace.set_sync``: every stage
+span blocks on its stage's outputs, so span duration = stage wall
+clock), aggregates spans by stage, and emits the drift table:
+
+    | stage | measured ms/round | floor ms/round | util | drift x |
+
+plus ONE JSON line with the bench keys the driver scores:
+
+- ``obs_overhead_pct``  — whole-round cost of ENABLED tracing on the
+  resident hot path (traced vs untraced wall clock, best-of-2 each);
+  the acceptance bar is <= 1.0.
+- ``stage_drift_max``   — max measured/floor over the floored stages.
+- ``higgs_stage_<s>_ms``— measured ms/round per stage.
+
+On a CPU host the drift columns are a PROXY (floors are v5e peaks, so
+drift runs orders of magnitude above 1x) — the table's value there is
+the per-stage decomposition and its round-over-round trend; on a real
+v5e the same join scores utilisation directly. Stage -> floor mapping
+(the paged coarse pass fuses the advance, matching roofline's ``fused``
+schedule): hist <- coarse/adv+coarse, refine <- refine, advance <- the
+epilogue advance; window/eval/exchange/fetch are host-side stages with
+no device floor (blank floor column).
+
+Usage: ``python tools/perf_report.py [--rows 200000 --depth 6 ...]``.
+``bench.py`` imports :func:`measure_overhead` / :func:`stage_report`
+for the BENCH_OBS keys.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, Optional
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_TOOLS)
+for _p in (_TOOLS, _ROOT):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+import roofline  # noqa: E402  (tools/roofline.py — pure shape math)
+
+# stages with a device floor in roofline's fused schedule; everything
+# else the paged driver traces (window/eval/exchange/fetch/level_full)
+# is host-side orchestration with no roofline line
+_FLOOR_OF_STAGE = {
+    "hist": ("coarse", "adv+coarse"),
+    "refine": ("refine",),
+    "advance": ("advance",),
+}
+
+
+def roofline_floors(rows: int, features: int, depth: int,
+                    mode: str = "fused") -> Dict[str, float]:
+    """Per-stage floor ms for ONE round, summed over levels."""
+    per_pass: Dict[str, float] = {}
+    for _d, _n, passes in roofline.schedule(rows, features, depth, mode):
+        for pname, cost in passes.items():
+            per_pass[pname] = per_pass.get(pname, 0.0) + cost["floor"] * 1e3
+    floors: Dict[str, float] = {}
+    for stage, pnames in _FLOOR_OF_STAGE.items():
+        tot = sum(per_pass.get(p, 0.0) for p in pnames)
+        if tot > 0.0:
+            floors[stage] = tot
+    return floors
+
+
+def plain_floors(rows: int, features: int, depth: int) -> Dict[str, float]:
+    """Floors for the paged PLAIN schedule (no coarse promotion —
+    ``level_hist``/``adv_hist`` build the full 256-slot fine histogram
+    in one sweep per level, the advance fused in from level 1 on), which
+    roofline's three named schedules don't model directly. Built from
+    the same :func:`roofline.pass_cost` primitives."""
+    gp = 8 * rows
+    hist = 0.0
+    for d in range(depth):
+        hist += roofline.pass_cost(
+            rows, features, roofline.FINE_B, 2 ** d, gpair_bytes=gp,
+            pos_rw=1 + (d > 0), advance=d > 0)["floor"] * 1e3
+    adv = roofline.pass_cost(
+        rows, features, 0, 2 ** depth, gpair_bytes=0, pos_rw=2,
+        advance=True)["floor"] * 1e3
+    return {"hist": hist, "advance": adv}
+
+
+def _train_paged(rows: int, features: int, depth: int, rounds: int,
+                 n_pages: int, tmpdir: str, tag: str):
+    import numpy as np
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.data.dmatrix import DataIter
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(rows, features).astype(np.float32)
+    y = (X @ rng.randn(features) > 0).astype(np.float32)
+
+    class _It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self.parts = np.array_split(np.arange(rows), n_pages)
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(self.parts):
+                return 0
+            idx = self.parts[self.i]
+            input_data(data=X[idx], label=y[idx])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    it = _It()
+    it.cache_prefix = os.path.join(tmpdir, "pc" + tag)
+    dm = xgb.QuantileDMatrix(it, max_bin=256)
+    params = {"objective": "binary:logistic", "max_depth": depth,
+              "eta": 0.1, "max_bin": 256}
+    return xgb.train(params, dm, rounds, verbose_eval=False)
+
+
+def measure_stages(rows: int = 200_000, features: int = 28,
+                   depth: int = 6, rounds: int = 3,
+                   n_pages: int = 4) -> Dict[str, Dict[str, float]]:
+    """Stream a paged training with sync-mode tracing ON; return
+    ``{stage: {"ms_per_round", "count"}}`` aggregated from the
+    ``paged/*`` spans. Forces the streamed schedule (page cache off,
+    collapse off) so every level crosses real stage boundaries."""
+    from xgboost_tpu.obs import trace as tr
+
+    env_keep = {k: os.environ.get(k) for k in
+                ("XTPU_PAGE_ROWS", "XTPU_PAGED_COLLAPSE",
+                 "XTPU_PAGE_CACHE_BYTES")}
+    os.environ["XTPU_PAGE_ROWS"] = str(max(rows // n_pages, 1))
+    os.environ["XTPU_PAGED_COLLAPSE"] = "0"
+    os.environ["XTPU_PAGE_CACHE_BYTES"] = "0"
+    was_enabled = tr.enabled()
+    tmp = tempfile.TemporaryDirectory(prefix="xtpu_perf_report_")
+    try:
+        tr.enable()
+        tr.set_sync(True)
+        # warm-up run compiles every per-page program; the measured run's
+        # spans then time steady-state stages, not XLA compilation
+        _train_paged(rows, features, depth, 2, n_pages, tmp.name, "w")
+        tr.reset()
+        _train_paged(rows, features, depth, rounds, n_pages, tmp.name, "m")
+        agg: Dict[str, Dict[str, float]] = {}
+        for s in tr.tracer().spans():
+            if not s.name.startswith("paged/"):
+                continue
+            st = agg.setdefault(s.name[len("paged/"):],
+                                {"total_ms": 0.0, "count": 0})
+            st["total_ms"] += s.dur * 1e3
+            st["count"] += 1
+        return {
+            stage: {"ms_per_round": st["total_ms"] / rounds,
+                    "count": st["count"]}
+            for stage, st in sorted(agg.items())
+        }
+    finally:
+        tr.set_sync(False)
+        if not was_enabled:
+            tr.disable()
+        for k, v in env_keep.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tmp.cleanup()
+
+
+def measure_overhead(rows: int = 200_000, features: int = 28,
+                     depth: int = 6, rounds: int = 10) -> float:
+    """Whole-round cost of ENABLED tracing on the resident hot path, as
+    a percentage (traced vs untraced wall clock, best-of-2 each; floored
+    at 0 — run-to-run noise must not report a negative 'cost')."""
+    import numpy as np
+
+    import jax
+    import xgboost_tpu as xgb
+    from xgboost_tpu.obs import trace as tr
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(rows, features).astype(np.float32)
+    y = (X @ rng.randn(features) > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "max_depth": depth,
+              "eta": 0.1, "max_bin": 256}
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        bst = xgb.train(params, dm, rounds, verbose_eval=False)
+        for st in bst._caches.values():
+            jax.block_until_ready(st["margin"])
+            float(np.asarray(st["margin"][0, 0]))
+        return time.perf_counter() - t0
+
+    was_enabled = tr.enabled()
+    try:
+        tr.disable()
+        timed()  # warm-up: binning + compile
+        base = min(timed() for _ in range(3))
+        tr.enable()
+        traced = min(timed() for _ in range(3))
+    finally:
+        if was_enabled:
+            tr.enable()
+        else:
+            tr.disable()
+    return max(0.0, (traced - base) / base * 100.0)
+
+
+def drift_rows(measured: Dict[str, Dict[str, float]],
+               floors: Dict[str, float]):
+    """Join measured stages to floors -> table rows, floored stages
+    first. ``util``/``drift`` are None where no floor exists."""
+    rows = []
+    for stage, m in measured.items():
+        floor = floors.get(stage)
+        ms = m["ms_per_round"]
+        rows.append({
+            "stage": stage,
+            "measured_ms": round(ms, 3),
+            "floor_ms": None if floor is None else round(floor, 3),
+            "util": (None if floor is None or ms <= 0
+                     else round(floor / ms, 6)),
+            "drift_x": (None if floor is None or floor <= 0
+                        else round(ms / floor, 1)),
+            "spans": m["count"],
+        })
+    rows.sort(key=lambda r: (r["floor_ms"] is None, -r["measured_ms"]))
+    return rows
+
+
+def render_markdown(rows, title: str) -> str:
+    out = [f"### {title}", "",
+           "| stage | measured ms/round | floor ms/round | util | "
+           "drift x | spans |",
+           "|---|---|---|---|---|---|"]
+    for r in rows:
+        fl = "—" if r["floor_ms"] is None else f"{r['floor_ms']:.3f}"
+        ut = "—" if r["util"] is None else f"{100 * r['util']:.2f}%"
+        dr = "—" if r["drift_x"] is None else f"{r['drift_x']:.1f}x"
+        out.append(f"| {r['stage']} | {r['measured_ms']:.3f} | {fl} | "
+                   f"{ut} | {dr} | {r['spans']} |")
+    return "\n".join(out)
+
+
+def stage_report(rows: int = 200_000, features: int = 28, depth: int = 6,
+                 rounds: int = 3, n_pages: int = 4) -> dict:
+    """measure + join + keys in one call (what bench.py uses)."""
+    measured = measure_stages(rows, features, depth, rounds, n_pages)
+    # the floor schedule must match what actually ran: a refine stage
+    # means the coarse two-level schedule (fused advance+coarse), no
+    # refine means the plain one-sweep fine build (the auto rule demotes
+    # small shards to it)
+    floors = (roofline_floors(rows, features, depth)
+              if "refine" in measured
+              else plain_floors(rows, features, depth))
+    rows_ = drift_rows(measured, floors)
+    keys = {f"higgs_stage_{r['stage']}_ms": r["measured_ms"]
+            for r in rows_}
+    drifts = [r["drift_x"] for r in rows_ if r["drift_x"] is not None]
+    keys["stage_drift_max"] = max(drifts) if drifts else None
+    return {"rows": rows_, "keys": keys}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--pages", type=int, default=4)
+    ap.add_argument("--overhead-rounds", type=int, default=10)
+    ap.add_argument("--skip-overhead", action="store_true",
+                    help="stage table only (the overhead check retrains "
+                         "the resident path 5x)")
+    args = ap.parse_args()
+
+    rep = stage_report(args.rows, args.features, args.depth, args.rounds,
+                       args.pages)
+    print(render_markdown(
+        rep["rows"],
+        f"measured vs roofline — {args.rows / 1e6:g}M x {args.features}, "
+        f"depth {args.depth} (streamed paged proxy)"))
+
+    out = dict(rep["keys"])
+    if not args.skip_overhead:
+        out["obs_overhead_pct"] = round(measure_overhead(
+            args.rows, args.features, args.depth,
+            args.overhead_rounds), 3)
+    print("\n" + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

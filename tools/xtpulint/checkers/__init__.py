@@ -12,6 +12,7 @@ from ..engine import Finding, RepoIndex
 
 from .trace_capture import check_trace_capture
 from .host_sync import check_host_sync
+from .async_timer import check_async_timer
 from .recompile import check_recompile
 from .donation import check_donation
 from .locks import check_locks
@@ -24,4 +25,5 @@ CHECKERS: Dict[str, Callable[[RepoIndex], List[Finding]]] = {
     "donation-misuse": check_donation,
     "lock-discipline": check_locks,
     "collective-symmetry": check_collectives,
+    "async-timer": check_async_timer,
 }

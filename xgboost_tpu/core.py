@@ -29,6 +29,7 @@ from .objective import get_objective
 from .objective.base import _nan_policy
 from .tree.param import TrainParam
 from .utils import observer
+from .obs import trace as obs_trace
 from .utils.timer import Monitor
 
 _VERSION = (0, 1, 0)
@@ -866,15 +867,18 @@ class Booster:
         from .boosting.gbtree import _PendingTree
 
         try:
-            new_margin, grown = _fused_round_fn(
-                binned.bins, state["margin"], labels, weights, n_real,
-                self.ctx.raw_seed(iteration), np.int32(iteration),
-                grower.monotone, grower.constraint_sets, grower.cat,
-                obj_cls=type(self.obj), obj_params=obj_params,
-                param=grower.param, max_nbins=grower.max_nbins,
-                hist_method=grower.hist_method,
-                has_missing=grower.has_missing,
-                nan_policy=_nan_policy())
+            # hot path: obs_trace.span returns a shared no-op when tracing
+            # is off — tests/test_obs.py pins this to zero allocations
+            with obs_trace.span("round/fused"):
+                new_margin, grown = _fused_round_fn(
+                    binned.bins, state["margin"], labels, weights, n_real,
+                    self.ctx.raw_seed(iteration), np.int32(iteration),
+                    grower.monotone, grower.constraint_sets, grower.cat,
+                    obj_cls=type(self.obj), obj_params=obj_params,
+                    param=grower.param, max_nbins=grower.max_nbins,
+                    hist_method=grower.hist_method,
+                    has_missing=grower.has_missing,
+                    nan_policy=_nan_policy())
         except Exception:
             logger.warning("fused boosting round failed; falling back to "
                            "the general path permanently", exc_info=True)

@@ -376,6 +376,9 @@ class PagedBinnedMatrix:
         # window being measured.
         self.ring_stats: dict = {"upload_s": 0.0, "blocked_s": 0.0,
                                  "uploads": 0, "bytes": 0}
+        from ..obs.metrics import get_registry
+
+        get_registry().register(type(self)._collect_obs, owner=self)
         if self.cache_budget_bytes < 0:
             self.cache_budget_bytes = int(os.environ.get(
                 "XTPU_PAGE_CACHE_BYTES", 4 << 30))
@@ -396,6 +399,28 @@ class PagedBinnedMatrix:
     def reset_ring_stats(self) -> None:
         self.ring_stats.update(upload_s=0.0, blocked_s=0.0, uploads=0,
                                bytes=0)
+
+    def _collect_obs(self):
+        """Registry collector: prefetch-ring accounting as counters (note
+        ``reset_ring_stats()`` resets them — scrapers should treat drops
+        as counter resets, the standard Prometheus convention)."""
+        from ..obs.metrics import Family, Sample
+
+        st = self.ring_stats
+        return [
+            Family("xtpu_ring_upload_seconds_total", "counter",
+                   "wall time the ring worker spent inside device_put",
+                   [Sample(st["upload_s"])]),
+            Family("xtpu_ring_blocked_seconds_total", "counter",
+                   "wall time the consumer waited on in-flight uploads",
+                   [Sample(st["blocked_s"])]),
+            Family("xtpu_ring_uploads_total", "counter",
+                   "pages shipped host-to-device",
+                   [Sample(st["uploads"])]),
+            Family("xtpu_ring_bytes_total", "counter",
+                   "H2D payload bytes shipped (transport layout)",
+                   [Sample(st["bytes"])]),
+        ]
 
     @staticmethod
     def _pack_host(arr: np.ndarray) -> np.ndarray:
@@ -494,9 +519,12 @@ class PagedBinnedMatrix:
 
         stats = self.ring_stats
 
+        from ..obs import trace as _trace
+
         def timed_fetch(s):
             t0 = _time.perf_counter()
-            out = fetch(s)
+            with _trace.span("ring/upload"):
+                out = fetch(s)
             if out[2]:  # uploaded (not a cache hit)
                 stats["upload_s"] += _time.perf_counter() - t0
                 stats["uploads"] += 1
@@ -509,7 +537,8 @@ class PagedBinnedMatrix:
                             for s in starts[:depth])
             for i in range(len(starts)):
                 t0 = _time.perf_counter()
-                key, payload, uploaded, _ = pending.popleft().result()
+                with _trace.span("ring/blocked"):
+                    key, payload, uploaded, _ = pending.popleft().result()
                 if uploaded:  # consumer stalled on an in-flight upload
                     stats["blocked_s"] += _time.perf_counter() - t0
                 if i + depth < len(starts):

@@ -1,18 +1,19 @@
 """Logging + phase timing.
 
-Mirrors the reference's console logger (``include/xgboost/logging.h:41``) and
-``common::Monitor`` per-label wall-clock accumulators (``src/common/timer.h:16,46``)
-printed at verbosity >= 3. On TPU the analogue of NVTX ranges is
-``jax.profiler.TraceAnnotation``; Monitor wraps both.
+Mirrors the reference's console logger (``include/xgboost/logging.h:41``).
+The ``common::Monitor`` analogue now lives in
+:mod:`xgboost_tpu.obs.monitor` (this module used to carry a duplicate
+copy); it is re-exported here for compatibility. On TPU the analogue of
+NVTX ranges is ``jax.profiler.TraceAnnotation``; Monitor sections wrap
+both, plus an :mod:`xgboost_tpu.obs.trace` span.
 """
 
 from __future__ import annotations
 
-import contextlib
 import logging
-import time
-from collections import defaultdict
-from typing import Callable, Dict, Iterator, Optional
+from typing import Callable, Optional
+
+from .obs.monitor import Monitor  # noqa: F401  (compat re-export)
 
 logger = logging.getLogger("xgboost_tpu")
 if not logger.handlers:
@@ -43,45 +44,3 @@ def console(msg: str) -> None:
 
 def set_verbosity(verbosity: int) -> None:
     logger.setLevel(_VERBOSITY_TO_LEVEL.get(int(verbosity), logging.DEBUG))
-
-
-class Monitor:
-    """Per-label elapsed-time accumulator (reference ``common::Monitor``)."""
-
-    def __init__(self, name: str) -> None:
-        self.name = name
-        self.totals: Dict[str, float] = defaultdict(float)
-        self.counts: Dict[str, int] = defaultdict(int)
-
-    @contextlib.contextmanager
-    def timed(self, label: str) -> Iterator[None]:
-        try:
-            import jax.profiler
-            ann = jax.profiler.TraceAnnotation(f"{self.name}.{label}")
-        except Exception:  # pragma: no cover
-            ann = contextlib.nullcontext()
-        start = time.perf_counter()
-        with ann:
-            yield
-        self.totals[label] += time.perf_counter() - start
-        self.counts[label] += 1
-
-    def start(self, label: str) -> None:
-        self.totals.setdefault(label, 0.0)
-        self._starts = getattr(self, "_starts", {})
-        self._starts[label] = time.perf_counter()
-
-    def stop(self, label: str) -> None:
-        self.totals[label] += time.perf_counter() - self._starts.pop(label)
-        self.counts[label] += 1
-
-    def report(self) -> str:
-        lines = [f"======== Monitor ({self.name}) ========"]
-        for label in sorted(self.totals):
-            lines.append(
-                f"{label}: {self.totals[label]*1e3:.3f}ms, {self.counts[label]} calls")
-        return "\n".join(lines)
-
-    def maybe_print(self, verbosity: int) -> None:
-        if verbosity >= 3:
-            console(self.report())

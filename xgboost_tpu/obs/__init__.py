@@ -1,0 +1,31 @@
+"""xtpuobs — the unified observability subsystem (docs/observability.md).
+
+Three instruments, one taxonomy:
+
+- :mod:`~xgboost_tpu.obs.trace` — ring-buffered host spans paired with
+  device-timeline annotations; ``XTPU_TRACE=1`` turns it on, export is
+  Chrome/Perfetto JSON or jsonl.
+- :mod:`~xgboost_tpu.obs.metrics` — the process-wide
+  :class:`MetricsRegistry` every counting subsystem registers into;
+  rendered as Prometheus text exposition on serve's ``GET /metrics``.
+- :mod:`~xgboost_tpu.obs.monitor` — the per-label wall-clock
+  :class:`Monitor` (the single copy; ``utils/timer.py`` and
+  ``logging_utils.py`` re-export it), with the opt-in ``sync=True``
+  mode that makes verbosity>=3 tables measure device work.
+
+``tools/perf_report.py`` joins the measured spans against
+``tools/roofline.py`` floors into the stage-drift table.
+"""
+
+from . import metrics, trace
+from .metrics import Family, HistogramData, MetricsRegistry, Sample, \
+    get_registry
+from .monitor import Monitor, Timer, annotate, profile
+from .trace import Span, Tracer, span
+
+__all__ = [
+    "trace", "metrics",
+    "Span", "Tracer", "span",
+    "MetricsRegistry", "Family", "Sample", "HistogramData", "get_registry",
+    "Monitor", "Timer", "annotate", "profile",
+]

@@ -1,0 +1,324 @@
+"""Low-overhead span tracing: ring-buffered host spans + device pairing.
+
+One process-wide :class:`Tracer` records host-side spans — stage names
+like ``paged/hist`` or ``serve/compute`` with wall-clock start/end —
+into a fixed-capacity ring, and pairs every span with a
+``jax.profiler.TraceAnnotation`` so the same stage names show up on the
+device timeline when a ``jax.profiler`` capture is running. Host spans
+around a *jitted* region measure dispatch + any sync the caller already
+does (see docs/observability.md for which stages are device-synced);
+stages *inside* one jitted program are labeled with ``jax.named_scope``
+at trace time instead (``tree/grow.py``) and only appear in device
+profiles.
+
+Tracing is OFF by default and the disabled path is free: ``span()``
+returns a shared no-op context manager without allocating, so the
+resident hot loop (one ``_fused_step`` dispatch per round) pays one
+predicate per span site and nothing else — ``tests/test_obs.py``
+pins this to literally zero allocations.
+
+Knobs (read at import; flip programmatically with
+:func:`enable` / :func:`disable` mid-process):
+
+- ``XTPU_TRACE``      — ``1`` enables tracing (default ``0``).
+- ``XTPU_TRACE_BUF``  — ring capacity in spans (default ``65536``);
+  the ring keeps the newest spans when it wraps.
+- ``XTPU_TRACE_OUT``  — path to auto-export on process exit;
+  ``*.jsonl`` writes one span per line, anything else writes
+  Chrome/Perfetto trace JSON (load in ``ui.perfetto.dev``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "enable", "disable", "enabled", "tracer",
+           "span", "instant", "export", "reset", "sync", "set_sync"]
+
+
+class Span:
+    """One finished span: ``[t0, t1)`` seconds on ``time.perf_counter``'s
+    clock, ``depth`` = nesting level within the recording thread."""
+
+    __slots__ = ("name", "cat", "t0", "t1", "depth", "tid", "args")
+
+    def __init__(self, name: str, cat: str, t0: float, t1: float,
+                 depth: int, tid: int, args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.cat = cat
+        self.t0 = t0
+        self.t1 = t1
+        self.depth = depth
+        self.tid = tid
+        self.args = args
+
+    @property
+    def dur(self) -> float:
+        return self.t1 - self.t0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"name": self.name, "cat": self.cat, "t0": self.t0,
+             "t1": self.t1, "dur": self.t1 - self.t0, "depth": self.depth,
+             "tid": self.tid}
+        if self.args:
+            d["args"] = self.args
+        return d
+
+
+class _NullSpan:
+    """Shared no-op context manager — the entire disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _LiveSpan:
+    """Enabled-path context manager: one per ``with span(...)`` block."""
+
+    __slots__ = ("_tr", "name", "cat", "args", "_t0", "_ann")
+
+    def __init__(self, tr: "Tracer", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tr = tr
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        ann_cls = self._tr._ann_cls
+        if ann_cls is not None:
+            try:
+                self._ann = ann_cls(self.name)
+                self._ann.__enter__()
+            except Exception:  # pragma: no cover - profiler unavailable
+                self._ann = None
+        else:
+            self._ann = None
+        tl = self._tr._tl
+        tl.depth = getattr(tl, "depth", 0) + 1
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        tl = self._tr._tl
+        depth = getattr(tl, "depth", 1)
+        tl.depth = depth - 1
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._tr._record(Span(self.name, self.cat, self._t0, t1,
+                              depth - 1, threading.get_ident(), self.args))
+        return False
+
+
+class Tracer:
+    """Fixed-capacity ring of :class:`Span` records."""
+
+    def __init__(self, capacity: int = 65536,
+                 annotate_device: bool = True) -> None:
+        self.capacity = max(int(capacity), 1)
+        self._buf: List[Optional[Span]] = [None] * self.capacity
+        self._n = 0                       # total spans ever recorded
+        self._lock = threading.Lock()
+        self._tl = threading.local()
+        self._epoch = time.perf_counter()  # export time base
+        self._ann_cls = None
+        if annotate_device:
+            try:
+                import jax.profiler
+                self._ann_cls = jax.profiler.TraceAnnotation
+            except Exception:  # pragma: no cover - jax-less analysis use
+                self._ann_cls = None
+
+    # ------------------------------------------------------------ recording
+    def span(self, name: str, cat: str = "",
+             args: Optional[Dict[str, Any]] = None) -> _LiveSpan:
+        return _LiveSpan(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "",
+                args: Optional[Dict[str, Any]] = None) -> None:
+        t = time.perf_counter()
+        self._record(Span(name, cat, t, t,
+                          getattr(self._tl, "depth", 0),
+                          threading.get_ident(), args))
+
+    def _record(self, sp: Span) -> None:
+        with self._lock:
+            self._buf[self._n % self.capacity] = sp
+            self._n += 1
+
+    # ------------------------------------------------------------- reading
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    @property
+    def dropped(self) -> int:
+        """Spans the ring overwrote (0 until it wraps)."""
+        return max(self._n - self.capacity, 0)
+
+    def spans(self) -> List[Span]:
+        """Chronological copy of the ring's current contents."""
+        with self._lock:
+            n, cap = self._n, self.capacity
+            if n <= cap:
+                return [s for s in self._buf[:n]]
+            i = n % cap
+            return self._buf[i:] + self._buf[:i]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._n = 0
+            self._epoch = time.perf_counter()
+
+    # ------------------------------------------------------------- export
+    def to_perfetto(self) -> Dict[str, Any]:
+        """Chrome/Perfetto trace-event JSON (``ph: "X"`` complete events,
+        microsecond timestamps relative to the tracer epoch)."""
+        events = []
+        pid = os.getpid()
+        for s in self.spans():
+            ev: Dict[str, Any] = {
+                "name": s.name, "ph": "X", "pid": pid, "tid": s.tid,
+                "ts": (s.t0 - self._epoch) * 1e6,
+                "dur": (s.t1 - s.t0) * 1e6,
+            }
+            if s.cat:
+                ev["cat"] = s.cat
+            if s.args:
+                ev["args"] = dict(s.args)
+            events.append(ev)
+        return {"displayTimeUnit": "ms", "traceEvents": events}
+
+    def dump(self, path: str) -> int:
+        """Write the ring to ``path``: jsonl (one span dict per line) when
+        the name ends in ``.jsonl``, Perfetto JSON otherwise. Returns the
+        number of spans written."""
+        spans = self.spans()
+        if path.endswith(".jsonl"):
+            with open(path, "w", encoding="utf-8") as fh:
+                for s in spans:
+                    fh.write(json.dumps(s.to_dict()) + "\n")
+        else:
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(self.to_perfetto(), fh)
+        return len(spans)
+
+
+# ------------------------------------------------------- module-level state
+
+_tracer: Optional[Tracer] = None
+
+
+def enable(capacity: Optional[int] = None) -> Tracer:
+    """Turn tracing on (idempotent); returns the live tracer."""
+    global _tracer
+    if _tracer is None or (capacity is not None
+                           and _tracer.capacity != int(capacity)):
+        _tracer = Tracer(capacity if capacity is not None
+                         else _default_capacity())
+    return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    _tracer = None
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def span(name: str, cat: str = "", args: Optional[Dict[str, Any]] = None):
+    """The one instrumentation entry point. Disabled: returns a shared
+    no-op context manager (no allocation). Enabled: records a host span
+    and mirrors it onto the device timeline."""
+    t = _tracer
+    if t is None:
+        return _NULL
+    return t.span(name, cat, args)
+
+
+def instant(name: str, cat: str = "",
+            args: Optional[Dict[str, Any]] = None) -> None:
+    """Zero-duration marker (retry events, promotions)."""
+    t = _tracer
+    if t is not None:
+        t.instant(name, cat, args)
+
+
+def export(path: Optional[str] = None) -> int:
+    """Dump the current ring (0 spans when tracing is off). Default path:
+    ``XTPU_TRACE_OUT`` or ``xtpu_trace.json``."""
+    t = _tracer
+    if t is None:
+        return 0
+    return t.dump(path or _OUT or "xtpu_trace.json")
+
+
+def reset() -> None:
+    """Clear the ring, keeping tracing in its current on/off state."""
+    t = _tracer
+    if t is not None:
+        t.clear()
+
+
+_SYNC = os.environ.get("XTPU_TRACE_SYNC", "0") not in ("0", "")
+
+
+def set_sync(on: bool) -> None:
+    """Toggle measurement-sync mode (see :func:`sync`)."""
+    global _SYNC
+    _SYNC = bool(on)
+
+
+def sync(x):
+    """Measurement barrier: block on ``x`` before the enclosing span
+    closes — but ONLY when tracing is enabled AND sync mode is on
+    (``XTPU_TRACE_SYNC=1`` or :func:`set_sync`). The paged/lossguide
+    drivers dispatch stages asynchronously, so their host spans normally
+    time the *dispatch*; ``tools/perf_report.py`` flips sync mode on so
+    those same spans time the *stage* against the roofline floors.
+    Returns ``x`` unchanged; a no-op on both the disabled and the
+    enabled-but-async paths."""
+    if _tracer is not None and _SYNC:
+        try:
+            import jax
+
+            jax.block_until_ready(x)
+        except Exception:  # pragma: no cover - non-array payloads
+            pass
+    return x
+
+
+def _default_capacity() -> int:
+    try:
+        return int(os.environ.get("XTPU_TRACE_BUF", 65536))
+    except ValueError:
+        return 65536
+
+
+_OUT = os.environ.get("XTPU_TRACE_OUT") or None
+
+if os.environ.get("XTPU_TRACE", "0") not in ("0", ""):
+    enable()
+    if _OUT:
+        import atexit
+
+        atexit.register(export, _OUT)

@@ -166,6 +166,22 @@ class ResilientCommunicator(Communicator):
         self._rng = random.Random(0xC0FFEE ^ inner.get_rank())
         self.stats: Dict[str, int] = {"ops": 0, "retries": 0, "desyncs": 0,
                                       "corruptions": 0, "timeouts": 0}
+        from ..obs.metrics import get_registry
+
+        get_registry().register(ResilientCommunicator._collect_obs,
+                                owner=self)
+
+    def _collect_obs(self):
+        """Registry collector: the stats dict as labeled counters, so a
+        serve-process scrape shows collective retry/desync rates."""
+        from ..obs.metrics import Family, Sample
+
+        return [Family(
+            "xtpu_collective_events_total", "counter",
+            "resilient-collective events by kind "
+            "(ops/retries/desyncs/corruptions/timeouts)",
+            [Sample(v, (("kind", k),))
+             for k, v in sorted(self.stats.items())])]
 
     # -- topology ------------------------------------------------------------
     def get_rank(self) -> int:
@@ -206,11 +222,18 @@ class ResilientCommunicator(Communicator):
         return box[0]
 
     def _attempts(self, fn: Callable[[], Any], what: str) -> Any:
+        from ..obs import trace as _trace
+
         pol = self.policy
         attempt = 0
+        label = current_op_label()
         while True:
             try:
-                return self._with_timeout(fn, what)
+                with _trace.span("collective/" + (label or "op"),
+                                 "collective",
+                                 {"what": what, "attempt": attempt}
+                                 if _trace.enabled() else None):
+                    return self._with_timeout(fn, what)
             except RETRYABLE_ERRORS as e:
                 retryable = True
                 err = e
@@ -221,6 +244,9 @@ class ResilientCommunicator(Communicator):
                 raise err
             delay = pol.delay(attempt, self._rng)
             self.stats["retries"] += 1
+            _trace.instant("collective/retry", "collective",
+                           {"what": what, "attempt": attempt,
+                            "delay_ms": round(delay * 1e3, 3)})
             if self._on_retry is not None:
                 self._on_retry(what, attempt, err)
             logger.warning("collective %s failed (%s); retry %d/%d in %.0f ms",

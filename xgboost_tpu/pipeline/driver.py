@@ -38,6 +38,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..obs import trace as _trace
+from ..obs.metrics import Family, Sample, get_registry
 from ..utils.checkpoint import (CheckpointConfig, _atomic_write, _crc_path,
                                 dmatrix_fingerprint, latest_valid_snapshot)
 from .chaos import PipelineFaultPlan
@@ -98,6 +100,35 @@ class Pipeline:
         self._dm = None          # live training matrix (pages 0.._next_page-1)
         self._next_page = 0      # first page NOT yet absorbed into _dm
         self._last_promotion_ms: Optional[float] = None
+        get_registry().register(Pipeline._collect_obs, owner=self)
+
+    def _collect_obs(self) -> List[Family]:
+        """Registry collector: the :meth:`status` gauges as Prometheus
+        series, so one scrape of serve's ``/metrics`` covers the loop."""
+        st = self.status()
+        gauges = [("xtpu_pipeline_pages", "durable pages in the log",
+                   st["pages"]),
+                  ("xtpu_pipeline_absorbed_pages",
+                   "pages absorbed into the live matrix",
+                   st["absorbed_pages"]),
+                  ("xtpu_pipeline_decided_epoch",
+                   "newest epoch with a committed decision",
+                   st["decided_epoch"]),
+                  ("xtpu_pipeline_active_version",
+                   "manifest's active model version (-1 when none)",
+                   st["active_version"] if st["active_version"] is not None
+                   else -1),
+                  ("xtpu_pipeline_rounds_behind",
+                   "rounds the served model trails the page log",
+                   st["rounds_behind"])]
+        fams = [Family(n, "gauge", h, [Sample(v)]) for n, h, v in gauges]
+        fams.append(Family("xtpu_pipeline_promotions_total", "counter",
+                           "committed promotions over the workdir lifetime",
+                           [Sample(st["promotions"])]))
+        fams.append(Family("xtpu_pipeline_rollbacks_total", "counter",
+                           "versions rolled back by canary/serve failures",
+                           [Sample(len(st["rolled_back"]))]))
+        return fams
 
     @staticmethod
     def _as_dmatrix(data):
@@ -149,14 +180,18 @@ class Pipeline:
         total = self.log.count()
         while self._next_page < total:
             e = self._next_page
-            self._absorb(e)
+            with _trace.span("pipeline/ingest"):
+                self._absorb(e)
             self._next_page += 1
             if e <= self.manifest.decided_epoch:
                 continue          # already committed; absorb-only replay
-            bst = self._train_epoch(e)
-            report.append(self._decide(e, bst))
+            with _trace.span("pipeline/train"):
+                bst = self._train_epoch(e)
+            with _trace.span("pipeline/decide"):
+                report.append(self._decide(e, bst))
             self._gc_snapshots(e)
-        self._sync_server()
+        with _trace.span("pipeline/sync_server"):
+            self._sync_server()
         return report
 
     # -- training ------------------------------------------------------------
@@ -341,7 +376,8 @@ class Pipeline:
             "epoch": e, "action": "promoted", "version": version,
             "rounds": (e + 1) * k, "scores": scores,
             "promotion_ms": self._last_promotion_ms}
-        canary = self._canary(e, version, bst)
+        with _trace.span("pipeline/canary"):
+            canary = self._canary(e, version, bst)
         if canary is not None:
             entry["canary"] = canary
             if canary.get("rolled_back"):

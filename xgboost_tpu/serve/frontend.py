@@ -28,7 +28,10 @@ the micro-batcher:
 
     POST /v1/predict   {"data": ..., "model":?, "output":?}
     GET  /v1/models    registry listing
-    GET  /v1/metrics   ServeMetrics snapshot (alias: /metrics)
+    GET  /v1/metrics   ServeMetrics snapshot (JSON)
+    GET  /metrics      Prometheus text exposition from the process-wide
+                       MetricsRegistry (serve + pipeline + collective
+                       counters — docs/observability.md glossary)
     GET  /healthz      liveness + versions/queue/shed counters
                        (503 once the server stops accepting)
 """
@@ -145,13 +148,30 @@ def make_http_server(server: Server, port: int,
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str, ctype: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self) -> None:  # noqa: N802 (stdlib API)
             if self.path == "/healthz":
                 # external probes and the pipeline's canary watcher read
                 # the same signals; 503 once the server stopped accepting
                 h = server.health_snapshot()
                 self._send(200 if h["status"] == "ok" else 503, h)
-            elif self.path in ("/metrics", "/v1/metrics"):
+            elif self.path == "/metrics":
+                # Prometheus text exposition from the process-wide
+                # registry: serve, pipeline, collective, ring and
+                # recompile series all land here (docs/observability.md)
+                from ..obs.metrics import get_registry
+
+                self._send_text(
+                    200, get_registry().render_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/v1/metrics":
                 self._send(200, server.metrics_snapshot())
             elif self.path == "/v1/models":
                 self._send(200, server.registry.describe())

@@ -17,9 +17,18 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
+
+from ..obs.metrics import Family, HistogramData, Sample, get_registry
 
 STAGES = ("queue", "pad", "h2d", "compute", "d2h", "e2e")
+
+# always exposed (at 0 before the first increment): pre-declared series
+# let rate()/increase() see the first real increment, and give scrape
+# consumers a stable schema to alert on
+CORE_COUNTERS = ("requests", "rows", "batches", "sheds",
+                 "deadline_exceeded", "errors", "swaps", "rollbacks",
+                 "recompiles")
 
 
 class LatencyHistogram:
@@ -91,13 +100,17 @@ class ServeMetrics:
     bucket size so ladder tuning is data-driven (docs/serving.md).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, register: bool = True) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {}
         self.bucket_hits: Dict[int, int] = {}
         self.hists: Dict[str, LatencyHistogram] = {
             s: LatencyHistogram() for s in STAGES}
         self.started_at = time.time()
+        if register:
+            # weakref registration: exposition follows live instances and
+            # a GC'd server's metrics drop out of /metrics on their own
+            get_registry().register(ServeMetrics._collect_obs, owner=self)
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -109,6 +122,19 @@ class ServeMetrics:
         ``metrics.counters[k] = v`` from another thread races them."""
         with self._lock:
             self.counters[name] = value
+
+    def get(self, name: str, default: int = 0) -> int:
+        """Locked single-counter read — the read-side twin of :meth:`set`
+        (a bare ``metrics.counters.get(k)`` from another thread races the
+        dict mutations that :meth:`inc` makes under the lock)."""
+        with self._lock:
+            return self.counters.get(name, default)
+
+    def get_many(self, names: Sequence[str]) -> Dict[str, int]:
+        """One locked read for several counters — a consistent cut, unlike
+        a sequence of :meth:`get` calls interleaved with writers."""
+        with self._lock:
+            return {n: self.counters.get(n, 0) for n in names}
 
     def hit_bucket(self, size: int, padded_rows: int) -> None:
         with self._lock:
@@ -151,3 +177,46 @@ class ServeMetrics:
         if extra:
             parts += [f"{k}={v}" for k, v in extra.items()]
         return " ".join(parts)
+
+    # ------------------------------------------------------- obs collector
+    def _collect_obs(self) -> List[Family]:
+        """Registry collector: counters as ``xtpu_serve_<name>_total``,
+        bucket hits labeled by ladder size, stage latencies as one
+        Prometheus histogram family labeled by stage."""
+        with self._lock:
+            counters = {**{k: 0 for k in CORE_COUNTERS}, **self.counters}
+            hits = dict(self.bucket_hits)
+            hist_rows = [(s, list(h.counts), h.total, h.n, h._lo, h._ratio)
+                         for s, h in self.hists.items() if h.n]
+            uptime = time.time() - self.started_at
+        fams = [
+            Family("xtpu_serve_uptime_seconds", "gauge",
+                   "seconds since ServeMetrics construction",
+                   [Sample(round(uptime, 3))]),
+        ]
+        for name, v in sorted(counters.items()):
+            fams.append(Family(f"xtpu_serve_{name}_total", "counter",
+                               f"serve counter {name!r} (docs/serving.md)",
+                               [Sample(v)]))
+        if hits:
+            fams.append(Family(
+                "xtpu_serve_bucket_hits_total", "counter",
+                "device batches per ladder bucket size",
+                [Sample(v, (("bucket", str(k)),))
+                 for k, v in sorted(hits.items())]))
+        samples = []
+        for stage, counts, total, n, lo, ratio in hist_rows:
+            cum = 0
+            buckets = []
+            for i, c in enumerate(counts[:-1]):
+                cum += c
+                buckets.append((lo * ratio ** i, cum))
+            buckets.append((math.inf, cum + counts[-1]))
+            samples.append(Sample(HistogramData(buckets, total, n),
+                                  (("stage", stage),)))
+        if samples:
+            fams.append(Family(
+                "xtpu_serve_stage_latency_seconds", "histogram",
+                "per-stage serving latency (queue/pad/h2d/compute/d2h/e2e)",
+                samples))
+        return fams

@@ -28,6 +28,8 @@ import jax
 import numpy as np
 
 from ..logging_utils import logger
+from ..obs import trace as _trace
+from ..obs.metrics import Family, Sample, get_registry
 from .batcher import MicroBatcher, PredictRequest
 from .buckets import BucketLadder, RecompileCounter
 from .errors import ServeError, ServerOverloaded
@@ -97,8 +99,22 @@ class Server:
             dispatch=self._dispatch,
             on_tick=self._maybe_log if self._next_log else None,
             on_expire=lambda n: self.metrics.inc("deadline_exceeded", n))
+        get_registry().register(Server._collect_obs, owner=self)
         for name, src in (models or {}).items():
             self.load_model(name, src)
+
+    def _collect_obs(self):
+        """Registry collector for state that lives outside ServeMetrics:
+        the recompile SLO gauge and the live queue depth."""
+        return [
+            Family("xtpu_serve_recompiles_after_warmup", "gauge",
+                   "executable-cache misses since warmup (SLO: 0)",
+                   [Sample(self.recompiles_after_warmup
+                           if self._warmed else 0)]),
+            Family("xtpu_serve_queue_rows", "gauge",
+                   "rows currently queued in the micro-batcher",
+                   [Sample(self.batcher.queue_depth_rows())]),
+        ]
 
     # ------------------------------------------------------- model lifecycle
     def load_model(self, name: str, source, *, version: Optional[int] = None,
@@ -214,16 +230,20 @@ class Server:
         (values [R, G] or None, margins [R, G]) host arrays and records
         stage latencies (skipped for warmup batches)."""
         t0 = time.perf_counter()
-        Xp = self.ladder.pad(X, bucket, self.config.pad_value)
+        with _trace.span("serve/pad"):
+            Xp = self.ladder.pad(X, bucket, self.config.pad_value)
         t1 = time.perf_counter()
-        xd = jax.block_until_ready(jax.device_put(Xp, self._device))
+        with _trace.span("serve/h2d"):
+            xd = jax.block_until_ready(jax.device_put(Xp, self._device))
         t2 = time.perf_counter()
-        margin_d = sm.margin_padded(xd)
-        value_d = sm.transform(margin_d)
-        jax.block_until_ready((margin_d, value_d))
+        with _trace.span("serve/compute"):
+            margin_d = sm.margin_padded(xd)
+            value_d = sm.transform(margin_d)
+            jax.block_until_ready((margin_d, value_d))
         t3 = time.perf_counter()
-        margin = np.asarray(margin_d)
-        value = np.asarray(value_d)
+        with _trace.span("serve/d2h"):
+            margin = np.asarray(margin_d)
+            value = np.asarray(value_d)
         t4 = time.perf_counter()
         if not warm:
             self.metrics.observe("pad", t1 - t0)
@@ -253,12 +273,14 @@ class Server:
         try:
             values, margins = [], []
             off = 0
-            for size in self.ladder.chunks(n):
-                bucket = self.ladder.bucket_for(size)
-                v, m = self._run_padded(sm, rows[off:off + size], bucket)
-                values.append(v[:size])
-                margins.append(m[:size])
-                off += size
+            with _trace.span("serve/batch", args={"rows": n}):
+                for size in self.ladder.chunks(n):
+                    bucket = self.ladder.bucket_for(size)
+                    v, m = self._run_padded(sm, rows[off:off + size],
+                                            bucket)
+                    values.append(v[:size])
+                    margins.append(m[:size])
+                    off += size
             value = np.concatenate(values) if len(values) > 1 else values[0]
             margin = (np.concatenate(margins) if len(margins) > 1
                       else margins[0])
@@ -299,19 +321,23 @@ class Server:
         probe and the pipeline's canary watcher both read — served
         versions, queue depth, and the shed/deadline/error counters whose
         RATE of change is the regression signal."""
-        c = self.metrics.counters
+        # one locked cut of the counters: reading .counters directly here
+        # raced the batcher worker's inc() mutations (the read-side twin
+        # of the _maybe_log set() race PR 6 fixed)
+        c = self.metrics.get_many(("requests", "sheds", "deadline_exceeded",
+                                   "errors", "swaps", "rollbacks"))
         return {
             "status": "closed" if self._closed else "ok",
             "warmed": self._warmed,
             "models": [{"name": m.name, "version": m.version}
                        for m in self.registry.models()],
             "queue_rows": self.batcher.queue_depth_rows(),
-            "requests": int(c.get("requests", 0)),
-            "sheds": int(c.get("sheds", 0)),
-            "deadline_exceeded": int(c.get("deadline_exceeded", 0)),
-            "errors": int(c.get("errors", 0)),
-            "swaps": int(c.get("swaps", 0)),
-            "rollbacks": int(c.get("rollbacks", 0)),
+            "requests": int(c["requests"]),
+            "sheds": int(c["sheds"]),
+            "deadline_exceeded": int(c["deadline_exceeded"]),
+            "errors": int(c["errors"]),
+            "swaps": int(c["swaps"]),
+            "rollbacks": int(c["rollbacks"]),
         }
 
     def metrics_snapshot(self) -> Dict[str, object]:

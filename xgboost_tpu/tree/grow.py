@@ -368,26 +368,33 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             # decoded splits, then one sorted ordering of the new level
             # yields BOTH its fine and coarse histograms
             row_axis = axis_name if not col_split else None
-            positions, hist_f, hist_c = scan_advance_level(
-                bins, gpair, positions, pending_adv, lo, n_level,
-                missing_bin, max_nbins=max_nbins, bins_t=bins_t,
-                method="auto", axis_name=row_axis,
-                decision_axis=axis_name if col_split else None,
-                acc=scan_acc)
-            hist_f = allreduce(hist_f)
-            hist_c = allreduce(hist_c)
+            # named_scope: stage labels on the device timeline — _grow is
+            # ONE jitted dispatch, so in-trace scopes (not host spans) are
+            # what aligns its stages with jax.profiler captures
+            with jax.named_scope("xtpu.sort"):
+                positions, hist_f, hist_c = scan_advance_level(
+                    bins, gpair, positions, pending_adv, lo, n_level,
+                    missing_bin, max_nbins=max_nbins, bins_t=bins_t,
+                    method="auto", axis_name=row_axis,
+                    decision_axis=axis_name if col_split else None,
+                    acc=scan_acc)
+            with jax.named_scope("xtpu.exchange"):
+                hist_f = allreduce(hist_f)
+                hist_c = allreduce(hist_c)
             pending_adv = None
         elif use_fused and pending_adv is not None:
             # cross-level fused sweep: advance rows below the previous
             # level's decoded splits AND build this level's coarse
             # histogram from the same bin-tile read
             row_axis = axis_name if not col_split else None
-            positions, hist_c = fused_advance_coarse(
-                bins, gpair, positions, pending_adv, lo, n_level,
-                missing_bin, bins_t=bins_t, method="auto",
-                axis_name=row_axis,
-                decision_axis=axis_name if col_split else None)
-            hist_c = allreduce(hist_c)
+            with jax.named_scope("xtpu.advance_hist"):
+                positions, hist_c = fused_advance_coarse(
+                    bins, gpair, positions, pending_adv, lo, n_level,
+                    missing_bin, bins_t=bins_t, method="auto",
+                    axis_name=row_axis,
+                    decision_axis=axis_name if col_split else None)
+            with jax.named_scope("xtpu.exchange"):
+                hist_c = allreduce(hist_c)
             pending_adv = None
 
         in_level = (positions >= lo) & (positions < lo + n_level)
@@ -398,66 +405,78 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             if use_scan and hist_f is None:
                 # root level (and any level not fed by a boundary sweep):
                 # one sorted pass builds fine + coarse together
-                hist_f, hist_c = scan_level_hists(
-                    bins, gpair, rel, n_level, max_nbins, missing_bin,
-                    bins_t=bins_t, method="auto", axis_name=row_axis,
-                    acc=scan_acc)
-                hist_f = allreduce(hist_f)
-                hist_c = allreduce(hist_c)
+                with jax.named_scope("xtpu.sort"):
+                    hist_f, hist_c = scan_level_hists(
+                        bins, gpair, rel, n_level, max_nbins, missing_bin,
+                        bins_t=bins_t, method="auto", axis_name=row_axis,
+                        acc=scan_acc)
+                with jax.named_scope("xtpu.exchange"):
+                    hist_f = allreduce(hist_f)
+                    hist_c = allreduce(hist_c)
             if hist_c is None:
-                hist_c = allreduce(build_hist(cb, gpair, rel, n_level, 20,
-                                              method="auto", bins_t=cb_t,
-                                              axis_name=row_axis))
-            span = choose_refine_window(hist_c,
-                                        node_sum[lo:lo + n_level],
-                                        n_real_bins, param,
-                                        has_missing)              # [N, F]
+                with jax.named_scope("xtpu.hist"):
+                    hist_c = allreduce(build_hist(
+                        cb, gpair, rel, n_level, 20, method="auto",
+                        bins_t=cb_t, axis_name=row_axis))
+            with jax.named_scope("xtpu.window"):
+                span = choose_refine_window(hist_c,
+                                            node_sum[lo:lo + n_level],
+                                            n_real_bins, param,
+                                            has_missing)          # [N, F]
             if use_scan:
                 # integral-histogram refine: the refine pass is an O(1)
                 # WINDOW-slice of the fine histogram already in hand —
                 # bit-equal to the direct refine build of the same rows
                 # (ops/split.py refine_from_fine docstring) — so the
                 # level needs NO second data sweep
-                hist_r = refine_from_fine(hist_f, span, missing_bin)
+                with jax.named_scope("xtpu.refine"):
+                    hist_r = refine_from_fine(hist_f, span, missing_bin)
             else:
                 # per-row window of the row's node, via one [F,N+1]@[N+1,n]
                 # MXU matmul (rows outside the level hit the zero pad row;
                 # their kernel contribution is dropped by rel == n_level)
-                span_pad = jnp.concatenate(
-                    [span.astype(jnp.float32),
-                     jnp.zeros((1, F), jnp.float32)]).T     # [F, N+1]
-                oh_rel = (rel[None, :] == jnp.arange(
-                    n_level + 1,
-                    dtype=jnp.int32)[:, None]).astype(jnp.float32)
-                c_row_t = jax.lax.dot_general(
-                    span_pad, oh_rel, (((1,), (0,)), ((), ())),
-                    precision=jax.lax.Precision.HIGHEST)    # [F, n]
-                # out-of-window sentinel (refine_bin_ids) must be a VALID
-                # slot of the kernel — the flat-index segment path would
-                # bleed an out-of-range id into the next feature's bins;
-                # the pad slots of the WINDOW+4-wide pass are discarded
-                from ..ops.split import WINDOW
-                rb_t = refine_bin_ids(bins_t.astype(jnp.int32),
-                                      c_row_t.astype(jnp.int32), missing_bin)
-                hist_r = allreduce(build_hist(
-                    rb_t.T, gpair, rel, n_level, WINDOW + 4, method="auto",
-                    bins_t=rb_t, axis_name=row_axis))[:, :, :WINDOW, :]
+                with jax.named_scope("xtpu.refine"):
+                    span_pad = jnp.concatenate(
+                        [span.astype(jnp.float32),
+                         jnp.zeros((1, F), jnp.float32)]).T  # [F, N+1]
+                    oh_rel = (rel[None, :] == jnp.arange(
+                        n_level + 1,
+                        dtype=jnp.int32)[:, None]).astype(jnp.float32)
+                    c_row_t = jax.lax.dot_general(
+                        span_pad, oh_rel, (((1,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST)    # [F, n]
+                    # out-of-window sentinel (refine_bin_ids) must be a
+                    # VALID slot of the kernel — the flat-index segment
+                    # path would bleed an out-of-range id into the next
+                    # feature's bins; the pad slots of the WINDOW+4-wide
+                    # pass are discarded
+                    from ..ops.split import WINDOW
+                    rb_t = refine_bin_ids(bins_t.astype(jnp.int32),
+                                          c_row_t.astype(jnp.int32),
+                                          missing_bin)
+                    hist_r = allreduce(build_hist(
+                        rb_t.T, gpair, rel, n_level, WINDOW + 4,
+                        method="auto", bins_t=rb_t,
+                        axis_name=row_axis))[:, :, :WINDOW, :]
             hist, n_real_eval = assemble_two_level(
                 hist_c, hist_r, span, n_real_bins, has_missing)
         elif depth == 0 or not use_compaction:
-            if use_prehot:
-                hist = build_hist_prehot(
-                    oh_pre, gpair, rel, n_level, max_nbins,
-                    axis_name=axis_name if not col_split else None)
-            else:
-                hist = build_hist(
-                    bins, gpair, rel, n_level, max_nbins,
-                    method=hist_kernel, bins_t=bins_t,
-                    # int8x2 quantisation scale must be pmax'd across row
-                    # shards so every shard quantises identically (col
-                    # split replicates rows — local scale is already global)
-                    axis_name=axis_name if not col_split else None)
-            hist = allreduce(hist)
+            with jax.named_scope("xtpu.hist"):
+                if use_prehot:
+                    hist = build_hist_prehot(
+                        oh_pre, gpair, rel, n_level, max_nbins,
+                        axis_name=axis_name if not col_split else None)
+                else:
+                    hist = build_hist(
+                        bins, gpair, rel, n_level, max_nbins,
+                        method=hist_kernel, bins_t=bins_t,
+                        # int8x2 quantisation scale must be pmax'd across
+                        # row shards so every shard quantises identically
+                        # (col split replicates rows — local scale is
+                        # already global)
+                        axis_name=axis_name if not col_split else None)
+            with jax.named_scope("xtpu.exchange"):
+                hist = allreduce(hist)
         else:
             n_parents = n_level // 2
             child = positions - lo
@@ -502,15 +521,16 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             fmask = fmask & allowed
 
         parent_sum = node_sum[lo:lo + n_level]
-        res = evaluate_splits(
-            hist, parent_sum,
-            n_real_eval if use_coarse else n_real_bins, param,
-            feature_mask=fmask, monotone=mono_loc,
-            node_lower=node_lower[lo:lo + n_level]
-            if monotone is not None else None,
-            node_upper=node_upper[lo:lo + n_level]
-            if monotone is not None else None,
-            cat=cat_loc, has_missing=has_missing)
+        with jax.named_scope("xtpu.eval"):
+            res = evaluate_splits(
+                hist, parent_sum,
+                n_real_eval if use_coarse else n_real_bins, param,
+                feature_mask=fmask, monotone=mono_loc,
+                node_lower=node_lower[lo:lo + n_level]
+                if monotone is not None else None,
+                node_upper=node_upper[lo:lo + n_level]
+                if monotone is not None else None,
+                cat=cat_loc, has_missing=has_missing)
         if use_coarse:
             # synthetic slot -> fine bin, per node's span for its feature
             span_sel = jnp.take_along_axis(
@@ -522,8 +542,9 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             local_feat, local_bin = res.feature, res.bin
             local_dl = res.default_left
             local_is_cat, local_words = res.is_cat, res.cat_words
-            res, mine = exchange_best_split(res, axis_name, F,
-                                            with_cat=cat is not None)
+            with jax.named_scope("xtpu.exchange"):
+                res, mine = exchange_best_split(res, axis_name, F,
+                                                with_cat=cat is not None)
 
         # a node exists at this level iff its parent split; it expands unless
         # the best gain fails the gamma / kRtEps test (reference prune rule).
@@ -617,37 +638,41 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
             # reference's partition-bitvector broadcast). Categorical
             # routing stays owner-local: the owner's bins hold the split
             # feature, so its local cat bitmask words decide
-            positions = advance_positions_level(
-                bins_f32, positions, rel,
-                jnp.where(can_split & mine, local_feat, -1),
-                jnp.where(can_split & mine, local_bin, 0),
-                can_split & mine & local_dl, can_split, missing_bin,
-                is_cat=(can_split & mine & local_is_cat)
-                if cat is not None else None,
-                cat_words=jnp.where(
-                    (mine & local_is_cat)[:, None], local_words,
-                    jnp.uint32(0)) if cat is not None else None,
-                decision_axis=axis_name)
+            with jax.named_scope("xtpu.advance"):
+                positions = advance_positions_level(
+                    bins_f32, positions, rel,
+                    jnp.where(can_split & mine, local_feat, -1),
+                    jnp.where(can_split & mine, local_bin, 0),
+                    can_split & mine & local_dl, can_split, missing_bin,
+                    is_cat=(can_split & mine & local_is_cat)
+                    if cat is not None else None,
+                    cat_words=jnp.where(
+                        (mine & local_is_cat)[:, None], local_words,
+                        jnp.uint32(0)) if cat is not None else None,
+                    decision_axis=axis_name)
         elif n_level <= DENSE_LEVEL_MAX:
-            positions = advance_positions_level(
-                bins_f32, positions, rel,
-                jnp.where(can_split, res.feature, -1),
-                jnp.where(can_split, res.bin, 0),
-                can_split & res.default_left, can_split, missing_bin,
-                is_cat=(can_split & res.is_cat) if cat is not None else None,
-                cat_words=res.cat_words if cat is not None else None)
+            with jax.named_scope("xtpu.advance"):
+                positions = advance_positions_level(
+                    bins_f32, positions, rel,
+                    jnp.where(can_split, res.feature, -1),
+                    jnp.where(can_split, res.bin, 0),
+                    can_split & res.default_left, can_split, missing_bin,
+                    is_cat=(can_split & res.is_cat)
+                    if cat is not None else None,
+                    cat_words=res.cat_words if cat is not None else None)
         else:  # deep level: per-row gather walk bounds memory to O(n);
             # under col split the walk resolves only owned nodes and one
             # psum broadcasts the decisions (update_positions docstring)
             is_split_full = jnp.zeros((max_nodes,), bool).at[idx].set(
                 can_split)
-            positions = update_positions(
-                bins, positions, split_feature, split_bin, default_left,
-                is_split_full, missing_bin,
-                is_cat_split=is_cat_split if cat is not None else None,
-                cat_words=cat_words if cat is not None else None,
-                decision_axis=axis_name if col_split else None,
-                feat_offset=feat_off)
+            with jax.named_scope("xtpu.advance"):
+                positions = update_positions(
+                    bins, positions, split_feature, split_bin, default_left,
+                    is_split_full, missing_bin,
+                    is_cat_split=is_cat_split if cat is not None else None,
+                    cat_words=cat_words if cat is not None else None,
+                    decision_axis=axis_name if col_split else None,
+                    feat_offset=feat_off)
 
         if use_compaction and depth + 1 < max_depth:
             # next level's per-node row counts pick each parent's smaller
@@ -665,21 +690,22 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     if (use_fused or use_scan) and pending_adv is not None:
         # epilogue: route rows below the deepest level's splits — advance
         # only, there is no next coarse pass left to fuse with
-        if pending_adv["kind"] == "dense":
-            lo_p, nl_p = pending_adv["lo"], pending_adv["n_level"]
-            feat_v, bin_v, dl_v, cs_v = pending_adv["arrs"]
-            rel_p = jnp.where(
-                (positions >= lo_p) & (positions < lo_p + nl_p),
-                positions - lo_p, nl_p).astype(jnp.int32)
-            positions = advance_positions_level(
-                bins.astype(jnp.float32), positions, rel_p, feat_v, bin_v,
-                dl_v, cs_v, missing_bin,
-                decision_axis=axis_name if col_split else None)
-        else:
-            positions = update_positions(
-                bins, positions, *pending_adv["arrs"], missing_bin,
-                decision_axis=axis_name if col_split else None,
-                feat_offset=feat_off)
+        with jax.named_scope("xtpu.advance"):
+            if pending_adv["kind"] == "dense":
+                lo_p, nl_p = pending_adv["lo"], pending_adv["n_level"]
+                feat_v, bin_v, dl_v, cs_v = pending_adv["arrs"]
+                rel_p = jnp.where(
+                    (positions >= lo_p) & (positions < lo_p + nl_p),
+                    positions - lo_p, nl_p).astype(jnp.int32)
+                positions = advance_positions_level(
+                    bins.astype(jnp.float32), positions, rel_p, feat_v,
+                    bin_v, dl_v, cs_v, missing_bin,
+                    decision_axis=axis_name if col_split else None)
+            else:
+                positions = update_positions(
+                    bins, positions, *pending_adv["arrs"], missing_bin,
+                    decision_axis=axis_name if col_split else None,
+                    feat_offset=feat_off)
 
     w = calc_weight(node_sum[:, 0], node_sum[:, 1], param)
     if monotone is not None:

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..context import shard_map as _shard_map
+from ..obs import trace as _trace
 from ..ops.histogram import build_hist, scan_level_hists
 from ..ops.partition import cat_goes_right
 from ..ops.split import CatInfo, evaluate_splits
@@ -666,7 +667,9 @@ class LossguideGrower:
                 ids = [i for i in ids if depth_of[i] < param.max_depth]
             if not ids:
                 if apply_args is not None:
-                    positions = apply1(bins, positions, *apply_args)
+                    with _trace.span("lossguide/apply"):
+                        positions = apply1(bins, positions, *apply_args)
+                        _trace.sync(positions)
                 return
             i0 = ids[0]
             i1 = ids[1] if len(ids) > 1 else -1
@@ -685,23 +688,30 @@ class LossguideGrower:
             if apply_args is not None and apply_eval is not None:
                 # siblings share a depth, so the filter kept both: i0/i1
                 # ARE the advance's fresh children
-                positions, res = apply_eval(
-                    bins, gpair, positions, *apply_args,
-                    jnp.asarray(psums), jnp.asarray(fm), lowers, uppers,
-                    n_real_bins, bins_t, cb_t)
+                with _trace.span("lossguide/apply_eval"):
+                    positions, res = apply_eval(
+                        bins, gpair, positions, *apply_args,
+                        jnp.asarray(psums), jnp.asarray(fm), lowers,
+                        uppers, n_real_bins, bins_t, cb_t)
+                    _trace.sync(res)
             else:
                 if apply_args is not None:
-                    positions = apply1(bins, positions, *apply_args)
-                res = eval2(bins, gpair, positions, np.int32(i0),
-                            np.int32(i1), jnp.asarray(psums),
-                            jnp.asarray(fm), lowers, uppers,
-                            n_real_bins, bins_t, cb_t)
+                    with _trace.span("lossguide/apply"):
+                        positions = apply1(bins, positions, *apply_args)
+                        _trace.sync(positions)
+                with _trace.span("lossguide/eval"):
+                    res = eval2(bins, gpair, positions, np.int32(i0),
+                                np.int32(i1), jnp.asarray(psums),
+                                jnp.asarray(fm), lowers, uppers,
+                                n_real_bins, bins_t, cb_t)
+                    _trace.sync(res)
             # ONE packed device->host pull for the whole SplitResult —
             # a per-field np.asarray costs 8 blocking round trips per
             # split against a remote-device tunnel
             from ..utils.fetch import fetch_struct
 
-            res = fetch_struct(res)
+            with _trace.span("lossguide/fetch"):
+                res = fetch_struct(res)
             gain = np.asarray(res.gain)
             feat = np.asarray(res.feature)
             rbin = np.asarray(res.bin)

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..context import shard_map as _shard_map
+from ..obs import trace as _trace
 from ..ops.histogram import build_hist
 from ..ops.partition import advance_positions_level, update_positions
 from ..ops.split import evaluate_splits
@@ -1594,38 +1595,73 @@ class PagedGrower(TreeGrower):
             else:
                 cached, streamed = paged.cached_split_mesh(self._mk.world)
             distributed = _coll.get_communicator().is_distributed()
+            # Host spans per stage: this loop is the one place tree
+            # growth has REAL host-visible stage boundaries (the resident
+            # path is one jitted dispatch, labeled with named_scope
+            # instead). Async dispatches mean a span times the dispatch
+            # unless _trace.sync() is armed (perf_report measurement
+            # mode) — then each span times its stage wall-clock.
             if single_dev and cached and not streamed and not distributed:
-                positions, stash, state, prev = self._mk.level_full(
-                    paged, gpair, positions, prev, lo, n_level, n_static,
-                    self._ev, state, tree_mask, key, depth, cached)
+                with _trace.span("paged/level_full",
+                                 args={"depth": depth}
+                                 if _trace.enabled() else None):
+                    positions, stash, state, prev = self._mk.level_full(
+                        paged, gpair, positions, prev, lo, n_level,
+                        n_static, self._ev, state, tree_mask, key, depth,
+                        cached)
+                    _trace.sync(stash)
             elif self._coarse:
-                positions, hist_c, fine = self._mk.coarse_pass(
-                    paged, gpair, positions, prev, lo, n_level, n_static,
-                    cached, streamed)
-                hist_c = _host_allreduce(hist_c)
+                with _trace.span("paged/hist",
+                                 args={"depth": depth}
+                                 if _trace.enabled() else None):
+                    positions, hist_c, fine = self._mk.coarse_pass(
+                        paged, gpair, positions, prev, lo, n_level,
+                        n_static, cached, streamed)
+                    _trace.sync(hist_c)
+                with _trace.span("paged/exchange"):
+                    hist_c = _host_allreduce(hist_c)
                 # node-level window choice from the GLOBAL coarse hist
                 # (allreduced above, so every host/shard refines the same
                 # windows); cached pages re-read HBM for the refine,
                 # streamed pages' refine comes from their fine partials
-                span = self._ev.choose_window(hist_c, state)
-                hist_r = _host_allreduce(self._mk.refine_pass(
-                    paged, gpair, positions, span, lo, n_level, n_static,
-                    cached, fine=fine))
-                stash, state, prev = self._ev(
-                    (hist_c, hist_r, span), state, tree_mask, key,
-                    jnp.int32(depth), jnp.int32(lo), jnp.int32(n_level))
+                with _trace.span("paged/window"):
+                    span = self._ev.choose_window(hist_c, state)
+                    _trace.sync(span)
+                with _trace.span("paged/refine",
+                                 args={"depth": depth}
+                                 if _trace.enabled() else None):
+                    hist_r = self._mk.refine_pass(
+                        paged, gpair, positions, span, lo, n_level,
+                        n_static, cached, fine=fine)
+                    _trace.sync(hist_r)
+                with _trace.span("paged/exchange"):
+                    hist_r = _host_allreduce(hist_r)
+                with _trace.span("paged/eval"):
+                    stash, state, prev = self._ev(
+                        (hist_c, hist_r, span), state, tree_mask, key,
+                        jnp.int32(depth), jnp.int32(lo),
+                        jnp.int32(n_level))
+                    _trace.sync(stash)
             else:
-                if prev is None:
-                    hist = self._mk.level_hist(paged, gpair, positions,
-                                               lo, n_level, n_static)
-                else:
-                    positions, hist = self._mk.adv_hist(
-                        paged, gpair, positions, prev, lo, n_level,
-                        n_static)
-                hist = _host_allreduce(hist)
-                stash, state, prev = self._ev(
-                    hist, state, tree_mask, key, jnp.int32(depth),
-                    jnp.int32(lo), jnp.int32(n_level))
+                with _trace.span("paged/hist",
+                                 args={"depth": depth}
+                                 if _trace.enabled() else None):
+                    if prev is None:
+                        hist = self._mk.level_hist(paged, gpair,
+                                                   positions, lo, n_level,
+                                                   n_static)
+                    else:
+                        positions, hist = self._mk.adv_hist(
+                            paged, gpair, positions, prev, lo, n_level,
+                            n_static)
+                    _trace.sync(hist)
+                with _trace.span("paged/exchange"):
+                    hist = _host_allreduce(hist)
+                with _trace.span("paged/eval"):
+                    stash, state, prev = self._ev(
+                        hist, state, tree_mask, key, jnp.int32(depth),
+                        jnp.int32(lo), jnp.int32(n_level))
+                    _trace.sync(stash)
             stashes.append(stash)
             # ONE-BEHIND early stop: the previous level's eval finished
             # long before this level's page passes were even dispatched, so
@@ -1638,11 +1674,14 @@ class PagedGrower(TreeGrower):
                 prev = None
                 break
         if prev is not None:  # route rows below the deepest splits
-            positions = self._mk.final_advance(paged, positions, prev,
-                                               n_static)
+            with _trace.span("paged/advance"):
+                positions = self._mk.final_advance(paged, positions, prev,
+                                                   n_static)
+                _trace.sync(positions)
 
         # ---- host bookkeeping replay (one packed pull for the tree) ----
-        fetched = fetch_packed(stashes + [{"root": root_sum}])
+        with _trace.span("paged/fetch"):
+            fetched = fetch_packed(stashes + [{"root": root_sum}])
         split_feature = np.full(max_nodes, -1, np.int32)
         split_bin = np.zeros(max_nodes, np.int32)
         default_left = np.zeros(max_nodes, bool)
@@ -1850,14 +1889,20 @@ class PagedMultiTargetGrower(MultiTargetGrower):
             lo = 2 ** depth - 1
             n_level = 2 ** depth
 
-            if prev is None:
-                hist = self._mk.level_hist(paged, gpair, positions, lo,
-                                           n_level, n_static, multi=True)
-            else:
-                positions, hist = self._mk.adv_hist(
-                    paged, gpair, positions, prev, lo, n_level, n_static,
-                    multi=True)
-            hist = _host_allreduce(hist)
+            with _trace.span("paged/hist",
+                             args={"depth": depth}
+                             if _trace.enabled() else None):
+                if prev is None:
+                    hist = self._mk.level_hist(paged, gpair, positions,
+                                               lo, n_level, n_static,
+                                               multi=True)
+                else:
+                    positions, hist = self._mk.adv_hist(
+                        paged, gpair, positions, prev, lo, n_level,
+                        n_static, multi=True)
+                _trace.sync(hist)
+            with _trace.span("paged/exchange"):
+                hist = _host_allreduce(hist)
 
             level_key = jax.random.fold_in(key, depth)
             fmask_level = _sample_features(level_key, tree_mask,
@@ -1888,11 +1933,12 @@ class PagedMultiTargetGrower(MultiTargetGrower):
 
             parent_pad = np.zeros((n_static, K, 2), np.float32)
             parent_pad[:n_level] = node_sum[lo:lo + n_level]
-            res = evaluate_splits_multi(hist, jnp.asarray(parent_pad),
-                                        jnp.asarray(n_real), param,
-                                        feature_mask=fmask,
-                                        has_missing=self.has_missing)
-            res = fetch_struct(res)  # ONE packed pull of the decisions
+            with _trace.span("paged/eval"):
+                res = evaluate_splits_multi(hist, jnp.asarray(parent_pad),
+                                            jnp.asarray(n_real), param,
+                                            feature_mask=fmask,
+                                            has_missing=self.has_missing)
+                res = fetch_struct(res)  # ONE packed pull of decisions
 
             res_gain = np.asarray(res.gain)[:n_level]
             can_split = (active[lo:lo + n_level]
@@ -1932,8 +1978,10 @@ class PagedMultiTargetGrower(MultiTargetGrower):
                 default_left, max_nodes, lo)
 
         if prev is not None:  # route rows below the deepest splits
-            positions = self._mk.final_advance(paged, positions, prev,
-                                               n_static)
+            with _trace.span("paged/advance"):
+                positions = self._mk.final_advance(paged, positions, prev,
+                                                   n_static)
+                _trace.sync(positions)
 
         w = np.asarray(calc_weight(jnp.asarray(node_sum[..., 0]),
                                    jnp.asarray(node_sum[..., 1]),

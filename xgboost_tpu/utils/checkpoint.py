@@ -341,10 +341,15 @@ class SnapshotWriter:
     def submit(self, directory: str, snap: TrainingSnapshot, name: str,
                keep: Optional[int]) -> None:
         def work() -> None:
+            from ..obs import trace as _trace
+
             try:
-                write_snapshot(directory, snap, name)
-                if keep is not None:
-                    prune_snapshots(directory, keep, name)
+                with _trace.span("checkpoint/write",
+                                 args={"round": snap.round}
+                                 if _trace.enabled() else None):
+                    write_snapshot(directory, snap, name)
+                    if keep is not None:
+                        prune_snapshots(directory, keep, name)
             except BaseException as e:  # noqa: BLE001 - surfaced at flush
                 with self._lock:
                     self.last_error = e
@@ -355,10 +360,13 @@ class SnapshotWriter:
             self._pending.append(self._ex.submit(work))
 
     def flush(self, raise_errors: bool = False) -> None:
+        from ..obs import trace as _trace
+
         with self._lock:
             pending, self._pending = self._pending, []
-        for f in pending:
-            f.result()
+        with _trace.span("checkpoint/flush"):
+            for f in pending:
+                f.result()
         if raise_errors:
             with self._lock:
                 err, self.last_error = self.last_error, None

@@ -1,105 +1,14 @@
-"""Per-label wall-clock accumulators (reference ``common::Monitor``,
-``src/common/timer.h:16,46``): every phase of a boosting iteration is wrapped
-in ``monitor.start(label)`` / ``stop(label)`` pairs and the accumulated
-totals print at verbosity >= 3, exactly like the reference's
-``--verbosity=3`` per-class timing tables. On TPU the device work is
-asynchronous, so these timers measure host-side dispatch unless the caller
-blocks; pair with ``jax.profiler`` traces for on-device timelines."""
+"""Compat re-export: the per-label wall-clock ``Monitor`` lives in
+:mod:`xgboost_tpu.obs.monitor` now (this module and ``logging_utils``
+used to carry one copy each). Import from here keeps working; new code
+should import from ``xgboost_tpu.obs``. The unified Monitor adds the
+opt-in ``sync=True`` mode — ``section(label)`` yields an object whose
+``sync_on(x)`` makes ``stop()`` block until ``x`` is device-ready, so
+verbosity>=3 tables can measure device work instead of async dispatch.
+"""
 
 from __future__ import annotations
 
-import time
-from typing import Dict
+from ..obs.monitor import Monitor, Timer, annotate, profile
 
-
-class Timer:
-    __slots__ = ("elapsed", "count", "_start")
-
-    def __init__(self) -> None:
-        self.elapsed = 0.0
-        self.count = 0
-        self._start = 0.0
-
-    def start(self) -> None:
-        self._start = time.perf_counter()
-
-    def stop(self) -> None:
-        self.elapsed += time.perf_counter() - self._start
-        self.count += 1
-
-
-class Monitor:
-    """Label -> Timer map with a context-manager shorthand."""
-
-    def __init__(self, name: str = "") -> None:
-        self.name = name
-        self.timers: Dict[str, Timer] = {}
-
-    def start(self, label: str) -> None:
-        self.timers.setdefault(label, Timer()).start()
-
-    def stop(self, label: str) -> None:
-        self.timers[label].stop()
-
-    class _Section:
-        __slots__ = ("mon", "label")
-
-        def __init__(self, mon: "Monitor", label: str) -> None:
-            self.mon = mon
-            self.label = label
-
-        def __enter__(self):
-            self.mon.start(self.label)
-
-        def __exit__(self, *exc):
-            self.mon.stop(self.label)
-            return False
-
-    def section(self, label: str) -> "_Section":
-        return Monitor._Section(self, label)
-
-    def report(self) -> str:
-        lines = [f"======== Monitor ({self.name}) ========"]
-        for label, t in sorted(self.timers.items()):
-            lines.append(f"{label}: {t.elapsed * 1e3:.3f}ms, "
-                         f"{t.count} calls @ "
-                         f"{t.elapsed / max(t.count, 1) * 1e6:.1f}us")
-        return "\n".join(lines)
-
-    def maybe_print(self) -> None:
-        """Print the table when global verbosity >= 3 (reference prints from
-        the Monitor destructor under the same condition)."""
-        from ..config import get_config
-
-        if get_config().get("verbosity", 1) >= 3 and self.timers:
-            print(self.report())
-
-
-def annotate(label: str):
-    """Named range on the device timeline (the reference's NVTX ranges,
-    ``src/common/timer.h:52`` under ``USE_NVTX``): shows up in
-    ``jax.profiler`` traces. Usable as a context manager."""
-    import jax
-
-    return jax.profiler.TraceAnnotation(label)
-
-
-class profile:
-    """Capture a device profile around a block (reference: nvprof/NVTX
-    workflow): ``with profile("/tmp/trace"): bst = train(...)`` writes a
-    TensorBoard-loadable trace of every XLA kernel."""
-
-    def __init__(self, log_dir: str) -> None:
-        self.log_dir = log_dir
-
-    def __enter__(self):
-        import jax
-
-        jax.profiler.start_trace(self.log_dir)
-        return self
-
-    def __exit__(self, *exc):
-        import jax
-
-        jax.profiler.stop_trace()
-        return False
+__all__ = ["Timer", "Monitor", "annotate", "profile"]
