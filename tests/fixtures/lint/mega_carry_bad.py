@@ -1,0 +1,52 @@
+"""Known-bad twin for the r14 megakernel carry discipline.
+
+The whole point of ``hist_method="mega"`` is that the per-tree level
+loop never touches the host: every level is one iteration of an
+in-program ``fori_loop`` over bounded-shape carries. The two
+anti-patterns that quietly reintroduce the per-level overhead the
+megakernel deletes: a device->host pull inside the level loop (one
+blocking round-trip per level — host-sync), and donating a carry
+buffer into the per-level program without rebinding the name, so the
+next iteration hands XLA a destroyed buffer (donation-misuse).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def advance_level(carry, hist):
+    return carry + jnp.sum(hist)
+
+
+def grow_tree_host_loop(hists, max_depth):
+    # per-level scalar pull to decide the next level on the host
+    gains = []
+    for depth in range(max_depth):
+        best = jnp.max(hists[depth])
+        gains.append(best.item())  # LINT[host-sync]
+    return gains
+
+
+def level_loop_blocking(carry, max_depth):
+    depth = 0
+    while depth < max_depth:
+        carry = carry * 2
+        carry.block_until_ready()  # LINT[host-sync]
+        depth += 1
+    return carry
+
+
+def donate_carry_in_loop(carry, hists):
+    # the donated carry is never rebound: iteration 2 passes a buffer
+    # XLA already destroyed in iteration 1
+    for h in hists:
+        advance_level(carry, h)  # LINT[donation-misuse]
+    return None
+
+
+def use_carry_after_donate(carry, hist):
+    out = advance_level(carry, hist)
+    return out + carry  # LINT[donation-misuse]

@@ -1,0 +1,42 @@
+"""Known-good twin for the r14 megakernel carry discipline.
+
+The fixed shapes: the level loop lives INSIDE one jitted program as a
+``fori_loop`` over bounded carries (``(gain, n_level)`` here, standing
+in for tree/grow.py ``_mega_body``'s carry tuple) so nothing crosses
+the host boundary until the tree is done — then ONE batched pull; and
+every donating call rebinds its carry slot in the same statement.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def grow_tree_megakernel(hists, max_depth):
+    def body(depth, carry):
+        gain, n_level = carry
+        level = jax.lax.dynamic_index_in_dim(hists, depth, 0,
+                                             keepdims=False)
+        return gain + jnp.max(level), n_level * 2
+
+    return jax.lax.fori_loop(0, max_depth, body,
+                             (jnp.float32(0.0), jnp.int32(1)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def advance_round(margin, delta):
+    return margin + delta
+
+
+def boosting_loop(margin, deltas):
+    for d in deltas:
+        margin = advance_round(margin, d)  # rebound: safe to donate
+    return margin
+
+
+def fetch_tree(hists, max_depth):
+    gain, n_level = grow_tree_megakernel(hists, max_depth)
+    # one host pull for the finished tree, not one per level
+    return float(gain), int(n_level)

@@ -238,14 +238,25 @@ def test_counting_sort_block_layout(n, n_nodes, R):
         assert np.all(np.diff(rows) > 0)
 
 
-def test_counting_sort_order_is_stable_identity_for_one_node():
-    """n_nodes=1 (the root level): all real keys are equal, so the stable
-    sort is the identity — the op skips the sort outright (this is also
-    what keeps shard_map's replication checker off the constant-input
-    sort primitive at the root, ops/partition.py)."""
+def test_counting_sort_order_for_one_node():
+    """n_nodes=1 (the root level) takes the sort-free cumsum path (no sort
+    primitive, so the root works under shard_map's replication checker and
+    inside the megakernel fori_loop body, ops/partition.py) but must keep
+    the SAME contract as the general path: active rows first in original
+    order, inactive strays (rel == n_nodes) last in original order.
+    (r14 fix: the old shortcut returned the identity, leaving strays
+    interleaved with node-0 rows.)"""
+    # all rows active: the stable grouping IS the identity
+    rel = jnp.asarray(np.zeros(6, np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(counting_sort_by_node(rel, 1)), np.arange(6))
+    # mixed strays: node-0 rows first, strays last, both in row order —
+    # bitwise the stable argsort the general path produces
     rel = jnp.asarray(np.array([0, 1, 0, 0, 1, 0], np.int32))
     order = np.asarray(counting_sort_by_node(rel, 1))
-    np.testing.assert_array_equal(order, np.arange(6))
+    np.testing.assert_array_equal(order, np.array([0, 2, 3, 5, 1, 4]))
+    np.testing.assert_array_equal(
+        order, np.argsort(np.array([0, 1, 0, 0, 1, 0]), kind="stable"))
 
 
 # ---- split accumulators (bf16 head + f32 fix-up) ------------------------

@@ -222,6 +222,54 @@ def measure_overhead(rows: int = 200_000, features: int = 28,
     return max(0.0, (traced - base) / base * 100.0)
 
 
+def mega_floor_ms(rows: int, features: int, depth: int) -> float:
+    """Floor for ONE megakernel round: the scan schedule's floor exactly
+    (the fori_loop body runs the same passes — tools/roofline.py mega)."""
+    return sum(c["floor"]
+               for _, _, ps in roofline.schedule(rows, features, depth,
+                                                 "scan")
+               for c in ps.values()) * 1e3
+
+
+def measure_mega_round(rows: int = 200_000, features: int = 28,
+                       depth: int = 6, rounds: int = 8) -> float:
+    """Steady ms/round of the resident megakernel tier. The whole tree
+    is ONE compiled program — there are no intra-tree host span
+    boundaries to decompose (docs/observability.md r14), so the mega
+    row joins the WHOLE round against the mega floor."""
+    import numpy as np
+
+    import jax
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(rows, features).astype(np.float32)
+    y = (X @ rng.randn(features) > 0).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "max_depth": depth,
+              "eta": 0.1, "max_bin": 256, "hist_method": "mega"}
+    bst = xgb.train(params, dm, 2, verbose_eval=False)  # bin + compile
+    state = next(iter(bst._caches.values()))
+    jax.block_until_ready(state["margin"])
+    t0 = time.perf_counter()
+    for it in range(2, 2 + rounds):
+        bst.update(dm, it)
+    jax.block_until_ready(state["margin"])
+    return (time.perf_counter() - t0) / rounds * 1e3
+
+
+def mega_report(rows: int = 200_000, features: int = 28,
+                depth: int = 6, rounds: int = 8) -> dict:
+    """Whole-round megakernel row in drift_rows shape (one dict)."""
+    ms = measure_mega_round(rows, features, depth, rounds)
+    floor = mega_floor_ms(rows, features, depth)
+    return {"stage": "mega/round", "measured_ms": round(ms, 3),
+            "floor_ms": round(floor, 3),
+            "util": None if ms <= 0 else round(floor / ms, 6),
+            "drift_x": None if floor <= 0 else round(ms / floor, 1),
+            "spans": rounds}
+
+
 def drift_rows(measured: Dict[str, Dict[str, float]],
                floors: Dict[str, float]):
     """Join measured stages to floors -> table rows, floored stages
@@ -288,16 +336,26 @@ def main():
     ap.add_argument("--skip-overhead", action="store_true",
                     help="stage table only (the overhead check retrains "
                          "the resident path 5x)")
+    ap.add_argument("--skip-mega", action="store_true",
+                    help="omit the resident megakernel whole-round row")
     args = ap.parse_args()
 
     rep = stage_report(args.rows, args.features, args.depth, args.rounds,
                        args.pages)
-    print(render_markdown(
-        rep["rows"],
-        f"measured vs roofline — {args.rows / 1e6:g}M x {args.features}, "
-        f"depth {args.depth} (streamed paged proxy)"))
-
+    table = list(rep["rows"])
     out = dict(rep["keys"])
+    if not args.skip_mega:
+        # r14: the megakernel has no host stage boundaries inside a tree
+        # — one whole-round row against the mega (== scan) floor
+        mr = mega_report(args.rows, args.features, args.depth)
+        table.append(mr)
+        out["higgs_stage_mega_round_ms"] = mr["measured_ms"]
+        out["mega_round_drift_x"] = mr["drift_x"]
+    print(render_markdown(
+        table,
+        f"measured vs roofline — {args.rows / 1e6:g}M x {args.features}, "
+        f"depth {args.depth} (streamed paged proxy; mega row = resident "
+        f"whole round)"))
     if not args.skip_overhead:
         out["obs_overhead_pct"] = round(measure_overhead(
             args.rows, args.features, args.depth,

@@ -57,6 +57,15 @@ _LEARNER_KEYS = {
 import functools as _functools
 
 
+@_functools.partial(jax.jit, static_argnames=("n_valid",))
+def _margin_bad_rows(margin, n_valid: int):
+    """The NaN-guard reduction as ONE compiled program (op-by-op eager
+    jnp here would cost several extra launches per fused round, breaking
+    the megakernel tier's <=2-dispatch-per-round budget —
+    tests/test_mega.py pins the count)."""
+    return jnp.sum(~jnp.isfinite(margin[:n_valid]).all(axis=-1))
+
+
 def _check_margin_finite(margin, n_valid: int, objective: str,
                          first_round: int, n_rounds: int = 1) -> None:
     """Post-round half of the NaN guard for the TRACED gradient paths
@@ -70,7 +79,7 @@ def _check_margin_finite(margin, n_valid: int, objective: str,
 
     if _nan_policy() != "raise":
         return
-    bad = int(jnp.sum(~jnp.isfinite(margin[:n_valid]).all(axis=-1)))
+    bad = int(_margin_bad_rows(margin, n_valid))
     if not bad:
         return
     where = (f"round {first_round}" if n_rounds == 1 else
@@ -439,12 +448,12 @@ class Booster:
                 "or the dart booster (the reference rejects both for "
                 "vector-leaf trees)")
         if self.learner_params.get("hist_method") in ("coarse", "fused",
-                                                      "scan") \
+                                                      "scan", "mega") \
                 and (tm in ("approx", "exact")
                      or ms == "multi_output_tree"):
             raise NotImplementedError(
-                "hist_method='coarse'/'fused'/'scan' supports the hist "
-                "updaters (depthwise or lossguide, resident or "
+                "hist_method='coarse'/'fused'/'scan'/'mega' supports the "
+                "hist updaters (depthwise or lossguide, resident or "
                 "external-memory depthwise) with scalar trees only")
         dsm = self.learner_params.get("data_split_mode", "row")
         if dsm not in ("row", "col"):

@@ -21,6 +21,7 @@ f32 accumulation already gives run-to-run reproducible histograms.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -76,8 +77,10 @@ def _segment_hist_acc(bins: jnp.ndarray, gpair: jnp.ndarray,
     sum is the fix-up pass — the recombined result carries f32-class
     error, not bf16-class (tests/test_scan_hist.py pins the bound).
     Opt-in via ``XTPU_SCAN_ACC=bf16`` and NOT bit-compatible with the
-    fused path, which is why ``auto`` never selects it and the
-    tools/validate_scan.py promotion grid runs the default."""
+    fused path, which is why the hist-method ``auto`` promotion never
+    selects it and the tools/validate_scan.py promotion grid runs the
+    default. ``XTPU_SCAN_ACC=auto`` (Round 14) engages it only behind
+    the measured per-shape-class error bound (``resolve_scan_acc``)."""
     if acc == "f32":
         return build_hist_segment(bins, gpair, rel_pos, n_nodes, max_nbins)
     if acc != "bf16":
@@ -98,6 +101,37 @@ def _segment_hist_acc(bins: jnp.ndarray, gpair: jnp.ndarray,
         seg, num_segments=nseg)                        # f32 fix-up
     hist = h_head.astype(jnp.float32) + h_fix
     return hist[: n_nodes * stride].reshape(n_nodes, F, max_nbins, 2)
+
+
+SCAN_ACC_RMS_BOUND = float(os.environ.get("XTPU_SCAN_ACC_RMS", "1e-6"))
+
+
+@partial(jax.jit, static_argnames=("max_nbins",))
+def _scan_acc_rms(bins: jnp.ndarray, gpair: jnp.ndarray,
+                  max_nbins: int) -> jnp.ndarray:
+    """Relative RMS gap of the bf16-split root histogram vs the exact
+    f32 build — the probe behind ``XTPU_SCAN_ACC=auto``."""
+    rel = jnp.zeros((bins.shape[0],), jnp.int32)
+    h32 = _segment_hist_acc(bins, gpair, rel, 1, max_nbins, "f32")
+    h16 = _segment_hist_acc(bins, gpair, rel, 1, max_nbins, "bf16")
+    num = jnp.sqrt(jnp.mean(jnp.square(h16 - h32)))
+    den = jnp.sqrt(jnp.mean(jnp.square(h32)))
+    return num / jnp.maximum(den, jnp.float32(1e-30))
+
+
+def resolve_scan_acc(bins: jnp.ndarray, gpair: jnp.ndarray,
+                     max_nbins: int, has_missing: bool = True) -> str:
+    """``XTPU_SCAN_ACC=auto`` -> ``"bf16"`` or ``"f32"`` for one shape
+    class (ROADMAP item 1c): the bf16 head + f32 residual split
+    accumulator halves the hot accumulate bytes, but it is only taken
+    when its MEASURED relative RMS error on the root histogram of the
+    first round's gradients stays within ``XTPU_SCAN_ACC_RMS``
+    (default 1e-6); otherwise auto falls back to the exact f32
+    accumulator. Growers call this once per shape class and cache the
+    resolved string, so the probe costs one extra histogram build per
+    training run."""
+    rms = float(_scan_acc_rms(bins, gpair, max_nbins))
+    return "bf16" if rms <= SCAN_ACC_RMS_BOUND else "f32"
 
 
 def build_hist_scan(bins: jnp.ndarray, gpair: jnp.ndarray,
@@ -502,14 +536,24 @@ def scan_advance_level(bins: jnp.ndarray, gpair: jnp.ndarray,
                        n_level: int, missing_bin: int, *, max_nbins: int,
                        bins_t: jnp.ndarray = None, method: str = "auto",
                        axis_name=None, decision_axis=None,
-                       acc: str = "f32"):
+                       acc: str = "f32", n_cap: int = None):
     """Scan-formulation boundary sweep: advance rows below the previous
     level's decoded splits, then ONE sorted ordering of the new level
     yields its fine + coarse histograms
     (the scan counterpart of ``fused_advance_coarse`` — same advance ops,
     so positions are bit-identical; the builds are sorted segment sums,
     bit-equal to the fused schedule's. Returns
-    ``(positions, fine, coarse)``)."""
+    ``(positions, fine, coarse)``).
+
+    ``n_cap``: static node capacity for the megakernel (hist_method="mega",
+    tree/grow.py). Inside the per-tree ``lax.fori_loop`` the level bounds
+    ``lo`` / ``n_level`` (and ``prev``'s) are TRACED carry values, so the
+    histogram shape must come from a loop-invariant bound instead: rows
+    outside the level take the sentinel ``n_cap`` and the builds run at
+    capacity ``n_cap``. Rows [0:n_level] of the result are bitwise equal
+    to the uncapped build — the stable counting sort produces the same
+    permutation either way (the sentinel is the unique maximum key in
+    both), and ``segment_sum`` only gains trailing empty segments."""
     from .partition import advance_positions_level, update_positions
 
     kind = prev["kind"]
@@ -528,10 +572,11 @@ def scan_advance_level(bins: jnp.ndarray, gpair: jnp.ndarray,
             bins, positions, sf, sb, dl, isf, missing_bin,
             decision_axis=decision_axis,
             feat_offset=prev.get("feat_offset"))
+    cap = n_level if n_cap is None else n_cap
     rel = jnp.where((positions >= lo) & (positions < lo + n_level),
-                    positions - lo, n_level).astype(jnp.int32)
+                    positions - lo, cap).astype(jnp.int32)
     fine, coarse = scan_level_hists(
-        bins, gpair, rel, n_level, max_nbins, missing_bin, bins_t=bins_t,
+        bins, gpair, rel, cap, max_nbins, missing_bin, bins_t=bins_t,
         method=method, axis_name=axis_name, acc=acc)
     return positions, fine, coarse
 

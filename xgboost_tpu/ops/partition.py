@@ -109,12 +109,23 @@ def counting_sort_by_node(rel_pos: jnp.ndarray, n_nodes: int,
     """
     n = rel_pos.shape[0]
     if n_nodes == 1:
-        # every real key equals 0, so the stable sort IS the identity
-        # permutation — skipping it keeps bit-parity for free and dodges a
-        # shard_map check_rep crash on the root level, where ``rel_pos``
-        # traces as a constant and jax's replication rule for the
-        # multi-result sort primitive returns None
-        order = jnp.arange(n, dtype=jnp.int32)
+        # two buckets (node 0 / inactive): the stable grouping permutation
+        # is a cumsum counting rank — no sort primitive, so the root level
+        # works under shard_map even when ``rel_pos`` traces as a constant
+        # (jax's replication rule for the multi-result sort primitive
+        # returns None and check_rep/check_vma crashes; cumsum + scatter
+        # both have rules), and it stays traceable inside the megakernel's
+        # ``lax.fori_loop`` body (hist_method="mega"). Bitwise equal to
+        # ``argsort(stable=True)``: node-0 rows first in original order,
+        # then inactive rows in original order.
+        in0 = (rel_pos.astype(jnp.int32) < 1).astype(jnp.int32)
+        c0 = jnp.cumsum(in0)
+        rank0 = c0 - in0
+        in1 = 1 - in0
+        rank1 = jnp.cumsum(in1) - in1
+        dest = jnp.where(in0 == 1, rank0, c0[-1] + rank1)
+        order = jnp.zeros((n,), jnp.int32).at[dest].set(
+            jnp.arange(n, dtype=jnp.int32))
     else:
         order = jnp.argsort(rel_pos.astype(jnp.int32), stable=True)
     if block is None:
@@ -129,16 +140,9 @@ def counting_sort_by_node(rel_pos: jnp.ndarray, n_nodes: int,
         [jnp.zeros((1,), padded.dtype), jnp.cumsum(padded)])  # [N + 1]
     cap = (-(-n // R) + n_nodes) * R
     rel_s = jnp.take(rel_pos, order).astype(jnp.int32)        # sorted keys
-    if n_nodes == 1:
-        # identity order (see above): keys are NOT grouped, so the rank
-        # within node 0's run is a running count of its rows, not an
-        # offset from the run start
-        in_run = (rel_s < 1).astype(counts.dtype)
-        rank = jnp.cumsum(in_run) - in_run
-    else:
-        run_start = jnp.concatenate(
-            [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])  # [N + 1]
-        rank = jnp.arange(n) - run_start[jnp.clip(rel_s, 0, n_nodes)]
+    run_start = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])  # [N + 1]
+    rank = jnp.arange(n) - run_start[jnp.clip(rel_s, 0, n_nodes)]
     dest = starts[jnp.clip(rel_s, 0, n_nodes)] + rank
     dest = jnp.where(rel_s < n_nodes, dest, cap)              # drop strays
     perm = jnp.full((cap,), n, order.dtype).at[dest].set(order, mode="drop")

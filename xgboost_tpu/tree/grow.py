@@ -18,6 +18,7 @@ like the broadcast seed at ``src/tree/updater_gpu_hist.cu:786-789``).
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import functools
 import os
 from typing import NamedTuple, Optional
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..context import shard_map as _shard_map
+from ..obs import trace as _trace
 from ..ops.histogram import (build_hist, build_hist_prehot,
                              build_onehot_plane, fused_advance_coarse,
                              scan_advance_level, scan_level_hists,
@@ -100,6 +102,19 @@ AUTO_COARSE_MIN_BINS = 128
 # back to fused — the escape hatch if a validate_scan run ever fails on
 # new hardware. Read once at import (construction time), never traced.
 AUTO_SCAN_PROMOTE = os.environ.get("XTPU_SCAN_PROMOTE", "1").lower() \
+    not in ("0", "false", "off")
+
+# Round 14: wherever "auto" promotes to the scan formulation it now rolls
+# the whole per-tree level loop into ONE ``lax.fori_loop`` body
+# (hist_method="mega"): the same scan-formulation stage chain runs at a
+# static node capacity with sentinel-padded slots, so XLA compiles one
+# loop body instead of max_depth unrolled levels and the per-level launch
+# overhead collapses to ~1 (tools/roofline.py mega schedule). Models are
+# bit-identical to scan (tools/validate_mega.py pins the grid).
+# XTPU_MEGA=0 demotes auto back to the unrolled scan loop — the escape
+# hatch if a validate_mega run ever fails on new hardware. Read once at
+# import (construction time), never traced.
+AUTO_MEGA = os.environ.get("XTPU_MEGA", "1").lower() \
     not in ("0", "false", "off")
 
 
@@ -333,10 +348,35 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
     # bit-identical to fused (tools/validate_scan.py pins the grid), so
     # "auto" promotes scan wherever it promoted fused; explicit "fused"
     # keeps the old schedule so the A/B stays measurable.
-    use_scan = hist_kernel == "scan" or (hist_kernel == "auto"
-                                         and use_coarse and AUTO_SCAN_PROMOTE)
+    use_scan = (hist_kernel in ("scan", "mega")
+                or (hist_kernel == "auto"
+                    and use_coarse and AUTO_SCAN_PROMOTE))
     use_coarse = use_coarse or use_scan
     use_fused = use_fused and not use_scan
+    # Round 14 megakernel (hist_method="mega"): the scan stage chain, but
+    # the Python depth loop becomes one ``lax.fori_loop`` with level
+    # bounds as traced carries and node arrays padded to the static
+    # capacity N_cap = 2^(max_depth-1). Engages for explicit "mega" and
+    # for "auto" wherever scan promoted (XTPU_MEGA=0 opts out); outside
+    # its gates it falls back to the unrolled scan loop, which is
+    # bit-identical, so a fallback is never a correctness event:
+    # - numeric features only (scan's own restriction);
+    # - every level dense (2^max_depth <= DENSE_LEVEL_MAX): the loop body
+    #   is ONE program, so the dense/walk advance switch cannot vary by
+    #   depth;
+    # - colsample_bynode == 1: per-node subsampling draws
+    #   ``jax.random.split(key, n_level)`` whose RESULTS depend on the
+    #   level width, which is traced here — jax's split is not
+    #   prefix-stable, so the padded draw would change sampled features
+    #   (colsample_bylevel is safe: fold_in of the traced depth is
+    #   value-identical to the unrolled fold_in);
+    # - no smaller-child compaction (static per-level capacities).
+    use_mega = (use_scan
+                and (hist_kernel == "mega"
+                     or (hist_kernel == "auto" and AUTO_MEGA))
+                and cat is None and not use_compaction
+                and max_depth >= 1 and dense_delta
+                and param.colsample_bynode >= 1.0)
     if use_coarse:
         if cat is not None or max_nbins > 256 + int(has_missing):
             raise NotImplementedError(
@@ -356,7 +396,239 @@ def _grow(bins: jnp.ndarray, gpair: jnp.ndarray, n_real_bins: jnp.ndarray,
         cb = cb_t.T
 
     pending_adv = None  # fused: splits awaiting the next boundary sweep
-    for depth in range(max_depth):
+    if use_mega:
+        # ---- megakernel: one fori_loop body for every level ------------
+        # Same stage chain as the unrolled scan loop below — boundary
+        # sweep (advance + one sorted ordering -> fine+coarse), window,
+        # integral refine, eval, heap bookkeeping — with the level bounds
+        # ``lo`` / ``n_level`` as TRACED values and every per-level array
+        # padded to the static capacity N_cap = 2^(max_depth-1).
+        # Bit-parity with scan:
+        # - the boundary sweep runs EVERY iteration; at d=0 the pending
+        #   decision arrays are all-inert (can_split False), so the
+        #   advance is `where(False, ..., positions)` — bitwise identity —
+        #   and the sweep's hist build IS the root build;
+        # - histogram rows [0:n_level] are bitwise equal to the uncapped
+        #   build (scan_advance_level n_cap docstring);
+        # - padded node slots (j >= n_level) never write: every scatter
+        #   routes through a sentinel index with mode="drop", and
+        #   ``can_split`` is masked on ``valid``, so padded lanes cannot
+        #   influence real rows or the heap;
+        # - per-node stages (window/refine/eval/assemble/decode) are
+        #   row-independent, so padded lanes just compute dead values.
+        N_cap = 2 ** (max_depth - 1)
+        mega_row_axis = axis_name if not col_split else None
+        mega_dec_axis = axis_name if col_split else None
+        lane = jnp.arange(N_cap, dtype=jnp.int32)
+
+        def _mega_body(d, carry):
+            n_level = (jnp.int32(1) << d).astype(jnp.int32)
+            lo = n_level - 1
+            nl_prev = n_level >> 1
+            lo_prev = nl_prev - 1
+            valid = lane < n_level
+            idx = lo + lane
+            drop_idx = jnp.where(valid, idx, max_nodes)
+            positions = carry["positions"]
+            prev = {"kind": "dense", "lo": lo_prev, "n_level": nl_prev,
+                    "arrs": (carry["feat_p"], carry["bin_p"],
+                             carry["dl_p"], carry["cs_p"])}
+            with jax.named_scope("xtpu.sort"):
+                positions, hist_f, hist_c = scan_advance_level(
+                    bins, gpair, positions, prev, lo, n_level,
+                    missing_bin, max_nbins=max_nbins, bins_t=bins_t,
+                    method="auto", axis_name=mega_row_axis,
+                    decision_axis=mega_dec_axis, acc=scan_acc,
+                    n_cap=N_cap)
+            with jax.named_scope("xtpu.exchange"):
+                hist_f = allreduce(hist_f)
+                hist_c = allreduce(hist_c)
+            node_sum_l = jax.lax.dynamic_slice(
+                carry["node_sum"], (lo, jnp.int32(0)), (N_cap, 2))
+            active_l = jax.lax.dynamic_slice(carry["active"], (lo,),
+                                             (N_cap,))
+            if monotone is not None:
+                nlow_l = jax.lax.dynamic_slice(carry["node_lower"], (lo,),
+                                               (N_cap,))
+                nupp_l = jax.lax.dynamic_slice(carry["node_upper"], (lo,),
+                                               (N_cap,))
+            with jax.named_scope("xtpu.window"):
+                span = choose_refine_window(hist_c, node_sum_l,
+                                            n_real_bins, param,
+                                            has_missing)          # [N, F]
+            with jax.named_scope("xtpu.refine"):
+                hist_r = refine_from_fine(hist_f, span, missing_bin)
+            hist, n_real_eval = assemble_two_level(
+                hist_c, hist_r, span, n_real_bins, has_missing)
+
+            # fold_in of the traced depth is value-identical to the
+            # unrolled loop's fold_in of the Python int
+            level_key = jax.random.fold_in(key, d)
+            fmask = _sample_features(level_key, tree_mask,
+                                     param.colsample_bylevel)[None, :]
+            if constraint_sets is not None:
+                path = jax.lax.dynamic_slice(
+                    carry["node_path"], (lo, jnp.int32(0)),
+                    (N_cap, F_cons))
+                allowed = interaction_allowed_dev(path, constraint_sets)
+                if col_split:
+                    allowed = jax.lax.dynamic_slice(
+                        allowed, (jnp.int32(0), feat_off), (N_cap, F))
+                fmask = fmask & allowed
+
+            with jax.named_scope("xtpu.eval"):
+                res = evaluate_splits(
+                    hist, node_sum_l, n_real_eval, param,
+                    feature_mask=fmask, monotone=mono_loc,
+                    node_lower=nlow_l if monotone is not None else None,
+                    node_upper=nupp_l if monotone is not None else None,
+                    cat=None, has_missing=has_missing)
+            span_sel = jnp.take_along_axis(
+                span, jnp.maximum(res.feature, 0)[:, None], axis=1)[:, 0]
+            res = res._replace(bin=decode_two_level_bin(res.bin, span_sel))
+            if col_split:
+                local_feat, local_bin = res.feature, res.bin
+                local_dl = res.default_left
+                with jax.named_scope("xtpu.exchange"):
+                    res, mine = exchange_best_split(res, axis_name, F)
+
+            can_split = (valid & active_l
+                         & (res.gain > max(param.gamma, _EPS))
+                         & jnp.isfinite(res.gain))
+
+            out = dict(carry)
+            out["split_feature"] = carry["split_feature"].at[drop_idx].set(
+                jnp.where(can_split, res.feature, -1), mode="drop")
+            out["split_bin"] = carry["split_bin"].at[drop_idx].set(
+                jnp.where(can_split, res.bin, 0), mode="drop")
+            out["default_left"] = carry["default_left"].at[drop_idx].set(
+                can_split & res.default_left, mode="drop")
+            out["is_leaf"] = carry["is_leaf"].at[drop_idx].set(
+                ~can_split, mode="drop")
+            out["gain"] = carry["gain"].at[drop_idx].set(
+                jnp.where(can_split, res.gain, 0.0), mode="drop")
+
+            li_d = jnp.where(valid, 2 * idx + 1, max_nodes)
+            ri_d = jnp.where(valid, 2 * idx + 2, max_nodes)
+            out["active"] = (carry["active"]
+                             .at[li_d].set(can_split, mode="drop")
+                             .at[ri_d].set(can_split, mode="drop"))
+            zero2 = jnp.zeros_like(res.left_sum)
+            out["node_sum"] = (carry["node_sum"]
+                               .at[li_d].set(jnp.where(can_split[:, None],
+                                                       res.left_sum, zero2),
+                                             mode="drop")
+                               .at[ri_d].set(jnp.where(can_split[:, None],
+                                                       res.right_sum, zero2),
+                                             mode="drop"))
+            if monotone is not None:
+                wl = jnp.clip(calc_weight(res.left_sum[:, 0],
+                                          res.left_sum[:, 1], param),
+                              nlow_l, nupp_l)
+                wr = jnp.clip(calc_weight(res.right_sum[:, 0],
+                                          res.right_sum[:, 1], param),
+                              nlow_l, nupp_l)
+                mid = (wl + wr) * 0.5
+                mc = monotone[jnp.maximum(res.feature, 0)]
+                l_hi = jnp.where(mc > 0, mid, nupp_l)
+                r_lo = jnp.where(mc > 0, mid, nlow_l)
+                l_lo = jnp.where(mc < 0, mid, nlow_l)
+                r_hi = jnp.where(mc < 0, mid, nupp_l)
+                out["node_lower"] = (
+                    carry["node_lower"]
+                    .at[li_d].set(jnp.where(can_split, l_lo, 0),
+                                  mode="drop")
+                    .at[ri_d].set(jnp.where(can_split, r_lo, 0),
+                                  mode="drop"))
+                out["node_upper"] = (
+                    carry["node_upper"]
+                    .at[li_d].set(jnp.where(can_split, l_hi, 0),
+                                  mode="drop")
+                    .at[ri_d].set(jnp.where(can_split, r_hi, 0),
+                                  mode="drop"))
+            if constraint_sets is not None:
+                fsel = (jnp.arange(F_cons, dtype=jnp.int32)[None, :]
+                        == jnp.maximum(res.feature, 0)[:, None]) \
+                    & can_split[:, None]
+                child_path = path | fsel
+                out["node_path"] = (
+                    carry["node_path"]
+                    .at[li_d].set(child_path, mode="drop")
+                    .at[ri_d].set(child_path, mode="drop"))
+
+            with jax.named_scope("xtpu.delta"):
+                # rows whose node just became a terminal leaf take its
+                # value now (the unrolled loop's dense_delta block)
+                leaf_now = active_l & ~can_split
+                w_level = calc_weight(node_sum_l[:, 0], node_sum_l[:, 1],
+                                      param)
+                if monotone is not None:
+                    w_level = jnp.clip(w_level, nlow_l, nupp_l)
+                w_level = jnp.where(leaf_now, w_level * param.eta, 0.0)
+                rel = jnp.where(
+                    (positions >= lo) & (positions < lo + n_level),
+                    positions - lo, N_cap).astype(jnp.int32)
+                rel_oh = rel[:, None] == lane[None, :]
+                out["delta"] = carry["delta"] + jnp.sum(
+                    jnp.where(rel_oh, w_level[None, :], 0.0), axis=1)
+
+            if col_split:
+                out["feat_p"] = jnp.where(can_split & mine, local_feat, -1)
+                out["bin_p"] = jnp.where(can_split & mine, local_bin, 0)
+                out["dl_p"] = can_split & mine & local_dl
+            else:
+                out["feat_p"] = jnp.where(can_split, res.feature, -1)
+                out["bin_p"] = jnp.where(can_split, res.bin, 0)
+                out["dl_p"] = can_split & res.default_left
+            out["cs_p"] = can_split
+            out["positions"] = positions
+            return out
+
+        carry0 = {
+            "split_feature": split_feature, "split_bin": split_bin,
+            "default_left": default_left, "is_leaf": is_leaf,
+            "active": active, "gain": gain, "node_sum": node_sum,
+            "positions": positions, "delta": delta,
+            # pending boundary decisions, all-inert before the root level
+            "feat_p": jnp.full((N_cap,), -1, jnp.int32),
+            "bin_p": jnp.zeros((N_cap,), jnp.int32),
+            "dl_p": jnp.zeros((N_cap,), bool),
+            "cs_p": jnp.zeros((N_cap,), bool),
+        }
+        if monotone is not None:
+            carry0["node_lower"] = node_lower
+            carry0["node_upper"] = node_upper
+        if constraint_sets is not None:
+            carry0["node_path"] = node_path
+        carry = jax.lax.fori_loop(0, max_depth, _mega_body, carry0)
+        split_feature = carry["split_feature"]
+        split_bin = carry["split_bin"]
+        default_left = carry["default_left"]
+        is_leaf = carry["is_leaf"]
+        active = carry["active"]
+        gain = carry["gain"]
+        node_sum = carry["node_sum"]
+        positions = carry["positions"]
+        delta = carry["delta"]
+        if monotone is not None:
+            node_lower = carry["node_lower"]
+            node_upper = carry["node_upper"]
+        # epilogue advance below the deepest level's splits — the deepest
+        # level is exactly N_cap wide, so the pending arrays are unpadded
+        # and the static-bound advance matches the unrolled epilogue
+        lo_p = 2 ** (max_depth - 1) - 1
+        with jax.named_scope("xtpu.advance"):
+            rel_p = jnp.where(
+                (positions >= lo_p) & (positions < lo_p + N_cap),
+                positions - lo_p, N_cap).astype(jnp.int32)
+            positions = advance_positions_level(
+                bins_f32, positions, rel_p, carry["feat_p"],
+                carry["bin_p"], carry["dl_p"], carry["cs_p"], missing_bin,
+                decision_axis=mega_dec_axis)
+
+    # mega replaces the unrolled loop wholesale (fori_loop above); the
+    # generic fused/scan epilogue is skipped via pending_adv=None
+    for depth in range(0 if use_mega else max_depth):
         lo = 2 ** depth - 1
         n_level = 2 ** depth
         idx = lo + jnp.arange(n_level)
@@ -835,11 +1107,14 @@ class TreeGrower:
         # read; docs/env_knobs.md XTPU_SCAN_ACC): "bf16" accumulates the
         # segment sums in bf16 with an f32 residual fix-up pass — an
         # opt-in A/B knob, NOT bit-compatible with fused, never selected
-        # by "auto" (tools/validate_scan.py gates promotion on f32 only)
+        # by the hist-method "auto" promotion (tools/validate_scan.py
+        # gates promotion on f32 only). "auto" (Round 14) resolves to
+        # bf16/f32 at first grow behind the measured RMS error-bound
+        # gate (ops/histogram.py resolve_scan_acc)
         self.scan_acc = os.environ.get("XTPU_SCAN_ACC", "f32")
-        if self.scan_acc not in ("f32", "bf16"):
+        if self.scan_acc not in ("f32", "bf16", "auto"):
             raise ValueError(
-                f"XTPU_SCAN_ACC must be 'f32' or 'bf16', got "
+                f"XTPU_SCAN_ACC must be 'f32', 'bf16' or 'auto', got "
                 f"{self.scan_acc!r}")
         self.mesh = mesh
         self.monotone = (None if monotone is None
@@ -888,15 +1163,39 @@ class TreeGrower:
                                      base_mask,
                                      self.param.colsample_bytree)
         key = jax.random.fold_in(key, 0x5EED)
-        if self.mesh is None:
-            g = _grow(bins, gpair, n_real_bins, tree_mask, key,
-                      self.monotone, self.constraint_sets, self.cat,
-                      param=self.param, max_nbins=self.max_nbins,
-                      hist_method=self.hist_method, axis_name=None,
-                      has_missing=self.has_missing,
-                      scan_acc=self.scan_acc)
-        else:
-            g = self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+        if self.scan_acc == "auto":
+            # resolved ONCE per grower (shape class) on the first
+            # round's gradients, before the jitted tree program (where
+            # scan_acc is static) is built
+            if not getattr(bins, "is_paged", False):
+                from ..ops.histogram import resolve_scan_acc
+
+                self.scan_acc = resolve_scan_acc(bins, gpair,
+                                                 self.max_nbins,
+                                                 self.has_missing)
+            else:
+                self.scan_acc = "f32"
+        # host span for the megakernel tier — only when grow() IS the
+        # dispatch (standalone/mesh); under the fused round this method
+        # runs at trace time where a wall-clock span is meaningless
+        mega_live = (self.hist_method == "mega"
+                     or (self.hist_method == "auto" and AUTO_MEGA
+                         and jax.default_backend() == "tpu"))
+        span = (_trace.span("round/mega")
+                if mega_live and not isinstance(bins, jax.core.Tracer)
+                else _contextlib.nullcontext())
+        with span:
+            if self.mesh is None:
+                g = _grow(bins, gpair, n_real_bins, tree_mask, key,
+                          self.monotone, self.constraint_sets, self.cat,
+                          param=self.param, max_nbins=self.max_nbins,
+                          hist_method=self.hist_method, axis_name=None,
+                          has_missing=self.has_missing,
+                          scan_acc=self.scan_acc)
+            else:
+                g = self._sharded(bins, gpair, n_real_bins, tree_mask, key)
+            if mega_live and not isinstance(bins, jax.core.Tracer):
+                _trace.sync(g.positions)
         if self.param.max_leaves > 0:
             g = self._truncate_max_leaves(g)
         return g
@@ -974,12 +1273,20 @@ class TreeGrower:
                     is_cat_split=P(), cat_words=P(), base_weight=P())
             # col mode: outputs ARE replicated (every split field passes
             # through a psum / all_gather), but the static replication
-            # checker cannot prove it through the owner-shard select chain
+            # checker cannot prove it through the owner-shard select chain.
+            # mega: the fori_loop carry mixes proven-replicated outputs
+            # with unknown-rep inits (scatter has no replication rule on
+            # this jax), and the loop requires input/output reps to match
+            # exactly — the values replicate fine (every hist passes the
+            # in-loop psum), so the static check is waived like col mode
+            mega_possible = (self.hist_method == "mega"
+                             or (self.hist_method == "auto" and AUTO_MEGA
+                                 and jax.default_backend() == "tpu"))
             self._sharded_fn = jax.jit(_shard_map(
                 inner, mesh=self.mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
-                check_vma=self.split_mode != "col"))
+                check_vma=self.split_mode != "col" and not mega_possible))
         return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
 
     def to_tree_model(self, g: GrownTree) -> TreeModel:

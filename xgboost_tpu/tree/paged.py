@@ -68,7 +68,7 @@ def _make_kernels(grower):
     missing_bin = (grower.max_nbins - 1 if grower.has_missing
                    else grower.max_nbins)
     method = _strip_hist_suffix(grower.hist_method)
-    if (method in ("coarse", "fused", "scan")
+    if (method in ("coarse", "fused", "scan", "mega")
             or getattr(grower, "_coarse", False)):
         # two-level scheme: the coarse/refine page passes are plain
         # narrow-width builds — let the per-backend auto selection pick
@@ -82,6 +82,10 @@ def _make_kernels(grower):
         # schedule IS the scan schedule for out-of-core data and the two
         # methods are trivially bit-identical (tests/test_scan_hist.py);
         # the sorted in-VMEM segment build targets the resident tiers.
+        # "mega" lowers here identically: the single-program level loop
+        # needs resident bins (tree/grow.py gate), so on the paged tier
+        # it IS the scan/page-major schedule — bit-identical by
+        # construction (tests/test_mega.py paged cell).
         method = "auto"
     if grower.mesh is not None:
         return _MeshPageKernels(grower.mesh, grower.max_nbins, missing_bin,
@@ -1509,7 +1513,7 @@ class PagedGrower(TreeGrower):
             from .grow import auto_selects_coarse
 
             base = _strip_hist_suffix(self.hist_method)
-            if base in ("coarse", "fused", "scan") and (
+            if base in ("coarse", "fused", "scan", "mega") and (
                     self.cat is not None
                     or self.max_nbins > 256 + int(self.has_missing)):
                 raise NotImplementedError(
@@ -1529,7 +1533,7 @@ class PagedGrower(TreeGrower):
             # "scan" does too — the page-major schedule's fine-partial +
             # refine_from_fine slicing already IS the integral-histogram
             # half of the scan formulation (_make_kernels comment)
-            self._coarse = base in ("coarse", "fused", "scan") or (
+            self._coarse = base in ("coarse", "fused", "scan", "mega") or (
                 base == "auto" and auto_selects_coarse(
                     n_local, self.max_nbins, self.has_missing,
                     numeric=self.cat is None, col_split=False))
@@ -1779,7 +1783,7 @@ class PagedLossguideGrower(LossguideGrower):
                          mesh=None, monotone=monotone,
                          constraint_sets=constraint_sets,
                          has_missing=has_missing)
-        if self._base_hm in ("coarse", "fused", "scan"):
+        if self._base_hm in ("coarse", "fused", "scan", "mega"):
             raise NotImplementedError(
                 f"hist_method='{self._base_hm}' with grow_policy="
                 "lossguide runs on resident matrices only (the paged "
@@ -2021,12 +2025,13 @@ class PagedMultiLossguideGrower(MultiLossguideGrower):
         super().__init__(param, max_nbins, cuts, hist_method=hist_method,
                          mesh=None, has_missing=has_missing,
                          constraint_sets=constraint_sets)
-        if _strip_hist_suffix(hist_method) in ("coarse", "fused", "scan"):
+        if _strip_hist_suffix(hist_method) in ("coarse", "fused", "scan",
+                                               "mega"):
             # same contract as the scalar PagedLossguideGrower (and the
             # core guard already rejects coarse/fused for vector leaves)
             raise NotImplementedError(
-                "hist_method='coarse'/'fused'/'scan' with grow_policy="
-                "lossguide runs on resident matrices only")
+                "hist_method='coarse'/'fused'/'scan'/'mega' with "
+                "grow_policy=lossguide runs on resident matrices only")
         self.mesh = mesh
         self._mk = None
 

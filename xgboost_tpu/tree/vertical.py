@@ -73,6 +73,12 @@ def row_split_hist_method(hist_method: str) -> str:
             "kernels instead (docs/performance.md round 7)", UserWarning,
             stacklevel=3)
         return "auto" + sfx
+    if base == "mega":
+        # the single-program level loop needs row-split resident bins;
+        # the scan formulation is its bit-identical per-level schedule,
+        # so degrade silently to that (the lossguide/paged growers apply
+        # their own scan-tier policy downstream)
+        return "scan" + sfx
     return hist_method
 
 
