@@ -1,0 +1,34 @@
+"""Known-bad twin for the stale-pragma checker.
+
+Every ``disable=`` pragma here excuses code that no longer trips the
+named checker (or names a checker that never existed), so the pragma is
+a dead reviewed-exception: it suppresses nothing today and silently
+re-opens the hole for the next regression at its line.
+"""
+
+import jax.numpy as jnp
+
+
+def fixed_round(margin, delta):
+    # the env read this excused was removed in a refactor
+    # xtpulint: disable=trace-capture  # LINT[stale-pragma]
+    return margin + delta
+
+
+def grow(hist, depth):
+    total = hist[depth]
+    # once a .item() loop; now pure device code, pragma left behind
+    out = jnp.sum(total)  # xtpulint: disable=host-sync  # LINT[stale-pragma]
+    return out
+
+
+def predict(margin):
+    # typo'd slug: can never suppress anything
+    # xtpulint: disable=hostsync  # LINT[stale-pragma]
+    return margin * 2
+
+
+def drain(margin):
+    # a blanket disable with nothing left underneath it
+    # xtpulint: disable=all  # LINT[stale-pragma]
+    return margin

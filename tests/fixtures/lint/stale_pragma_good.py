@@ -1,0 +1,19 @@
+"""Known-good twin for the stale-pragma checker.
+
+The pragma below still earns its keep: the loop genuinely materializes a
+device value per iteration (a real host-sync finding), and the
+``disable=`` is the reviewed exception for it. A live pragma must not be
+flagged — and the suppressed finding must not surface either.
+"""
+
+import jax.numpy as jnp
+
+
+def threshold_sweep(hist, levels):
+    # deliberate per-level sync: the threshold feeds host-side control
+    # flow that chooses the next page schedule (reviewed exception)
+    gains = []
+    for depth in range(levels):
+        g = jnp.sum(hist[depth])
+        gains.append(g.item())  # xtpulint: disable=host-sync
+    return gains

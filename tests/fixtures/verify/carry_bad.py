@@ -1,0 +1,36 @@
+"""Bad twin: carry-stability — a weak-typed array carry (python literal
+broadcast into the loop state) and a carry far over the contract's
+size bound at trace shapes."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.carry", dispatch_budget=2, max_carry_kb=64.0)
+
+
+@jax.jit  # VERIFY[carry-stability]
+def weak_carry_loop(x):
+    # 1.0 broadcast seeds the carry weak; every iteration keeps it weak
+    init = jax.lax.broadcast(1.0, (8,))
+    return jax.lax.fori_loop(0, 4, lambda i, c: c * 2.0 + x, init)
+
+
+@jax.jit  # VERIFY[carry-stability]
+def bulky_carry_loop(x):
+    # a whole 1 MiB scratch buffer rides across iterations (> 64 KiB)
+    init = (jnp.zeros((512, 512), jnp.float32), x)
+    out = jax.lax.fori_loop(
+        0, 4, lambda i, c: (c[0] + 1.0, c[1] * 2.0), init)
+    return out[1]
+
+
+def plan():
+    return RoundPlan(handle="fx.carry", unit="round", dispatches=[
+        ProgramSpec(name="weak", fn=weak_carry_loop,
+                    args=(_abstract((8,), "float32"),)),
+        ProgramSpec(name="bulky", fn=bulky_carry_loop,
+                    args=(_abstract((512, 512), "float32"),)),
+    ])

@@ -1,0 +1,28 @@
+"""Good twin: carry-stability — explicitly dtyped, bounded carries (the
+fixed form of carry_bad: pinned zeros seed, scratch consumed in-body)."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.carry", dispatch_budget=1, max_carry_kb=64.0)
+
+
+@jax.jit
+def pinned_carry_loop(x):
+    init = jnp.zeros((8,), jnp.float32)
+
+    def body(i, c):
+        scratch = jnp.outer(x, x)          # built and consumed in-body
+        return c * 2.0 + scratch[i]
+
+    return jax.lax.fori_loop(0, 4, body, init)
+
+
+def plan():
+    return RoundPlan(handle="fx.carry", unit="round", dispatches=[
+        ProgramSpec(name="pinned", fn=pinned_carry_loop,
+                    args=(_abstract((8,), "float32"),)),
+    ])

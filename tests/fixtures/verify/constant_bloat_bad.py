@@ -1,0 +1,27 @@
+"""Bad twin: constant-bloat — a 200 KB lookup table closed over by value
+gets baked into the jaxpr as a const (duplicated per compiled variant,
+re-staged on every compile)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.const", dispatch_budget=1,
+                           max_const_bytes=1 << 16)
+
+_TABLE = np.arange(50_000, dtype=np.float32)   # 200 KB, closed over
+
+
+@jax.jit  # VERIFY[constant-bloat]
+def lookup(idx):
+    return jnp.asarray(_TABLE)[idx]
+
+
+def plan():
+    return RoundPlan(handle="fx.const", unit="pass", dispatches=[
+        ProgramSpec(name="lookup", fn=lookup,
+                    args=(_abstract((32,), "int32"),)),
+    ])

@@ -1,0 +1,23 @@
+"""Good twin: constant-bloat — the table is a traced argument, so it is
+device data shared across variants, not a baked literal."""
+
+import jax
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.const", dispatch_budget=1,
+                           max_const_bytes=1 << 16)
+
+
+@jax.jit
+def lookup(table, idx):
+    return table[idx]
+
+
+def plan():
+    return RoundPlan(handle="fx.const", unit="pass", dispatches=[
+        ProgramSpec(name="lookup", fn=lookup,
+                    args=(_abstract((50_000,), "float32"),
+                          _abstract((32,), "int32"))),
+    ])

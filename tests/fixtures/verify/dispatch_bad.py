@@ -1,0 +1,39 @@
+"""Bad twin: dispatch-budget — three programs per round against a budget
+of two (the PR-11 regression shape: a stray per-round update program),
+plus a hidden host callback inside one of them."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.dispatch", dispatch_budget=2)
+
+
+@jax.jit  # VERIFY[dispatch-budget]
+def round_step(margin, delta):
+    return margin + delta
+
+
+@jax.jit
+def guard(margin):
+    return jnp.sum(jnp.isnan(margin))
+
+
+@jax.jit  # VERIFY[dispatch-budget]
+def stray_update(margin):
+    # the un-budgeted third dispatch, smuggling a host round-trip too
+    scaled = jax.pure_callback(
+        lambda m: m * 0.5, jax.ShapeDtypeStruct(margin.shape,
+                                                margin.dtype), margin)
+    return scaled
+
+
+def plan():
+    m = _abstract((512, 1), "float32")
+    return RoundPlan(handle="fx.dispatch", unit="round", dispatches=[
+        ProgramSpec(name="round", fn=round_step, args=(m, m)),
+        ProgramSpec(name="guard", fn=guard, args=(m,)),
+        ProgramSpec(name="stray", fn=stray_update, args=(m,)),
+    ])

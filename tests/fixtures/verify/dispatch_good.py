@@ -1,0 +1,28 @@
+"""Good twin: dispatch-budget — exactly the budgeted two programs per
+round, no callbacks."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.dispatch", dispatch_budget=2)
+
+
+@jax.jit
+def round_step(margin, delta):
+    return margin + delta
+
+
+@jax.jit
+def guard(margin):
+    return jnp.sum(jnp.isnan(margin))
+
+
+def plan():
+    m = _abstract((512, 1), "float32")
+    return RoundPlan(handle="fx.dispatch", unit="round", dispatches=[
+        ProgramSpec(name="round", fn=round_step, args=(m, m)),
+        ProgramSpec(name="guard", fn=guard, args=(m,)),
+    ])

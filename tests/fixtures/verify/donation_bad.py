@@ -1,0 +1,27 @@
+"""Bad twin: donation-ineffective — donate_argnums is declared but the
+donated input matches no output shape/dtype, so XLA silently drops the
+aliasing and peak HBM holds two copies."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.donation", dispatch_budget=1, donated=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))  # VERIFY[donation-ineffective]
+def consume_margin(margin):
+    # scalar output: the donated (512,1) buffer cannot alias it
+    return jnp.sum(margin)
+
+
+def plan():
+    return RoundPlan(handle="fx.donation", unit="round", dispatches=[
+        ProgramSpec(name="consume", fn=consume_margin,
+                    args=(_abstract((512, 1), "float32"),),
+                    donate_argnums=(0,)),
+    ])

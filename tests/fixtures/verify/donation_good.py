@@ -1,0 +1,26 @@
+"""Good twin: donation-ineffective — the donated buffer is updated
+in-place-shaped (same shape+dtype output), so the aliasing materializes
+in the lowering."""
+
+import functools
+
+import jax
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.donation", dispatch_budget=1, donated=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update_margin(margin, delta):
+    return margin + delta
+
+
+def plan():
+    return RoundPlan(handle="fx.donation", unit="round", dispatches=[
+        ProgramSpec(name="update", fn=update_margin,
+                    args=(_abstract((512, 1), "float32"),
+                          _abstract((512, 1), "float32")),
+                    donate_argnums=(0,)),
+    ])

@@ -58,6 +58,35 @@ def test_no_stale_baseline_entries():
                     for e in result.stale))
 
 
+def test_verify_baseline_is_justified_and_wellformed():
+    """The jax-free half of the xtpuverify gate, kept here so a
+    repo-dirtying suppression from EITHER tool fails tier-1 even if the
+    jax-tracing verify gate is deselected: every entry in
+    tools/xtpuverify/baseline.toml parses and carries a justification.
+    (Staleness needs tracing and lives in tests/test_verify_gate.py.)"""
+    from tools.xtpuverify import DEFAULT_BASELINE as VERIFY_BASELINE
+    from tools.xtpuverify import load_baseline as load_verify_baseline
+    bl = load_verify_baseline(VERIFY_BASELINE)
+    unjustified = [e for e in bl.entries if not e.justification.strip()]
+    assert not unjustified, (
+        "xtpuverify baseline entries without a written justification: "
+        + ", ".join(f"{e.path}:{e.line} [{e.checker}]"
+                    for e in unjustified))
+
+
+def test_both_tools_share_one_baseline_format():
+    """The shared store (tools/analysis_baseline.py) must stay the
+    single source of format truth: both tools' loaders are the same
+    function, so fingerprints and file bytes cannot drift apart."""
+    import tools.analysis_baseline as shared
+    import tools.xtpulint.baseline as lint_bl
+    import tools.xtpuverify as verify
+
+    assert lint_bl.Suppression is shared.Suppression
+    assert verify.Suppression is shared.Suppression
+    assert lint_bl.Baseline is shared.Baseline
+
+
 def test_fixed_defects_stay_fixed():
     """The two real defects this analyzer surfaced and PR 6 fixed must
     never come back: SnapshotWriter.last_error races (checkpoint.py) and

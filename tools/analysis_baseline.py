@@ -1,0 +1,144 @@
+"""Shared reviewed-suppression store for the repo's static analyzers.
+
+Both gates — ``tools.xtpulint`` (source-AST lint) and ``tools.xtpuverify``
+(jaxpr-level program contracts) — enforce *zero NEW findings*, not zero
+findings: a finding is either fixed or recorded here with a human-written
+justification. Each tool keeps its own ``baseline.toml`` next to its
+package; this module owns the common format, matching, and (de)serialization
+so fingerprints and file bytes behave identically across tools.
+
+Every entry MUST carry a ``justification`` — the tier-1 gates
+(``tests/test_lint_gate.py`` / ``tests/test_verify_gate.py``) fail the
+build otherwise, so a suppression can never be silently waved through.
+Stale entries (fingerprint matches no current finding) also fail: when a
+baselined finding is fixed, its entry must be deleted so the suppression
+cannot mask a future regression at the same fingerprint.
+
+The file is a deliberate TOML subset (flat string keys, double-quoted
+single-line values) read/written by this module — the container image has
+no tomllib (py3.10) and no third-party toml package, and the subset keeps
+diffs reviewable line-by-line.
+
+Findings are duck-typed: anything with ``fingerprint``, ``checker``,
+``path``, ``symbol`` and ``line`` attributes matches (both tools' Finding
+classes do, with the same sha1-prefix fingerprint recipe).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class Suppression:
+    fingerprint: str
+    checker: str = ""
+    path: str = ""
+    symbol: str = ""
+    justification: str = ""
+    line: int = 0          # informational only; never used for matching
+
+
+@dataclass
+class Baseline:
+    entries: List[Suppression] = field(default_factory=list)
+    source: str = ""
+
+    def by_fingerprint(self) -> Dict[str, Suppression]:
+        return {e.fingerprint: e for e in self.entries}
+
+    def split(self, findings: Sequence
+              ) -> Tuple[list, list, List[Suppression]]:
+        """(new, suppressed, stale) — stale entries match no finding."""
+        table = self.by_fingerprint()
+        new: list = []
+        suppressed: list = []
+        hit: set = set()
+        for f in findings:
+            e = table.get(f.fingerprint)
+            if e is None:
+                new.append(f)
+            else:
+                suppressed.append(f)
+                hit.add(f.fingerprint)
+        stale = [e for e in self.entries if e.fingerprint not in hit]
+        return new, suppressed, stale
+
+
+def _unquote(raw: str) -> str:
+    raw = raw.strip()
+    if len(raw) >= 2 and raw[0] == '"' and raw[-1] == '"':
+        body = raw[1:-1]
+        return (body.replace("\\\\", "\x00").replace('\\"', '"')
+                .replace("\\n", "\n").replace("\x00", "\\"))
+    return raw
+
+
+def _quote(value: str) -> str:
+    return '"' + (value.replace("\\", "\\\\").replace('"', '\\"')
+                  .replace("\n", "\\n")) + '"'
+
+
+def load_baseline(path: str) -> Baseline:
+    bl = Baseline(source=path)
+    if not os.path.exists(path):
+        return bl
+    current: Optional[Suppression] = None
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            text = line.strip()
+            if not text or text.startswith("#"):
+                continue
+            if text == "[[suppression]]":
+                current = Suppression(fingerprint="")
+                bl.entries.append(current)
+                continue
+            if "=" in text and current is not None:
+                key, _, raw = text.partition("=")
+                key = key.strip()
+                value = _unquote(raw)
+                if key == "line":
+                    try:
+                        current.line = int(value)
+                    except ValueError:
+                        pass
+                elif hasattr(current, key):
+                    setattr(current, key, value)
+                continue
+            if "=" in text and current is None:
+                raise ValueError(
+                    f"{path}:{lineno}: key outside a [[suppression]] "
+                    "table")
+    bl.entries = [e for e in bl.entries if e.fingerprint]
+    return bl
+
+
+def format_baseline(entries: List[Suppression], *,
+                    tool: str = "xtpulint",
+                    gate: str = "tests/test_lint_gate.py") -> str:
+    out = [
+        f"# {tool} baseline — reviewed suppressions.",
+        "# Every entry MUST carry a written justification; the tier-1",
+        f"# gate ({gate}) fails on empty ones and on",
+        "# stale entries. Regenerate skeletons with:",
+        f"#   python -m tools.{tool} --write-baseline",
+        "",
+    ]
+    for e in sorted(entries, key=lambda s: (s.path, s.line, s.checker)):
+        out.append("[[suppression]]")
+        out.append(f"fingerprint = {_quote(e.fingerprint)}")
+        out.append(f"checker = {_quote(e.checker)}")
+        out.append(f"path = {_quote(e.path)}")
+        out.append(f"line = {e.line}")
+        out.append(f"symbol = {_quote(e.symbol)}")
+        out.append(f"justification = {_quote(e.justification)}")
+        out.append("")
+    return "\n".join(out)
+
+
+def suppression_of(f, justification: str = "") -> Suppression:
+    return Suppression(fingerprint=f.fingerprint, checker=f.checker,
+                       path=f.path, symbol=f.symbol, line=f.line,
+                       justification=justification)

@@ -6,15 +6,21 @@
 #   bash tools/ci_checks.sh --lint     # xtpulint only (sub-second-ish)
 #
 # xtpulint gates at zero NEW findings against tools/xtpulint/baseline.toml
-# (docs/static_analysis.md); the same gate also runs inside the suite as
-# tests/test_lint_gate.py, so CI setups that only run pytest still enforce
-# it — this script just fails faster and prints findings with hints.
+# and xtpuverify gates the traced program contracts against
+# tools/xtpuverify/baseline.toml (docs/static_analysis.md); the same gates
+# also run inside the suite as tests/test_lint_gate.py /
+# tests/test_verify_gate.py, so CI setups that only run pytest still
+# enforce them — this script just fails faster and prints findings with
+# hints.
 
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== xtpulint =="
 python -m tools.xtpulint || exit $?
+
+echo "== xtpuverify (program contracts, abstract trace on CPU) =="
+python -m tools.xtpuverify || exit $?
 
 [ "$1" = "--lint" ] && exit 0
 

@@ -1,134 +1,33 @@
-"""Reviewed suppressions: the gate is zero-NEW-findings, not zero-findings.
+"""xtpulint's baseline store — a thin binding of the shared machinery.
 
-``baseline.toml`` holds one ``[[suppression]]`` table per accepted
-finding. Every entry MUST carry a human-written ``justification`` —
-``tests/test_lint_gate.py`` fails the build otherwise, so a suppression
-can never be silently waved through.
-
-The file is a deliberate TOML subset (flat string keys, double-quoted
-single-line values) read/written by this module — the container image has
-no tomllib (py3.10) and no third-party toml package, and the subset keeps
-diffs reviewable line-by-line.
+The format, matching, and TOML-subset (de)serialization live in
+``tools/analysis_baseline.py``, shared with ``tools.xtpuverify`` so both
+gates keep identical fingerprint/suppression semantics. This module only
+pins xtpulint's default file location and re-exports the shared names so
+existing imports (``from tools.xtpulint.baseline import ...``) keep
+working unchanged.
 """
 
 from __future__ import annotations
 
+import functools
 import os
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Optional
 
-from .engine import Finding
+from ..analysis_baseline import (Baseline, Suppression, _quote, _unquote,
+                                 suppression_of)
+from ..analysis_baseline import format_baseline as _format_baseline
+from ..analysis_baseline import load_baseline as _load_baseline
+
+__all__ = ["Baseline", "Suppression", "DEFAULT_BASELINE", "load_baseline",
+           "format_baseline", "suppression_of"]
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
 
 
-@dataclass
-class Suppression:
-    fingerprint: str
-    checker: str = ""
-    path: str = ""
-    symbol: str = ""
-    justification: str = ""
-    line: int = 0          # informational only; never used for matching
-
-
-@dataclass
-class Baseline:
-    entries: List[Suppression] = field(default_factory=list)
-    source: str = ""
-
-    def by_fingerprint(self) -> Dict[str, Suppression]:
-        return {e.fingerprint: e for e in self.entries}
-
-    def split(self, findings: List[Finding]
-              ) -> Tuple[List[Finding], List[Finding], List[Suppression]]:
-        """(new, suppressed, stale) — stale entries match no finding."""
-        table = self.by_fingerprint()
-        new: List[Finding] = []
-        suppressed: List[Finding] = []
-        hit: set = set()
-        for f in findings:
-            e = table.get(f.fingerprint)
-            if e is None:
-                new.append(f)
-            else:
-                suppressed.append(f)
-                hit.add(f.fingerprint)
-        stale = [e for e in self.entries if e.fingerprint not in hit]
-        return new, suppressed, stale
-
-
-def _unquote(raw: str) -> str:
-    raw = raw.strip()
-    if len(raw) >= 2 and raw[0] == '"' and raw[-1] == '"':
-        body = raw[1:-1]
-        return (body.replace("\\\\", "\x00").replace('\\"', '"')
-                .replace("\\n", "\n").replace("\x00", "\\"))
-    return raw
-
-
-def _quote(value: str) -> str:
-    return '"' + (value.replace("\\", "\\\\").replace('"', '\\"')
-                  .replace("\n", "\\n")) + '"'
-
-
 def load_baseline(path: Optional[str] = None) -> Baseline:
-    path = path or DEFAULT_BASELINE
-    bl = Baseline(source=path)
-    if not os.path.exists(path):
-        return bl
-    current: Optional[Suppression] = None
-    with open(path, "r", encoding="utf-8") as fh:
-        for lineno, line in enumerate(fh, 1):
-            text = line.strip()
-            if not text or text.startswith("#"):
-                continue
-            if text == "[[suppression]]":
-                current = Suppression(fingerprint="")
-                bl.entries.append(current)
-                continue
-            if "=" in text and current is not None:
-                key, _, raw = text.partition("=")
-                key = key.strip()
-                value = _unquote(raw)
-                if key == "line":
-                    try:
-                        current.line = int(value)
-                    except ValueError:
-                        pass
-                elif hasattr(current, key):
-                    setattr(current, key, value)
-                continue
-            if "=" in text and current is None:
-                raise ValueError(
-                    f"{path}:{lineno}: key outside a [[suppression]] "
-                    "table")
-    bl.entries = [e for e in bl.entries if e.fingerprint]
-    return bl
+    return _load_baseline(path or DEFAULT_BASELINE)
 
 
-def format_baseline(entries: List[Suppression]) -> str:
-    out = [
-        "# xtpulint baseline — reviewed suppressions.",
-        "# Every entry MUST carry a written justification; the tier-1",
-        "# gate (tests/test_lint_gate.py) fails on empty ones and on",
-        "# stale entries. Regenerate skeletons with:",
-        "#   python -m tools.xtpulint --write-baseline",
-        "",
-    ]
-    for e in sorted(entries, key=lambda s: (s.path, s.line, s.checker)):
-        out.append("[[suppression]]")
-        out.append(f"fingerprint = {_quote(e.fingerprint)}")
-        out.append(f"checker = {_quote(e.checker)}")
-        out.append(f"path = {_quote(e.path)}")
-        out.append(f"line = {e.line}")
-        out.append(f"symbol = {_quote(e.symbol)}")
-        out.append(f"justification = {_quote(e.justification)}")
-        out.append("")
-    return "\n".join(out)
-
-
-def suppression_of(f: Finding, justification: str = "") -> Suppression:
-    return Suppression(fingerprint=f.fingerprint, checker=f.checker,
-                       path=f.path, symbol=f.symbol, line=f.line,
-                       justification=justification)
+format_baseline = functools.partial(_format_baseline, tool="xtpulint",
+                                    gate="tests/test_lint_gate.py")

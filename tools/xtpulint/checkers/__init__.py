@@ -17,7 +17,10 @@ from .recompile import check_recompile
 from .donation import check_donation
 from .locks import check_locks
 from .collectives import check_collectives
+from .stale_pragma import check_stale_pragma
 
+# stale-pragma MUST stay last: it reads ModuleInfo.pragma_hits, which the
+# other checkers' suppression filtering populates as they run.
 CHECKERS: Dict[str, Callable[[RepoIndex], List[Finding]]] = {
     "trace-capture": check_trace_capture,
     "host-sync": check_host_sync,
@@ -26,4 +29,5 @@ CHECKERS: Dict[str, Callable[[RepoIndex], List[Finding]]] = {
     "lock-discipline": check_locks,
     "collective-symmetry": check_collectives,
     "async-timer": check_async_timer,
+    "stale-pragma": check_stale_pragma,
 }

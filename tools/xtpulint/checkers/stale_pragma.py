@@ -1,0 +1,76 @@
+"""stale-pragma: ``# xtpulint: disable=`` comments that no longer
+suppress anything.
+
+A pragma is a reviewed exception, and like a baseline entry it must not
+outlive the finding it excuses: once the underlying code is fixed (or
+refactored away), a left-behind ``disable=`` silently re-opens the hole
+for the next regression at that line. The engine records every pragma
+line that actually suppressed a finding this run
+(``ModuleInfo.pragma_hits``); this checker — registered LAST so every
+other checker has already run — flags the rest. Pragmas naming a slug
+that is not a registered checker are flagged unconditionally (they can
+never suppress anything, usually a typo like ``hostsync``).
+
+Under ``--select`` the check is conservative: a pragma is only declared
+dead when every checker it names actually ran (an ``all`` pragma needs a
+full run), so partial runs cannot produce false stales.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..engine import Finding, ModuleInfo, RepoIndex, SUPPRESS_TOKEN
+
+
+def _symbol_at(mod: ModuleInfo, lineno: int) -> str:
+    best = None
+    for info in mod.functions.values():
+        node = info.node
+        start = getattr(node, "lineno", None)
+        end = getattr(node, "end_lineno", None)
+        if start is None or end is None or not start <= lineno <= end:
+            continue
+        if best is None or start > best[0]:
+            best = (start, info.symbol)
+    return best[1] if best else "<module>"
+
+
+def check_stale_pragma(index: RepoIndex) -> List[Finding]:
+    from . import CHECKERS   # late: this module is itself in the registry
+
+    select = index.config.select
+    ran = set(select) if select else set(CHECKERS)
+    known = set(CHECKERS) | {"all"}
+    findings: List[Finding] = []
+    for mod in index.modules.values():
+        for lineno, raw in enumerate(mod.lines, 1):
+            if SUPPRESS_TOKEN not in raw:
+                continue
+            ids = raw.split(SUPPRESS_TOKEN, 1)[1].split()[0]
+            names = {s.strip() for s in ids.split(",")}
+            if lineno in mod.pragma_hits:
+                continue
+            unknown = sorted(names - known)
+            if unknown:
+                findings.append(Finding(
+                    checker="stale-pragma", path=mod.relpath, line=lineno,
+                    symbol=_symbol_at(mod, lineno),
+                    message=f"pragma names unknown checker(s) "
+                            f"{unknown} — it can never suppress anything",
+                    hint="fix the slug (see --list-checkers) or delete "
+                         "the pragma",
+                    line_text=mod.line_text(lineno)))
+                continue
+            if ("all" in names and ran != set(CHECKERS)) \
+                    or ("all" not in names and not names <= ran):
+                continue     # named checkers didn't all run: can't judge
+            findings.append(Finding(
+                checker="stale-pragma", path=mod.relpath, line=lineno,
+                symbol=_symbol_at(mod, lineno),
+                message=f"pragma `disable={ids}` suppressed no finding "
+                        "this run — the excused code is gone",
+                hint="delete the pragma; a dead disable= re-opens the "
+                     "hole for the next regression at this line",
+                line_text=mod.line_text(lineno)))
+    return findings

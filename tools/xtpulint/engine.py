@@ -236,6 +236,9 @@ class ModuleInfo:
     parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
     # func node -> FuncInfo for fast symbol lookup of any ast node
     by_node: Dict[ast.AST, FuncInfo] = field(default_factory=dict)
+    # pragma lines that suppressed at least one finding this run — the
+    # stale-pragma checker flags the SUPPRESS_TOKEN lines missing here
+    pragma_hits: Set[int] = field(default_factory=set)
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -257,7 +260,11 @@ class ModuleInfo:
             if SUPPRESS_TOKEN in text:
                 ids = text.split(SUPPRESS_TOKEN, 1)[1].split()[0]
                 names = {s.strip() for s in ids.split(",")}
-                if checker in names or "all" in names:
+                # "all" never covers the meta-checker: a blanket pragma
+                # must not be able to hide its own staleness
+                if checker in names or \
+                        ("all" in names and checker != "stale-pragma"):
+                    self.pragma_hits.add(ln)
                     return True
         return False
 

@@ -1,0 +1,66 @@
+"""xtpuverify — jaxpr-level program-contract verifier for xgboost_tpu.
+
+Traces the library's exported program handles (``xgboost_tpu.programs``)
+with abstract avals and checks the traced/lowered artifacts against the
+declarative contract table (``tools/xtpuverify/contracts.py``): dispatch
+budgets per steady round/tree/level/batch, loop-carry stability and
+size, f64/bf16 dtype discipline, donation effectiveness in the lowered
+StableHLO, collective axis/branch symmetry, and baked-constant bloat.
+
+Run ``python -m tools.xtpuverify --help`` or see docs/static_analysis.md.
+The tier-1 gate (tests/test_verify_gate.py) keeps the repo at
+zero-new-findings against tools/xtpuverify/baseline.toml (shared
+suppression machinery: tools/analysis_baseline.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import List, Optional, Tuple
+
+from ..analysis_baseline import (Baseline, Suppression, load_baseline as
+                                 _load_baseline, format_baseline as
+                                 _format_baseline, suppression_of)
+from .engine import (Finding, SkippedHandle, TracedProgram, VerifyConfig,
+                     run_contracts, verify_pairs)
+
+__all__ = ["Finding", "SkippedHandle", "TracedProgram", "VerifyConfig",
+           "VerifyResult", "run_contracts", "verify_pairs", "verify_repo",
+           "DEFAULT_BASELINE", "load_baseline", "format_baseline",
+           "suppression_of"]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.toml")
+
+format_baseline = functools.partial(_format_baseline, tool="xtpuverify",
+                                    gate="tests/test_verify_gate.py")
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    return _load_baseline(DEFAULT_BASELINE if path is None else path)
+
+
+class VerifyResult:
+    def __init__(self, findings: List[Finding], baseline: Baseline,
+                 skipped: List[SkippedHandle]) -> None:
+        self.all_findings = findings
+        self.new, self.suppressed, self.stale = baseline.split(findings)
+        self.baseline = baseline
+        self.skipped = skipped
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def verify_repo(root: str, *,
+                baseline_path: Optional[str] = DEFAULT_BASELINE,
+                select: Optional[Tuple[str, ...]] = None,
+                handles: Optional[Tuple[str, ...]] = None) -> VerifyResult:
+    """Programmatic entry point used by the tier-1 gate and the tests."""
+    cfg = VerifyConfig(root=root, select=select, handles=handles)
+    findings, skipped = run_contracts(cfg)
+    baseline = (_load_baseline(baseline_path) if baseline_path
+                else Baseline())
+    return VerifyResult(findings, baseline, skipped)

@@ -1,0 +1,30 @@
+"""Verify-checker registry: slug -> check(ctx) -> iterable[Finding].
+
+Slugs are stable API — they appear in ``tools/xtpuverify/baseline.toml``
+entries, inline suppressions (``# xtpuverify: disable=<slug>``) and
+docs/static_analysis.md. ``collective-symmetry`` deliberately mirrors the
+xtpulint slug of the same name: xtpulint checks the *source* shape of the
+rank-asymmetry hazard, this one checks the *traced* collective sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable
+
+from ..engine import CheckContext, Finding
+
+from .dispatch import check_dispatch
+from .carries import check_carries
+from .dtypes import check_dtypes
+from .donation import check_donation
+from .collectives import check_collectives
+from .constants import check_constants
+
+CHECKERS: Dict[str, Callable[[CheckContext], Iterable[Finding]]] = {
+    "dispatch-budget": check_dispatch,
+    "carry-stability": check_carries,
+    "dtype-discipline": check_dtypes,
+    "donation-ineffective": check_donation,
+    "collective-symmetry": check_collectives,
+    "constant-bloat": check_constants,
+}

@@ -1,0 +1,92 @@
+"""carry-stability: every loop carry in the traced programs is
+shape/dtype-stable and bounded.
+
+jax itself rejects a carry whose aval *changes* across iterations, so
+what remains checkable — and bites in this codebase — is:
+
+- **weak-typed array carries**: a python literal broadcast into the
+  carry (``jnp.where(m, x, 0.0)`` seeding a level loop) carries
+  ``weak_type=True`` through the whole loop. The program still traces,
+  but the carry's promotion behaviour now depends on context, and a
+  caller-side dtype tweak re-specializes every downstream eqn — a
+  recompile + silent-upcast hazard. Scalar weak carries are exempt:
+  ``fori_loop``'s own induction counter is a weak i32 scalar by
+  construction and is ubiquitous/harmless.
+- **wide-dtype carries**: f64/c128 in a carry means an x64 leak rode
+  into the hottest loop of the program (TPUs pay 2x HBM for it).
+- **carry size**: total carry bytes at the handle's trace shapes above
+  ``contract.max_carry_kb`` — the structural-blowup tripwire for e.g. a
+  whole histogram stack accidentally carried across levels instead of
+  being consumed in-body.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..engine import (CheckContext, Finding, aval_nbytes, iter_eqns,
+                      scan_carry_avals, short_aval, while_carry_avals)
+
+WIDE_DTYPES = {"float64", "complex128"}
+
+
+def _loop_carries(jaxpr):
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name == "scan":
+            yield eqn, scan_carry_avals(eqn)
+        elif name == "while":
+            yield eqn, while_carry_avals(eqn)
+
+
+def check_carries(ctx: CheckContext) -> Iterator[Finding]:
+    limit = int(ctx.contract.max_carry_kb * 1024)
+    for tp in ctx.programs:
+        seen = set()
+        for eqn, avals in _loop_carries(tp.jaxpr):
+            loop = eqn.primitive.name
+            for i, aval in enumerate(avals):
+                if getattr(aval, "weak_type", False) \
+                        and getattr(aval, "ndim", 0) >= 1:
+                    key = ("weak", loop, i, short_aval(aval))
+                    if key not in seen:
+                        seen.add(key)
+                        yield ctx.finding(
+                            "carry-stability",
+                            f"weak-typed array carry[{i}] "
+                            f"{short_aval(aval)} in {loop} — a python "
+                            "literal was broadcast into the loop carry",
+                            detail=f"weak carry[{i}] {short_aval(aval)} "
+                                   f"in {loop}",
+                            spec=tp.spec,
+                            hint="seed the carry with an explicitly "
+                                 "dtyped array (jnp.zeros(..., dtype)/"
+                                 ".astype) so promotion is pinned")
+                if aval.dtype.name in WIDE_DTYPES:
+                    key = ("wide", loop, i, aval.dtype.name)
+                    if key not in seen:
+                        seen.add(key)
+                        yield ctx.finding(
+                            "carry-stability",
+                            f"{aval.dtype.name} carry[{i}] in {loop} — "
+                            "an x64 value rode into the loop carry",
+                            detail=f"{aval.dtype.name} carry[{i}] in {loop}",
+                            spec=tp.spec,
+                            hint="cast to f32 before the loop; x64 doubles "
+                                 "carry HBM and serializes on TPU")
+            total = sum(aval_nbytes(a) for a in avals)
+            if total > limit:
+                key = ("size", loop, len(avals))
+                if key not in seen:
+                    seen.add(key)
+                    yield ctx.finding(
+                        "carry-stability",
+                        f"{loop} carry is {total} bytes across "
+                        f"{len(avals)} leaves at trace shapes — over the "
+                        f"contract bound of {limit} "
+                        f"({ctx.contract.max_carry_kb:g} KiB)",
+                        detail=f"oversized {loop} carry",
+                        spec=tp.spec,
+                        hint="consume bulky intermediates in-body instead "
+                             "of carrying them across iterations, or "
+                             "raise max_carry_kb with a justification")

@@ -1,0 +1,97 @@
+"""collective-symmetry (traced): collectives only over contracted mesh
+axes, and the same collective sequence on every branch of a ``cond``.
+
+xtpulint's checker of the same slug pattern-matches the *source* for
+rank-dependent collective shapes; this one reads the truth from the
+jaxpr: every ``psum``/``all_gather``/... eqn names its axes in params
+(``axes`` for psum-family, ``axis_name`` for gather-family), so an axis
+outside ``contract.mesh_axes`` — or any collective at all in a meshless
+tier like serve — is a structural error, not a style question. Branch
+asymmetry is the classic SPMD deadlock: if the two sides of a
+``lax.cond`` issue different collective sequences and the predicate ever
+diverges across shards, every device blocks in a different collective.
+jax usually converts such conds to ``select``, so an asymmetric cond
+that *survives* to the jaxpr is exactly the dangerous kind.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+from ..engine import CheckContext, Finding, iter_eqns
+
+COLLECTIVE_PRIMS = {
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter",
+}
+
+
+def _axis_names(eqn) -> Tuple[str, ...]:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _collective_signature(jaxpr) -> List[Tuple[str, Tuple[str, ...]]]:
+    return [(eqn.primitive.name, _axis_names(eqn))
+            for eqn in iter_eqns(jaxpr)
+            if eqn.primitive.name in COLLECTIVE_PRIMS]
+
+
+def check_collectives(ctx: CheckContext) -> Iterator[Finding]:
+    allowed = set(ctx.contract.mesh_axes)
+    for tp in ctx.programs:
+        seen = set()
+        for eqn in iter_eqns(tp.jaxpr):
+            prim = eqn.primitive.name
+            if prim in COLLECTIVE_PRIMS:
+                if not allowed:
+                    if ("meshless", prim) not in seen:
+                        seen.add(("meshless", prim))
+                        yield ctx.finding(
+                            "collective-symmetry",
+                            f"collective `{prim}` in a tier whose contract "
+                            "declares no mesh axes",
+                            detail=f"{prim} in meshless tier",
+                            spec=tp.spec,
+                            hint="single-device tiers must not contain "
+                                 "collectives; if this tier went "
+                                 "multi-device, add its mesh axes to the "
+                                 "contract")
+                    continue
+                for name in _axis_names(eqn):
+                    if name not in allowed and ("axis", prim, name) \
+                            not in seen:
+                        seen.add(("axis", prim, name))
+                        yield ctx.finding(
+                            "collective-symmetry",
+                            f"`{prim}` over axis {name!r} — not a "
+                            f"contract mesh axis {sorted(allowed)}",
+                            detail=f"{prim} over {name}",
+                            spec=tp.spec,
+                            hint="collectives must run over the declared "
+                                 "data mesh; a stray axis name usually "
+                                 "means a hardcoded axis string drifted "
+                                 "from context.DATA_AXIS")
+            elif prim == "cond":
+                sigs = [_collective_signature(b)
+                        for b in eqn.params.get("branches", ())]
+                if sigs and any(s != sigs[0] for s in sigs[1:]) \
+                        and ("cond",) not in seen:
+                    seen.add(("cond",))
+                    desc = " vs ".join(
+                        "[" + ",".join(p for p, _ in s) + "]"
+                        for s in sigs)
+                    yield ctx.finding(
+                        "collective-symmetry",
+                        "cond branches issue different collective "
+                        f"sequences ({desc}) — deadlock if the predicate "
+                        "ever diverges across shards",
+                        detail="asymmetric collectives across cond",
+                        spec=tp.spec,
+                        hint="hoist the collectives out of the cond, or "
+                             "make every branch issue the identical "
+                             "sequence (reduce a zero contribution)")

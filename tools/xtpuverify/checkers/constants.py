@@ -1,0 +1,57 @@
+"""constant-bloat: no large literal arrays baked into the traced jaxprs.
+
+A closed-over concrete array becomes a jaxpr const: it is embedded in
+every compiled variant of the program (one copy per static-arg cache
+entry), re-uploaded on every compile, and — because it participates in
+the trace by *value* — silently couples the compiled artifact to
+whatever host state produced it. The idiomatic fix in this codebase is
+to pass the array as a traced argument, or mark it static only if it is
+genuinely tiny (cut thresholds, monotone masks). Consts live on the
+nested ``ClosedJaxpr``s (inner pjit closures), not only the top level,
+so the walk covers both.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from ..engine import CheckContext, Finding, iter_closed_jaxprs
+
+
+def _nbytes(const) -> int:
+    n = getattr(const, "nbytes", None)
+    if n is not None:
+        return int(n)
+    try:
+        return int(np.asarray(const).nbytes)
+    except Exception:  # noqa: BLE001 - non-array const (rare): ignore
+        return 0
+
+
+def check_constants(ctx: CheckContext) -> Iterator[Finding]:
+    limit = ctx.contract.max_const_bytes
+    for tp in ctx.programs:
+        seen_ids = set()
+        for closed in iter_closed_jaxprs(tp.jaxpr):
+            for const in getattr(closed, "consts", ()):
+                if id(const) in seen_ids:
+                    continue
+                seen_ids.add(id(const))
+                n = _nbytes(const)
+                if n <= limit:
+                    continue
+                shape = "x".join(str(d)
+                                 for d in getattr(const, "shape", ()))
+                dtype = getattr(getattr(const, "dtype", None), "name",
+                                type(const).__name__)
+                yield ctx.finding(
+                    "constant-bloat",
+                    f"{n}-byte constant {dtype}[{shape}] baked into the "
+                    f"jaxpr (contract limit {limit}B) — duplicated per "
+                    "compiled variant and re-staged on every compile",
+                    detail=f"baked const {dtype}[{shape}]",
+                    spec=tp.spec,
+                    hint="pass the array as a traced argument instead of "
+                         "closing over a concrete value")

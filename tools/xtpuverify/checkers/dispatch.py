@@ -1,0 +1,62 @@
+"""dispatch-budget: the tier's steady scheduling unit runs at most
+``contract.dispatch_budget`` distinct compiled programs, the paged tier
+re-uploads zero pages per steady level, and no program smuggles a host
+round-trip in through a jax callback primitive (which would be an extra
+un-budgeted host<->device sync per dispatch).
+
+This is the static half of PR 11's megakernel guarantee: the runtime
+dispatch-count test measures a live run; this checker pins the *declared
+plan* — ``core.steady_round_dispatches()`` et al. — to the contract, so
+a refactor that quietly adds a third per-round program fails CI even on
+hosts where the runtime test is skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..engine import CheckContext, Finding, iter_eqns
+
+CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call",
+}
+
+
+def check_dispatch(ctx: CheckContext) -> Iterator[Finding]:
+    c, plan = ctx.contract, ctx.plan
+    n = len(plan.dispatches)
+    if n > c.dispatch_budget:
+        names = ", ".join(s.name for s in plan.dispatches)
+        yield ctx.finding(
+            "dispatch-budget",
+            f"{n} dispatches per {plan.unit} ({names}) exceed the "
+            f"contract budget of {c.dispatch_budget}",
+            detail=f"dispatches per {plan.unit} over budget",
+            hint="fold the extra program into an existing dispatch or "
+                 "raise the contract with a justification")
+    if c.uploads_per_level is not None:
+        got = plan.meta.get("uploads_per_level")
+        if got is None or got > c.uploads_per_level:
+            yield ctx.finding(
+                "dispatch-budget",
+                f"plan declares uploads_per_level={got!r}; contract "
+                f"requires <= {c.uploads_per_level}",
+                detail="uploads_per_level over contract",
+                hint="the steady page-major path must run from HBM-cached "
+                     "pages; re-uploading pages per level rebuilds the "
+                     "PCIe bottleneck the pager exists to remove")
+    for tp in ctx.programs:
+        hit = set()
+        for eqn in iter_eqns(tp.jaxpr):
+            name = eqn.primitive.name
+            if name in CALLBACK_PRIMS and name not in hit:
+                hit.add(name)
+                yield ctx.finding(
+                    "dispatch-budget",
+                    f"hidden host callback `{name}` inside the compiled "
+                    "program — an un-budgeted host round-trip per dispatch",
+                    detail=f"host callback {name}",
+                    spec=tp.spec,
+                    hint="move host logic outside the jitted program or "
+                         "compute the value on-device")

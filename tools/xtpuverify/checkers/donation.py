@@ -1,0 +1,60 @@
+"""donation-ineffective: declared buffer donation must materialize as
+input-output aliasing in the lowering.
+
+``donate_argnums`` is a *request* — XLA silently drops it when the
+donated input's shape/dtype/layout matches no output, and the only
+artifact of the failure is a doubled peak-HBM footprint (the exact
+regression the resident margin-donation and paged state-donation designs
+exist to prevent). The check is therefore on the lowered StableHLO: a
+program that declares donation must carry at least one
+``tf.aliasing_output`` attribute. Conversely, a contract with
+``donated=True`` requires some dispatch in the plan to declare donation
+at all — deleting the ``donate_argnums=`` from the jit wrapper is a
+one-line diff that no runtime test notices until an OOM.
+
+Lowering is the one expensive step in the verifier (~0.2-0.4 s per
+program on CPU), so only programs whose contract or spec mentions
+donation are lowered.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..engine import CheckContext, Finding
+
+ALIAS_MARKER = "tf.aliasing_output"
+
+
+def _declares_donation(tp) -> bool:
+    # Donation lives either on the spec (plan-declared) or baked into the
+    # jit wrapper itself (e.g. core._fused_round_fn's donate_argnums=(1,),
+    # visible on the Traced as a pytree-flattened index tuple).
+    return bool(tp.spec.donate_argnums) \
+        or bool(getattr(tp.traced, "donate_argnums", ()))
+
+
+def check_donation(ctx: CheckContext) -> Iterator[Finding]:
+    declared = [tp for tp in ctx.programs if _declares_donation(tp)]
+    if ctx.contract.donated and not declared:
+        yield ctx.finding(
+            "donation-ineffective",
+            "contract expects buffer donation but no dispatch in the plan "
+            "declares donate_argnums",
+            detail="donation missing from plan",
+            hint="restore donate_argnums on the jit wrapper (and mirror it "
+                 "in the handle's ProgramSpec) — without it the round "
+                 "holds two copies of the donated buffer")
+    for tp in declared:
+        if ALIAS_MARKER not in tp.lowered_text:
+            donated = tp.spec.donate_argnums \
+                or tuple(getattr(tp.traced, "donate_argnums", ()))
+            yield ctx.finding(
+                "donation-ineffective",
+                f"donate_argnums={donated} declared but the lowering "
+                f"contains no {ALIAS_MARKER} — XLA dropped the donation",
+                detail="declared donation not aliased",
+                spec=tp.spec,
+                hint="the donated input must match an output's "
+                     "shape+dtype; check for a dtype cast or reshape "
+                     "between the donated buffer and the result")

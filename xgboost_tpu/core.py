@@ -171,6 +171,21 @@ def _fused_round_fn(bins, margin, labels, weights, n_real, seed, iteration,
         has_missing=has_missing)
 
 
+def steady_round_dispatches():
+    """The jitted programs ONE steady resident boosting round dispatches,
+    in call order: the fused round itself and the NaN-guard reduction
+    (``_fused_step`` below is the driver that calls exactly these two).
+    This list is the source of truth for the megakernel tier's
+    dispatches-per-round budget — ``tests/test_mega.py`` pins it at
+    runtime, and ``tools/xtpuverify``'s dispatch-budget contract checks
+    it statically (xgboost_tpu/programs.py), so the budget survives even
+    where cache-hit calls run on the C++ fast path invisible to Python
+    hooks. Adding a per-round dispatch means growing this list AND
+    raising the contract in tools/xtpuverify/contracts.py — deliberately
+    two visible edits."""
+    return (_fused_round_fn, _margin_bad_rows)
+
+
 @_functools.partial(
     jax.jit,
     donate_argnums=(1,),  # margin: updated in place, caller rebinds

@@ -1,0 +1,50 @@
+"""Ops-tier program handles: the segmented-scan histogram accumulators.
+
+These are policy handles rather than standalone driver dispatches: the
+scan histogram runs embedded in the tier programs, but the
+``XTPU_SCAN_ACC`` accumulator policy (bf16 head + f32 residual, taken
+only behind the measured RMS gate — ``resolve_scan_acc``) is defined
+HERE, so the kernel is exported at both policy points and the
+dtype-discipline contracts pin the policy to the code:
+
+- ``ops.hist_scan``      (acc="f32")  — the default; bf16 must never
+  reach an accumulate primitive.
+- ``ops.hist_scan_bf16`` (acc="bf16") — the gated opt-in; bf16
+  accumulation is the point, and its contract allows exactly that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..programs import ProgramSpec, RoundPlan, _abstract, register_program
+
+_R, _F, _B, _NODES = 512, 8, 64, 8
+
+
+def _scan_hist_plan(acc: str) -> RoundPlan:
+    import jax
+
+    from .histogram import build_hist_scan
+
+    fn = jax.jit(functools.partial(build_hist_scan, n_nodes=_NODES,
+                                   max_nbins=_B, acc=acc))
+    spec = ProgramSpec(
+        name=f"hist_scan_{acc}",
+        fn=fn,
+        args=(_abstract((_R, _F), "uint8"),     # bins
+              _abstract((_R, 2), "float32"),    # gpair
+              _abstract((_R,), "int32")),       # rel_pos
+        src=build_hist_scan)
+    return RoundPlan(handle=f"ops.hist_scan{'' if acc == 'f32' else '_' + acc}",
+                     unit="pass", dispatches=[spec])
+
+
+@register_program("ops.hist_scan")
+def _hist_scan_f32() -> RoundPlan:
+    return _scan_hist_plan("f32")
+
+
+@register_program("ops.hist_scan_bf16")
+def _hist_scan_bf16() -> RoundPlan:
+    return _scan_hist_plan("bf16")

@@ -1,0 +1,38 @@
+"""Serve-tier program handle: the level-synchronous forest walk.
+
+One batch of raw-feature prediction is ONE dispatch of
+``boosting.predict._predict_margin`` (the serve registry's
+``margin_padded`` hot path routes every request through it); the handle
+traces it at the padded chunk geometry ``ForestPredictor`` compiles
+(pow2 node slots, ``TREE_CHUNK`` trees).
+"""
+
+from __future__ import annotations
+
+from ..programs import ProgramSpec, RoundPlan, _abstract, register_program
+
+_ROWS, _FEATS, _TREES, _NODES, _DEPTH = 256, 8, 64, 128, 6
+
+
+@register_program("serve.walk")
+def _serve_walk() -> RoundPlan:
+    from ..boosting.predict import _predict_margin
+
+    T, M = _TREES, _NODES
+    spec = ProgramSpec(
+        name="predict_margin",
+        fn=_predict_margin,
+        args=(_abstract((T, M), "int32"),       # split_feature
+              _abstract((T, M), "float32"),     # split_value
+              _abstract((T, M), "bool_"),       # default_left
+              _abstract((T, M), "bool_"),       # is_leaf
+              _abstract((T, M), "int32"),       # left_child
+              _abstract((T, M), "int32"),       # right_child
+              _abstract((T, M), "float32"),     # leaf_value
+              _abstract((T,), "float32"),       # tree_weight
+              _abstract((T, 1), "float32"),     # group_onehot
+              _abstract((_ROWS, _FEATS), "float32"),   # X
+              _abstract((1,), "float32")),      # base margin
+        kwargs=dict(max_depth=_DEPTH))
+    return RoundPlan(handle="serve.walk", unit="batch",
+                     dispatches=[spec])

@@ -1237,7 +1237,11 @@ class TreeGrower:
                                np.uint32(0)),
             base_weight=np.where(exists, base_weight, 0.0).astype(np.float32))
 
-    def _sharded(self, bins, gpair, n_real_bins, tree_mask, key) -> GrownTree:
+    def sharded_program(self):
+        """Build (and cache) the jitted shard_map grow program WITHOUT
+        dispatching it — the traceable handle exported through
+        ``xgboost_tpu/tree/programs.py`` for the mesh row/col contract
+        checks; ``_sharded`` below invokes the same cached object."""
         from ..context import DATA_AXIS
 
         if self._sharded_fn is None:
@@ -1287,7 +1291,11 @@ class TreeGrower:
                 in_specs=in_specs,
                 out_specs=out_specs,
                 check_vma=self.split_mode != "col" and not mega_possible))
-        return self._sharded_fn(bins, gpair, n_real_bins, tree_mask, key)
+        return self._sharded_fn
+
+    def _sharded(self, bins, gpair, n_real_bins, tree_mask, key) -> GrownTree:
+        return self.sharded_program()(bins, gpair, n_real_bins, tree_mask,
+                                      key)
 
     def to_tree_model(self, g: GrownTree) -> TreeModel:
         """Pull device arrays to host, compact the heap, attach raw split

@@ -772,13 +772,34 @@ class _PageKernels:
         remote device: ~4 kernel dispatches plus an eval dispatch per
         level collapse into one program launch per level.
         -> (positions, stash, next_state, prev-dict)."""
-        from ..ops.split import COARSE_B, WINDOW
-
-        coarse = ev.coarse
         kind = None if prev is None else prev["kind"]
         cat = None if prev is None else prev["cat"]
         n_arr = 0 if prev is None else len(prev["arrs"])
         W = None if cat is None else int(cat[1].shape[1])
+        fused = self.level_full_fn(paged, ev, n_static, kind, W, n_arr,
+                                   len(cached))
+        consts = (gpair,
+                  jnp.int32(0 if prev is None else prev["lo"]),
+                  jnp.int32(0 if prev is None else prev["n_level"]),
+                  jnp.int32(lo), jnp.int32(n_level), jnp.int32(depth))
+        if prev is not None:
+            consts = consts + prev["arrs"] + (() if cat is None
+                                              else tuple(cat))
+        outs = fused(positions, state, tree_mask, key, consts,
+                     tuple(jnp.int32(s) for s, _, _ in cached),
+                     tuple(p for _, _, p in cached))
+        stash, state_n, prev_n = ev._package(tuple(outs[1:]), lo, n_level)
+        return outs[0], stash, state_n, prev_n
+
+    def level_full_fn(self, paged, ev, n_static, kind, W, n_arr, n_cached):
+        """Build (and cache) the whole-level compiled program WITHOUT
+        dispatching it: ``level_full`` above invokes exactly this cached
+        object, and ``xgboost_tpu/tree/programs.py`` exports it as the
+        traceable handle behind the paged dispatch-budget /
+        uploads-per-level contracts (tools/xtpuverify)."""
+        from ..ops.split import COARSE_B, WINDOW
+
+        coarse = ev.coarse
         dec = _page_decoder(paged)
         mb = self.missing_bin
         hk = self.hist_kernel
@@ -837,21 +858,9 @@ class _PageKernels:
             # state would just trip jax's alias check every level
             return jax.jit(fn, donate_argnums=(0,) if ev.deep else (0, 1))
 
-        fused = self._cached(
-            ("levelfull", kind, n_static, W, coarse, len(cached), ev.deep)
+        return self._cached(
+            ("levelfull", kind, n_static, W, coarse, n_cached, ev.deep)
             + _page_key(paged), build)
-        consts = (gpair,
-                  jnp.int32(0 if prev is None else prev["lo"]),
-                  jnp.int32(0 if prev is None else prev["n_level"]),
-                  jnp.int32(lo), jnp.int32(n_level), jnp.int32(depth))
-        if prev is not None:
-            consts = consts + prev["arrs"] + (() if cat is None
-                                              else tuple(cat))
-        outs = fused(positions, state, tree_mask, key, consts,
-                     tuple(jnp.int32(s) for s, _, _ in cached),
-                     tuple(p for _, _, p in cached))
-        stash, state_n, prev_n = ev._package(tuple(outs[1:]), lo, n_level)
-        return outs[0], stash, state_n, prev_n
 
     def final_advance(self, paged, positions, prev, n_static):
         """Advance-only pass for the LAST evaluated level (leaf routing)."""
