@@ -18,7 +18,9 @@ headline metric stays the 1M config for round-over-round comparability.
 Env knobs: BENCH_ROWS (default 1e6), BENCH_ROUNDS (default 20),
 BENCH_SKIP_BASELINE=1 to reuse the last stored baseline time,
 BENCH_11M=0 to skip the north-star shape, BENCH_OBS=0 to skip the
-xtpuobs tracing-overhead + stage-drift keys (tools/perf_report.py).
+xtpuobs tracing-overhead + stage-drift keys (tools/perf_report.py) and
+the xtpuflight keys (overlap_hidden_pct, straggler_skew_pct,
+hbm_peak_bytes_per_round, postmortem_write_ms).
 """
 
 from __future__ import annotations
@@ -453,6 +455,110 @@ def bench_checkpoint_overhead(X, y):
     return round(max(0.0, (best - base) / base * 100.0), 3)
 
 
+def bench_flight():
+    """xtpuflight keys (BENCH_OBS): aggregate compute-hidden fraction of
+    the streamed tier's ``ring/upload`` spans, per-stage rank skew of a
+    small virtual multi-rank world (merged, clock-aligned rings), the
+    per-round HBM peak watermark, and the black-box bundle write cost."""
+    import tempfile
+    import threading
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.obs import flight, memory
+    from xgboost_tpu.obs import trace as tr
+    from xgboost_tpu.obs.trace import Tracer
+    from xgboost_tpu.parallel.collective import InMemoryCommunicator
+    from xgboost_tpu.parallel.resilience import (ResilientCommunicator,
+                                                 op_context)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from perf_report import _train_paged
+    from trace_analyze import overlap_hidden_pct, straggler_report
+
+    out = {}
+    rows = int(os.environ.get("BENCH_OBS_ROWS", 200_000))
+
+    # ---- overlap_hidden_pct: streamed paged run, ASYNC tracing (the
+    # spans time real dispatch/blocking, not forced sync), ring/upload
+    # spans scored against other-thread compute spans
+    env_keep = {k: os.environ.get(k) for k in
+                ("XTPU_PAGE_ROWS", "XTPU_PAGED_COLLAPSE",
+                 "XTPU_PAGE_CACHE_BYTES")}
+    os.environ["XTPU_PAGE_ROWS"] = str(max(rows // 4, 1))
+    os.environ["XTPU_PAGED_COLLAPSE"] = "0"
+    os.environ["XTPU_PAGE_CACHE_BYTES"] = "0"
+    was_traced = tr.enabled()
+    try:
+        with tempfile.TemporaryDirectory(prefix="xtpu_bench_flight_") as d:
+            tr.enable()
+            _train_paged(rows, COLS, DEPTH, 2, 4, d, "w")  # compile
+            tr.reset()
+            _train_paged(rows, COLS, DEPTH, 3, 4, d, "m")
+            rec = flight.FlightRecorder(rank=0, world=1)
+            out["overlap_hidden_pct"] = overlap_hidden_pct([rec.ring_doc()])
+    finally:
+        if not was_traced:
+            tr.disable()
+        for k, v in env_keep.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # ---- straggler_skew_pct: 4 virtual ranks, resilient allreduces
+    # under per-rank rings, clocks aligned, merged timeline built
+    world = InMemoryCommunicator.make_world(4)
+    rings = [None] * 4
+
+    def run_rank(rank):
+        comm = ResilientCommunicator(world[rank])
+        rec = flight.FlightRecorder(
+            comm=comm, tracer=Tracer(capacity=4096, annotate_device=False))
+        rec.sync_clocks(pings=4)
+        for _ in range(8):
+            with rec.span("hist/allreduce"):
+                with op_context("bench/hist"):
+                    comm.allreduce(np.ones(4096, np.float32))
+        rings[rank] = rec.ring_doc()
+
+    threads = [threading.Thread(target=run_rank, args=(r,))
+               for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = straggler_report(rings, warn=False)
+    out["straggler_skew_pct"] = rep["straggler_skew_pct"]
+    merged = flight.merge_rings(rings)
+    out["flight_merged_spans"] = sum(
+        1 for ev in merged["traceEvents"] if ev.get("ph") == "X")
+
+    # ---- hbm_peak_bytes_per_round: resident train under the monitor
+    # (device allocator stats on TPU; explicit carry bookings on CPU)
+    mon = memory.enable()
+    try:
+        X, y = make_data(min(rows, 100_000), COLS)
+        dm = xgb.DMatrix(X, label=y)
+        timed_train(dm, 5)
+        out["hbm_peak_bytes_per_round"] = int(mon.peak_per_round())
+    finally:
+        memory.disable()
+
+    # ---- postmortem_write_ms: bundle write cost with a populated ring
+    with tempfile.TemporaryDirectory(prefix="xtpu_bench_bb_") as d:
+        box = flight.BlackBox(d, rank=0, world=1)
+        t_best = min(_timed_write(box, i) for i in range(3))
+        out["postmortem_write_ms"] = round(t_best * 1e3, 3)
+    return out
+
+
+def _timed_write(box, i):
+    t0 = time.perf_counter()
+    assert box.write(f"bench-{i}") is not None
+    return time.perf_counter() - t0
+
+
 def main():
     X, y = make_data(ROWS, COLS)
     ours_rps, auc = bench_ours(X, y)
@@ -547,6 +653,10 @@ def main():
             rows=int(os.environ.get("BENCH_OBS_ROWS", 200_000)),
             features=COLS, depth=DEPTH, rounds=3)
         result.update(rep["keys"])
+        # xtpuflight keys: overlap_hidden_pct (ROADMAP item 2's async
+        # psum signal), straggler_skew_pct over a 4-rank virtual world,
+        # the per-round HBM peak watermark, and the black-box write cost
+        result.update(bench_flight())
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # inference-serving SLOs (tools/bench_serve.py): open-loop mixed
         # 1/8/64/512-row workload through the micro-batcher; the four

@@ -1,0 +1,38 @@
+"""Bad twin: dispatch-budget — a flight-recorder hook smuggled INSIDE
+the compiled round program as a host callback.
+
+This is the observability hazard xtpuflight is designed around: spans,
+memory samples and straggler pings must live on the host side of the
+dispatch boundary (obs/flight.py, obs/memory.py).  A `debug_callback`
+inside the jitted program re-introduces a host round-trip per dispatch
+— exactly the serialization the tracer exists to measure, now baked
+into the measured program itself."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.flight_hook", dispatch_budget=1)
+
+
+def _record_sample(margin):
+    # stand-in for an obs hook: flight span / memory.sample from device
+    del margin
+
+
+@jax.jit  # VERIFY[dispatch-budget]
+def round_step(margin, delta):
+    out = margin + delta
+    # the smuggled recorder: a host callback per dispatch, invisible to
+    # the dispatch count but visible in the jaxpr
+    jax.debug.callback(_record_sample, jnp.sum(out))
+    return out
+
+
+def plan():
+    m = _abstract((512, 1), "float32")
+    return RoundPlan(handle="fx.flight_hook", unit="round", dispatches=[
+        ProgramSpec(name="round", fn=round_step, args=(m, m)),
+    ])

@@ -1,0 +1,36 @@
+"""Good twin: dispatch-budget — the flight-recorder hook stays on the
+host side of the dispatch boundary.
+
+Same round program as the bad twin minus the smuggled callback: the
+span open/close and memory sample happen around the dispatch (the
+obs/flight.py + obs/memory.py pattern), so the compiled program carries
+zero host-callback primitives and the jaxpr is clean."""
+
+import jax
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.flight_hook", dispatch_budget=1)
+
+
+@jax.jit
+def round_step(margin, delta):
+    return margin + delta
+
+
+def _traced_round(margin, delta):
+    # host-side instrumentation: the span and memory sample wrap the
+    # dispatch instead of riding inside it
+    from xgboost_tpu.obs import memory, trace
+    with trace.span("round/update", cat="round"):
+        out = round_step(margin, delta)
+    memory.sample("round")
+    return out
+
+
+def plan():
+    m = _abstract((512, 1), "float32")
+    return RoundPlan(handle="fx.flight_hook", unit="round", dispatches=[
+        ProgramSpec(name="round", fn=round_step, args=(m, m)),
+    ])

@@ -74,6 +74,44 @@ def test_disabled_span_is_shared_and_allocation_free():
     assert not grown, [str(d) for d in grown]
 
 
+def test_disabled_memory_hooks_are_allocation_free():
+    # same contract as the disabled tracer: the memory-accounting call
+    # sites core.py / paged.py / binned.py leave on the hot path must
+    # cost nothing when XTPU_FLIGHT_MEM is off (one predicate, no allocs)
+    from xgboost_tpu.obs import memory as mem
+    mem.disable()
+    assert not mem.enabled()
+    # warm the call sites first: the first pass may pay one-shot
+    # interpreter setup that is not a per-call cost
+    for _ in range(50):
+        mem.sample("round")
+        mem.book("carry/margin", 4096)
+        mem.unbook("carry/margin")
+        mem.note_round()
+    flt = tracemalloc.Filter(True, mem.__file__)
+    # a genuine per-call allocation fails every attempt; the retries only
+    # forgive one-shot noise (e.g. a stray background thread from an
+    # earlier test touching a hook once inside the measured window)
+    for attempt in range(3):
+        tracemalloc.start()
+        try:
+            gc.collect()
+            base = tracemalloc.take_snapshot().filter_traces([flt])
+            for _ in range(1000):
+                mem.sample("round")
+                mem.book("carry/margin", 4096)
+                mem.unbook("carry/margin")
+                mem.note_round()
+            after = tracemalloc.take_snapshot().filter_traces([flt])
+        finally:
+            tracemalloc.stop()
+        diff = after.compare_to(base, "lineno")
+        grown = [d for d in diff if d.size_diff > 0]
+        if not grown:
+            break
+    assert not grown, [str(d) for d in grown]
+
+
 def test_enabled_spans_record_nesting_and_args():
     tr.disable()
     t = tr.enable(capacity=128)

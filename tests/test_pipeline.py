@@ -7,6 +7,7 @@ loop at ANY stage boundary and restarting over the same workdir yields
 byte-identical promoted models — snapshots only make recovery cheaper,
 never different."""
 
+import io
 import json
 import os
 import threading
@@ -109,8 +110,20 @@ def test_kill_at_stage_recovers_byte_exact(stage, tmp_path, reference):
     plan = PipelineFaultPlan(
         kill_stage=stage, kill_epoch=1,
         kill_round=K + 2 if stage == "mid_epoch" else None)
-    with pytest.raises(KilledByChaos):
+    with pytest.raises(KilledByChaos) as ei:
         _run(tmp_path, chaos=plan)
+    # crash forensics: every kill point leaves a CRC-valid postmortem
+    # bundle in the workdir's black box, attached to the kill exception
+    from xgboost_tpu.obs.flight import render_postmortem, verify_bundle
+    bundle = getattr(ei.value, "bundle", None)
+    assert bundle is not None and os.path.exists(bundle), stage
+    doc = verify_bundle(bundle)
+    assert doc["reason"] == f"chaos-kill:{stage}"
+    assert doc["extra"]["stage"] == stage
+    assert doc["extra"]["epoch"] == 1
+    buf = io.StringIO()
+    render_postmortem(doc, file=buf)
+    assert f"chaos-kill:{stage}" in buf.getvalue()
     # recovery: a FRESH pipeline over the same workdir, no fault plan
     pipe = Pipeline(_config(tmp_path), server=Server(), holdout=HOLDOUT)
     pipe.run_pending()

@@ -93,8 +93,15 @@ def main():
         timed(1)  # compile the streaming programs at this cache state
         binned.reset_ring_stats()
         t_stream = timed(3)
-        ov = binned.streaming_overlap()
+        # one overlap formula in the repo: streaming_overlap routes
+        # through xgboost_tpu.obs.flight.hidden_fraction, the same kernel
+        # tools/trace_analyze.py applies to exported span intervals — so
+        # this line, bench.py's paged11m_streaming_overlap_pct and the
+        # analyzer's overlap_hidden_pct can never disagree on arithmetic
         rs = binned.ring_stats
+        ov = binned.streaming_overlap()
+        from xgboost_tpu.obs.flight import hidden_fraction
+        assert ov == hidden_fraction(rs["upload_s"], rs["blocked_s"])
         meq = rs["bytes"] / 3.0 / max(binned.bins_host.nbytes, 1)
         print(f"streaming (no cache): {t_stream / 3:.2f} s/round; "
               f"uploads/round={rs['uploads'] / 3:.1f} "

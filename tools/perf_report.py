@@ -30,8 +30,10 @@ epilogue advance; window/eval/exchange/fetch are host-side stages with
 no device floor (blank floor column).
 
 Usage: ``python tools/perf_report.py [--rows 200000 --depth 6 ...]``.
-``bench.py`` imports :func:`measure_overhead` / :func:`stage_report`
-for the BENCH_OBS keys.
+``--json`` emits ONE machine-readable doc; ``--budget X`` exits 1 when
+``stage_drift_max`` exceeds X, so CI can gate on drift
+(``tools/ci_checks.sh`` runs the smoke call). ``bench.py`` imports
+:func:`measure_overhead` / :func:`stage_report` for the BENCH_OBS keys.
 """
 
 from __future__ import annotations
@@ -338,6 +340,14 @@ def main():
                          "the resident path 5x)")
     ap.add_argument("--skip-mega", action="store_true",
                     help="omit the resident megakernel whole-round row")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output: ONE JSON doc (rows + keys), "
+                         "no markdown table")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="fail (exit 1) when stage_drift_max exceeds "
+                         "this threshold — makes the drift table a CI "
+                         "gate (floors are v5e peaks: on a CPU host use "
+                         "a proxy budget or none)")
     args = ap.parse_args()
 
     rep = stage_report(args.rows, args.features, args.depth, args.rounds,
@@ -351,17 +361,28 @@ def main():
         table.append(mr)
         out["higgs_stage_mega_round_ms"] = mr["measured_ms"]
         out["mega_round_drift_x"] = mr["drift_x"]
-    print(render_markdown(
-        table,
-        f"measured vs roofline — {args.rows / 1e6:g}M x {args.features}, "
-        f"depth {args.depth} (streamed paged proxy; mega row = resident "
-        f"whole round)"))
+    if not args.json:
+        print(render_markdown(
+            table,
+            f"measured vs roofline — {args.rows / 1e6:g}M x "
+            f"{args.features}, depth {args.depth} (streamed paged proxy; "
+            f"mega row = resident whole round)"))
     if not args.skip_overhead:
         out["obs_overhead_pct"] = round(measure_overhead(
             args.rows, args.features, args.depth,
             args.overhead_rounds), 3)
-    print("\n" + json.dumps(out))
+    if args.json:
+        print(json.dumps({"rows": table, "keys": out}))
+    else:
+        print("\n" + json.dumps(out))
+    drift = out.get("stage_drift_max")
+    if args.budget is not None and drift is not None \
+            and drift > args.budget:
+        print(f"FAIL: stage_drift_max {drift} exceeds budget "
+              f"{args.budget}", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Overlap & straggler analysis over exported flight rings.
+
+ROADMAP item 2's async-psum work is scored by ONE number — how much of
+each collective/transfer was hidden under compute — and the distributed
+tier's health by another — how far the slowest rank trails the cohort.
+This tool computes both from rings exported by
+:class:`xgboost_tpu.obs.flight.FlightRecorder` (the overlap arithmetic
+itself lives in ``xgboost_tpu/obs/flight.py`` — ``hidden_fraction`` /
+``covered_seconds`` — so ``data/binned.py``'s streaming-overlap counter
+and this offline analyzer can never drift apart):
+
+- **Overlap**: for every ``collective/*`` and ``ring/upload`` span, the
+  fraction of its wall time covered by non-target spans recorded on
+  OTHER threads of the same rank (the uploader/collective blocks its own
+  thread; hiding means someone else computed meanwhile). Aggregated to
+  ``overlap_hidden_pct`` = hidden seconds / target seconds * 100.
+- **Stragglers**: per stage (top-level span prefix), each rank's summed
+  time against the cohort mean -> ``straggler_skew_pct`` (the max over
+  stages of ``(slowest - mean) / mean * 100``), published as the
+  ``xtpu_straggler_skew_pct`` gauge; a typed
+  :class:`~xgboost_tpu.obs.flight.StragglerWarning` fires above the
+  threshold, naming the slow rank.
+
+Usage::
+
+    python tools/trace_analyze.py ring_rank*.json            # both reports
+    python tools/trace_analyze.py rings/*.json --merge t.json
+    python tools/trace_analyze.py rings/*.json --json --threshold 25
+
+``bench.py`` imports :func:`overlap_hidden_pct` /
+:func:`straggler_report` for the BENCH_OBS keys.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import warnings
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_TOOLS)
+for _p in (_TOOLS, _ROOT):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+from xgboost_tpu.obs.flight import (  # noqa: E402
+    StragglerWarning, covered_seconds, hidden_fraction, load_ring,
+    merge_rings)
+
+#: span-name prefixes whose wall time SHOULD be hidden under compute
+TARGET_PREFIXES = ("collective/", "ring/upload")
+
+#: default straggler threshold, percent over the cohort mean
+SKEW_THRESHOLD_PCT = 25.0
+
+
+def _is_target(name: str) -> bool:
+    return name.startswith(TARGET_PREFIXES)
+
+
+def overlap_rows(spans: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-target-span overlap rows for ONE rank's spans (dicts with
+    ``name``/``t0``/``t1``/``tid``). The cover set for a target span is
+    every non-target span on a DIFFERENT thread — work that proceeded
+    while the target blocked its own thread."""
+    spans = list(spans)
+    covers_by_tid: Dict[int, List] = {}
+    for s in spans:
+        if not _is_target(s["name"]):
+            covers_by_tid.setdefault(s.get("tid", 0), []).append(
+                (float(s["t0"]), float(s["t1"])))
+    rows = []
+    for s in spans:
+        if not _is_target(s["name"]):
+            continue
+        t0, t1 = float(s["t0"]), float(s["t1"])
+        covers = [iv for tid, ivs in covers_by_tid.items()
+                  if tid != s.get("tid", 0) for iv in ivs]
+        hidden_s = covered_seconds([(t0, t1)], covers)
+        frac = hidden_fraction(t1 - t0, (t1 - t0) - hidden_s)
+        rows.append({"name": s["name"], "t0": t0, "dur_s": t1 - t0,
+                     "hidden_s": hidden_s,
+                     "hidden_pct": None if frac is None
+                     else round(frac * 100.0, 3)})
+    return rows
+
+
+def overlap_hidden_pct(rings: Sequence[Any]) -> Optional[float]:
+    """Aggregate compute-hidden percentage over every target span in the
+    given rings (``None`` when no target span has any duration)."""
+    total = hidden = 0.0
+    for ring in rings:
+        doc = load_ring(ring)
+        for row in overlap_rows(doc["spans"]):
+            total += row["dur_s"]
+            hidden += row["hidden_s"]
+    frac = hidden_fraction(total, total - hidden)
+    return None if frac is None else round(frac * 100.0, 3)
+
+
+def _stage_of(name: str) -> str:
+    return name.split("/", 1)[0]
+
+
+def stage_rank_seconds(rings: Sequence[Any]) -> Dict[str, Dict[int, float]]:
+    """``{stage: {rank: summed seconds}}`` over all rings."""
+    out: Dict[str, Dict[int, float]] = {}
+    for ring in rings:
+        doc = load_ring(ring)
+        rank = int(doc["rank"])
+        for s in doc["spans"]:
+            st = out.setdefault(_stage_of(s["name"]), {})
+            st[rank] = st.get(rank, 0.0) \
+                + (float(s["t1"]) - float(s["t0"]))
+    return out
+
+
+def straggler_report(rings: Sequence[Any],
+                     threshold_pct: float = SKEW_THRESHOLD_PCT,
+                     warn: bool = True,
+                     publish: bool = True) -> Dict[str, Any]:
+    """Per-stage skew of the slowest rank against the cohort mean.
+
+    Returns ``{"stages": {stage: {...}}, "straggler_skew_pct",
+    "straggler_stage", "straggler_rank"}``. With ``publish``, sets the
+    ``xtpu_straggler_skew_pct`` gauge; with ``warn``, raises a
+    :class:`StragglerWarning` (via ``warnings.warn``) for the worst
+    stage over ``threshold_pct``."""
+    table = stage_rank_seconds(rings)
+    stages: Dict[str, Any] = {}
+    worst: Optional[Dict[str, Any]] = None
+    for stage, by_rank in sorted(table.items()):
+        if len(by_rank) < 2:
+            continue
+        mean = sum(by_rank.values()) / len(by_rank)
+        if mean <= 0:
+            continue
+        slow_rank, slow_s = max(by_rank.items(), key=lambda kv: kv[1])
+        skew = (slow_s - mean) / mean * 100.0
+        stages[stage] = {"mean_s": mean, "slowest_rank": slow_rank,
+                         "slowest_s": slow_s,
+                         "skew_pct": round(skew, 3),
+                         "ranks": len(by_rank)}
+        if worst is None or skew > worst["skew_pct"]:
+            worst = dict(stages[stage], stage=stage)
+    rep: Dict[str, Any] = {
+        "stages": stages,
+        "straggler_skew_pct": (None if worst is None
+                               else worst["skew_pct"]),
+        "straggler_stage": None if worst is None else worst["stage"],
+        "straggler_rank": (None if worst is None
+                           else worst["slowest_rank"]),
+    }
+    if publish and worst is not None:
+        from xgboost_tpu.obs.metrics import get_registry
+
+        get_registry().set_gauge(
+            "xtpu_straggler_skew_pct", worst["skew_pct"],
+            help="max per-stage skew of the slowest rank vs the cohort "
+                 "mean, percent")
+    if warn and worst is not None and worst["skew_pct"] > threshold_pct:
+        warnings.warn(StragglerWarning(
+            worst["stage"], worst["slowest_rank"], worst["skew_pct"],
+            threshold_pct))
+    return rep
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("rings", nargs="+", help="exported flight rings")
+    ap.add_argument("--merge", metavar="OUT",
+                    help="also write the merged Perfetto timeline here")
+    ap.add_argument("--threshold", type=float,
+                    default=SKEW_THRESHOLD_PCT,
+                    help="straggler warning threshold, percent")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output: one JSON doc, no tables")
+    args = ap.parse_args(argv)
+
+    rings = [load_ring(p) for p in args.rings]
+    ov = overlap_hidden_pct(rings)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always", StragglerWarning)
+        st = straggler_report(rings, threshold_pct=args.threshold)
+    out = {"overlap_hidden_pct": ov, **st,
+           "warnings": [str(w.message) for w in caught]}
+    if args.merge:
+        merged = merge_rings(rings)
+        with open(args.merge, "w", encoding="utf-8") as fh:
+            json.dump(merged, fh)
+        out["merged"] = args.merge
+    if args.json:
+        print(json.dumps(out))
+        return 0
+    print(f"rings: {len(rings)} "
+          f"(ranks {sorted(int(r['rank']) for r in rings)})")
+    print(f"overlap_hidden_pct: "
+          f"{'—' if ov is None else f'{ov:.1f}%'}")
+    if st["stages"]:
+        print("| stage | mean s | slowest rank | skew |")
+        print("|---|---|---|---|")
+        for stage, row in st["stages"].items():
+            print(f"| {stage} | {row['mean_s']:.4f} | "
+                  f"rank {row['slowest_rank']} ({row['slowest_s']:.4f}s) "
+                  f"| {row['skew_pct']:.1f}% |")
+    for w in caught:
+        print(f"WARNING: {w.message}", file=sys.stderr)
+    if args.merge:
+        print(f"merged timeline -> {args.merge}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,10 @@ diffs the bytes:
     resident depthwise | lossguide | paged (streamed) | mesh row-split
 
 Each traced cell must also actually RECORD the spans it claims to (an
-empty ring would make byte-equality vacuous).
+empty ring would make byte-equality vacuous). Two extra cells re-run
+resident and paged with the FULL xtpuflight stack armed (memory
+monitor, rank identity, black box) and additionally require a round of
+memory samples plus a CRC-valid postmortem bundle.
 
 The second half lints the one-registry Prometheus exposition
 (``obs.metrics.get_registry().render_prometheus()``) after exercising
@@ -160,6 +163,56 @@ def run_cells(rows: int, rounds: int):
     return results
 
 
+def run_flight_cells(rows: int, rounds: int):
+    """Byte-equality with the FULL flight recorder armed, not just the
+    bare tracer: memory monitor sampling every round and page level,
+    rank identity on the ring, black box armed. xtpuflight must be as
+    invisible to numerics as xtpuobs — and still leave a CRC-valid
+    postmortem bundle on demand."""
+    from xgboost_tpu.obs import flight, memory
+
+    X, y = _data(rows)
+    results = []
+    for name, fn, prefixes in CELLS:
+        if name not in ("resident", "paged"):
+            continue  # the cells with memory-accounting call sites
+        tr.disable()
+        raw_plain = fn(X, y, rounds)
+        tmp = tempfile.TemporaryDirectory(prefix="xtpu_flight_gate_")
+        t = tr.enable()
+        tr.set_identity(0, 1)
+        mon = memory.enable()
+        box = flight.arm(directory=tmp.name, rank=0, world=1,
+                         install_hooks=False)
+        try:
+            raw_flight = fn(X, y, rounds)
+            names = {s.name for s in t.spans()}
+            sampled = mon.snapshot()["samples"] > 0
+            bundle = box.write("validate-obs-flight")
+            bundle_ok = False
+            if bundle is not None:
+                try:
+                    flight.verify_bundle(bundle)
+                    bundle_ok = True
+                except flight.BundleCorrupt:
+                    pass
+        finally:
+            flight.disarm()
+            memory.disable()
+            tr.disable()
+            tmp.cleanup()
+        seen = any(n.startswith(p) for n in names for p in prefixes)
+        results.append({
+            "cell": f"{name}+flight",
+            "identical": raw_flight == raw_plain,
+            "spans": len(names),
+            "covered": seen and sampled and bundle_ok,
+            "ok": (raw_flight == raw_plain and seen and sampled
+                   and bundle_ok),
+        })
+    return results
+
+
 # ------------------------------------------------------- exposition lint
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
@@ -298,6 +351,7 @@ def main() -> int:
     args = ap.parse_args()
 
     results = run_cells(args.rows, args.rounds)
+    results += run_flight_cells(args.rows, args.rounds)
     wid = max(len(r["cell"]) for r in results)
     print(f"traced-vs-untraced byte equality ({args.rows} rows, "
           f"{args.rounds} rounds):")

@@ -29,6 +29,7 @@ from .objective import get_objective
 from .objective.base import _nan_policy
 from .tree.param import TrainParam
 from .utils import observer
+from .obs import memory as obs_memory
 from .obs import trace as obs_trace
 from .utils.timer import Monitor
 
@@ -822,6 +823,8 @@ class Booster:
                 state["margin"] = self.gbm.compute_margin(state)
             state["n_trees"] = total
         if fobj is None and self._fused_step(state, iteration):
+            if obs_memory.enabled():
+                self._mem_round(state)
             return
         margin = self.gbm.training_margin(state)
         with self._monitor.section("GetGradient"):
@@ -874,6 +877,19 @@ class Booster:
         if observer.enabled():
             observer.observe("margin", state["margin"], iteration)
         state["n_trees"] = self.gbm.version()
+        if obs_memory.enabled():
+            self._mem_round(state)
+
+    def _mem_round(self, state: Dict[str, Any]) -> None:
+        """HBM-accounting round boundary (callers gate on
+        ``obs_memory.enabled()`` so the default path stays free): book the
+        donated margin carry explicitly — allocator-less backends cannot
+        see it — then sample the watermark and close the round window."""
+        margin = state.get("margin")
+        if margin is not None and hasattr(margin, "nbytes"):
+            obs_memory.book("carry/margin", int(margin.nbytes))
+        obs_memory.sample("round")
+        obs_memory.note_round()
 
     def _fused_step(self, state: Dict[str, Any], iteration: int) -> bool:
         """One whole boosting round as a SINGLE jitted dispatch (gradient ->

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import memory as _mem
 from .quantile import HistogramCuts
 
 
@@ -444,11 +445,15 @@ class PagedBinnedMatrix:
 
     def streaming_overlap(self) -> Optional[float]:
         """Fraction of page-upload time hidden behind compute since the
-        last ``reset_ring_stats()`` (None until an upload happened)."""
-        up = self.ring_stats["upload_s"]
-        if up <= 0.0:
-            return None
-        return max(0.0, 1.0 - self.ring_stats["blocked_s"] / up)
+        last ``reset_ring_stats()`` (None until an upload happened).
+        Routes through the flight recorder's shared overlap kernel so
+        this counter and ``tools/trace_analyze.py``'s span-interval
+        version can never drift apart (same formula:
+        ``max(0, 1 - blocked/upload)``)."""
+        from ..obs.flight import hidden_fraction
+
+        return hidden_fraction(self.ring_stats["upload_s"],
+                               self.ring_stats["blocked_s"])
 
     @property
     def bins(self) -> "PagedBinnedMatrix":
@@ -546,6 +551,10 @@ class PagedBinnedMatrix:
                                              starts[i + depth]))
                 if uploaded and len(cache) < max_cached:
                     cache[key] = payload
+                    if _mem.enabled():
+                        # CPU-fallback HBM accounting: the page cache is
+                        # the paged tier's dominant resident allocation
+                        _mem.book("page_cache", len(cache) * page_bytes)
                 yield key, payload
 
     def pages(self, device=None):
@@ -656,6 +665,7 @@ class PagedBinnedMatrix:
                     "resident collapse failed (%s); falling back to the "
                     "streaming paged tier", e)
                 self._device_cache.clear()
+                _mem.unbook("page_cache")
                 return None
             if not got_page:
                 return None
@@ -663,6 +673,7 @@ class PagedBinnedMatrix:
                 bins=bins, cuts=self.cuts, max_nbins=self.max_nbins,
                 has_missing=self.has_missing)
             self._device_cache.clear()  # superseded by the resident array
+            _mem.unbook("page_cache")
         return self._resident
 
     def mesh_layout(self, world: int):
@@ -857,5 +868,6 @@ class PagedBinnedMatrix:
         search_bin_into(X, self.cuts, self.max_nbins - 1, grown[old_n:])
         self.bins_host = grown
         self._device_cache.clear()
+        _mem.unbook("page_cache")
         self._mesh_cache.clear()
         self._resident = None

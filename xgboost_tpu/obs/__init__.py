@@ -1,6 +1,6 @@
 """xtpuobs — the unified observability subsystem (docs/observability.md).
 
-Three instruments, one taxonomy:
+Five instruments, one taxonomy:
 
 - :mod:`~xgboost_tpu.obs.trace` — ring-buffered host spans paired with
   device-timeline annotations; ``XTPU_TRACE=1`` turns it on, export is
@@ -12,20 +12,31 @@ Three instruments, one taxonomy:
   :class:`Monitor` (the single copy; ``utils/timer.py`` and
   ``logging_utils.py`` re-export it), with the opt-in ``sync=True``
   mode that makes verbosity>=3 tables measure device work.
+- :mod:`~xgboost_tpu.obs.flight` — the distributed flight recorder:
+  ``(rank, world)``-tagged rings, clock-aligned multi-rank timeline
+  merging, the shared overlap kernel, and the crash black box
+  (``python -m xgboost_tpu.obs postmortem <bundle>`` renders a dump).
+- :mod:`~xgboost_tpu.obs.memory` — stage-boundary HBM watermarks
+  (``device.memory_stats()`` with explicit CPU bookings) behind
+  ``XTPU_FLIGHT_MEM=1``.
 
 ``tools/perf_report.py`` joins the measured spans against
-``tools/roofline.py`` floors into the stage-drift table.
+``tools/roofline.py`` floors into the stage-drift table;
+``tools/trace_analyze.py`` computes overlap/straggler reports from
+exported rings.
 """
 
-from . import metrics, trace
+from . import flight, memory, metrics, trace
+from .flight import BlackBox, FlightRecorder, StragglerWarning
 from .metrics import Family, HistogramData, MetricsRegistry, Sample, \
     get_registry
 from .monitor import Monitor, Timer, annotate, profile
 from .trace import Span, Tracer, span
 
 __all__ = [
-    "trace", "metrics",
+    "trace", "metrics", "flight", "memory",
     "Span", "Tracer", "span",
+    "FlightRecorder", "BlackBox", "StragglerWarning",
     "MetricsRegistry", "Family", "Sample", "HistogramData", "get_registry",
     "Monitor", "Timer", "annotate", "profile",
 ]

@@ -1,0 +1,217 @@
+"""HBM accounting: stage-boundary device-memory sampling + watermarks.
+
+The runtime complement to xtpuverify's static donation checker: the
+verifier proves a buffer *may* be reused; this module measures what the
+runtime actually held. A :class:`MemoryMonitor` samples
+``device.memory_stats()`` (``bytes_in_use`` / ``peak_bytes_in_use``,
+summed over addressable devices) at stage boundaries the drivers already
+mark (``round``, ``paged/level``, ``serve/batch``), tracks a live
+watermark + per-round peaks, and exposes both through the
+MetricsRegistry (``xtpu_hbm_bytes_in_use``, ``xtpu_hbm_peak_bytes``) and
+the bench key ``hbm_peak_bytes_per_round``.
+
+Backends without allocator stats (the CPU backend returns ``None``) fall
+back to EXPLICIT bookings: the paged tier books its device page cache
+(``data/binned.py``) and the resident tier books the donated margin
+carry (``core.py``), so the watermark still tracks the two buffers whose
+sizes the roadmap items argue about.
+
+Sampling is OFF by default and the disabled path is free: module-level
+:func:`sample` / :func:`book` / :func:`note_round` are one-predicate
+no-ops when no monitor is installed — ``tests/test_obs.py`` pins the
+disabled path to zero allocations exactly like the tracer's.
+
+Knobs (read at import; flip programmatically with :func:`enable` /
+:func:`disable`):
+
+- ``XTPU_FLIGHT_MEM`` — ``1`` enables HBM sampling (default ``0``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from .metrics import Family, Sample, get_registry
+
+__all__ = ["MemoryMonitor", "enable", "disable", "enabled", "monitor",
+           "sample", "book", "unbook", "note_round"]
+
+
+class MemoryMonitor:
+    """Watermark tracker over device allocator stats (or explicit
+    bookings where the backend has none)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._bookings: Dict[str, int] = {}
+        self._booked = 0                 # sum of explicit bookings, bytes
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.samples = 0
+        self.source = "booked"           # "device" once allocator stats seen
+        self._round_peak = 0
+        self._round_peaks: list = []     # per-round peak watermarks, bytes
+        self._last_tag = ""
+
+    # -- device read -------------------------------------------------------
+    def _device_bytes(self) -> Optional[int]:
+        """Summed ``bytes_in_use`` across addressable devices, or ``None``
+        when the backend exposes no allocator stats (CPU)."""
+        try:
+            import jax
+
+            total, got = 0, False
+            for d in jax.local_devices():
+                st = d.memory_stats()
+                if st:
+                    got = True
+                    total += int(st.get("bytes_in_use", 0))
+            return total if got else None
+        except Exception:  # pragma: no cover - jax-less analysis use
+            return None
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self, tag: str = "") -> int:
+        """Take one watermark sample; returns the live byte count."""
+        dev = self._device_bytes()
+        with self._lock:
+            if dev is not None:
+                self.source = "device"
+                live = dev
+            else:
+                live = self._booked
+            self.live_bytes = live
+            if live > self.peak_bytes:
+                self.peak_bytes = live
+            if live > self._round_peak:
+                self._round_peak = live
+            self.samples += 1
+            self._last_tag = tag
+        return live
+
+    def book(self, key: str, nbytes: int) -> None:
+        """Explicitly account ``nbytes`` live under ``key`` (CPU fallback
+        for buffers the backend's allocator can't see). Re-booking a key
+        replaces its previous size."""
+        nbytes = int(nbytes)
+        with self._lock:
+            self._booked += nbytes - self._bookings.get(key, 0)
+            self._bookings[key] = nbytes
+
+    def unbook(self, key: str) -> None:
+        with self._lock:
+            self._booked -= self._bookings.pop(key, 0)
+
+    def note_round(self) -> None:
+        """Close the current round's peak window (bounded history)."""
+        with self._lock:
+            self._round_peaks.append(self._round_peak)
+            if len(self._round_peaks) > 4096:
+                del self._round_peaks[:2048]
+            self._round_peak = self.live_bytes
+
+    # -- reading -----------------------------------------------------------
+    def peak_per_round(self) -> int:
+        """Max per-round peak watermark seen (falls back to the global
+        peak before the first round boundary)."""
+        with self._lock:
+            if self._round_peaks:
+                return max(self._round_peaks)
+            return self.peak_bytes
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "live_bytes": self.live_bytes,
+                "peak_bytes": self.peak_bytes,
+                "samples": self.samples,
+                "source": self.source,
+                "last_tag": self._last_tag,
+                "rounds": len(self._round_peaks),
+                "hbm_peak_bytes_per_round": (max(self._round_peaks)
+                                             if self._round_peaks
+                                             else self.peak_bytes),
+                "bookings": dict(self._bookings),
+            }
+
+    # -- registry ----------------------------------------------------------
+    def _collect(self):
+        with self._lock:
+            live, peak, n = self.live_bytes, self.peak_bytes, self.samples
+        return [
+            Family("xtpu_hbm_bytes_in_use", "gauge",
+                   "live device-memory watermark, bytes",
+                   [Sample(float(live))]),
+            Family("xtpu_hbm_peak_bytes", "gauge",
+                   "peak device-memory watermark, bytes",
+                   [Sample(float(peak))]),
+            Family("xtpu_hbm_samples_total", "counter",
+                   "memory watermark samples taken",
+                   [Sample(float(n))]),
+        ]
+
+
+# ------------------------------------------------------- module-level state
+
+_monitor: Optional[MemoryMonitor] = None
+_collector_sid: Optional[int] = None
+
+
+def enable() -> MemoryMonitor:
+    """Install the process memory monitor (idempotent)."""
+    global _monitor, _collector_sid
+    if _monitor is None:
+        _monitor = MemoryMonitor()
+        _collector_sid = get_registry().register(MemoryMonitor._collect,
+                                                 owner=_monitor)
+    return _monitor
+
+
+def disable() -> None:
+    global _monitor, _collector_sid
+    if _monitor is not None:
+        if _collector_sid is not None:
+            get_registry().unregister(_collector_sid)
+            _collector_sid = None
+        _monitor = None
+
+
+def enabled() -> bool:
+    return _monitor is not None
+
+
+def monitor() -> Optional[MemoryMonitor]:
+    return _monitor
+
+
+def sample(tag: str = "") -> None:
+    """Stage-boundary hook. Disabled: one predicate, no allocation."""
+    m = _monitor
+    if m is not None:
+        m.sample(tag)
+
+
+def book(key: str, nbytes: int) -> None:
+    """Explicit-booking hook (CPU fallback). Free when disabled."""
+    m = _monitor
+    if m is not None:
+        m.book(key, nbytes)
+
+
+def unbook(key: str) -> None:
+    m = _monitor
+    if m is not None:
+        m.unbook(key)
+
+
+def note_round() -> None:
+    """Round-boundary hook. Free when disabled."""
+    m = _monitor
+    if m is not None:
+        m.note_round()
+
+
+if os.environ.get("XTPU_FLIGHT_MEM", "0") not in ("0", ""):
+    enable()

@@ -37,7 +37,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["Span", "Tracer", "enable", "disable", "enabled", "tracer",
-           "span", "instant", "export", "reset", "sync", "set_sync"]
+           "span", "instant", "export", "reset", "sync", "set_sync",
+           "set_identity"]
 
 
 class Span:
@@ -134,6 +135,8 @@ class Tracer:
         self._lock = threading.Lock()
         self._tl = threading.local()
         self._epoch = time.perf_counter()  # export time base
+        self.rank: Optional[int] = None    # distributed identity (flight)
+        self.world: Optional[int] = None
         self._ann_cls = None
         if annotate_device:
             try:
@@ -141,6 +144,13 @@ class Tracer:
                 self._ann_cls = jax.profiler.TraceAnnotation
             except Exception:  # pragma: no cover - jax-less analysis use
                 self._ann_cls = None
+
+    def set_identity(self, rank: int, world: int) -> None:
+        """Tag this ring with its ``(rank, world)`` — exported spans and
+        Perfetto events carry the identity so N rings stay attributable
+        after :func:`~.flight.merge_rings`."""
+        self.rank = int(rank)
+        self.world = int(world)
 
     # ------------------------------------------------------------ recording
     def span(self, name: str, cat: str = "",
@@ -189,6 +199,10 @@ class Tracer:
         microsecond timestamps relative to the tracer epoch)."""
         events = []
         pid = os.getpid()
+        if self.rank is not None:
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": f"rank {self.rank}/"
+                                            f"{self.world}"}})
         for s in self.spans():
             ev: Dict[str, Any] = {
                 "name": s.name, "ph": "X", "pid": pid, "tid": s.tid,
@@ -199,6 +213,8 @@ class Tracer:
                 ev["cat"] = s.cat
             if s.args:
                 ev["args"] = dict(s.args)
+            if self.rank is not None:
+                ev.setdefault("args", {})["rank"] = self.rank
             events.append(ev)
         return {"displayTimeUnit": "ms", "traceEvents": events}
 
@@ -210,7 +226,10 @@ class Tracer:
         if path.endswith(".jsonl"):
             with open(path, "w", encoding="utf-8") as fh:
                 for s in spans:
-                    fh.write(json.dumps(s.to_dict()) + "\n")
+                    d = s.to_dict()
+                    if self.rank is not None:
+                        d["rank"], d["world"] = self.rank, self.world
+                    fh.write(json.dumps(d) + "\n")
         else:
             with open(path, "w", encoding="utf-8") as fh:
                 json.dump(self.to_perfetto(), fh)
@@ -277,6 +296,14 @@ def reset() -> None:
     t = _tracer
     if t is not None:
         t.clear()
+
+
+def set_identity(rank: int, world: int) -> None:
+    """Tag the global tracer (if enabled) with its distributed identity;
+    the flight recorder calls this once rank/world are known."""
+    t = _tracer
+    if t is not None:
+        t.set_identity(rank, world)
 
 
 _SYNC = os.environ.get("XTPU_TRACE_SYNC", "0") not in ("0", "")

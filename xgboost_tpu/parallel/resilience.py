@@ -52,7 +52,19 @@ from .collective import Communicator, get_communicator
 # --------------------------------------------------------------- typed errors
 
 class CollectiveError(RuntimeError):
-    """Base class of every resilient-collective failure."""
+    """Base class of every resilient-collective failure.
+
+    The resilient wrapper attaches structured forensics before raising:
+    ``rank`` (the local rank that detected the failure), ``label`` (the
+    :class:`op_context` call-site label), ``seq`` (collective sequence
+    number) and ``peer`` (the remote rank a gather implicated, when
+    known) — so handlers and the flight recorder's postmortem bundles
+    name the offending rank without parsing the message."""
+
+    rank: Optional[int] = None
+    label: Optional[str] = None
+    seq: Optional[int] = None
+    peer: Optional[int] = None
 
 
 class TransientCollectiveError(CollectiveError):
@@ -259,6 +271,18 @@ class ResilientCommunicator(Communicator):
         return (self._seq, kind, tuple(int(s) for s in shape), str(dtype),
                 current_op_label())
 
+    def _forensics(self, err: CollectiveError, seq: int,
+                   peer: Optional[int] = None) -> CollectiveError:
+        """Attach structured rank/op forensics (the header itself must
+        stay rank-symmetric — the sum-reduced hash check needs every
+        rank to contribute the identical tuple — so the local rank id
+        travels on the exception, not in band)."""
+        err.rank = self.get_rank()
+        err.label = current_op_label()
+        err.seq = seq
+        err.peer = peer
+        return err
+
     # -- collectives ---------------------------------------------------------
     def allreduce(self, values: np.ndarray, op: str = "sum") -> np.ndarray:
         arr = np.asarray(values)
@@ -289,25 +313,26 @@ class ResilientCommunicator(Communicator):
         if op == "sum":
             if rh != h * world:
                 self.stats["desyncs"] += 1
-                raise CollectiveDesync(
+                raise self._forensics(CollectiveDesync(
                     f"{what}: rank {self.get_rank()} header hash mismatch "
                     f"(got {rh}, want {h * world}); ranks disagree on the "
-                    "collective schedule (sequence/op-kind/shape/dtype)")
+                    "collective schedule (sequence/op-kind/shape/dtype)"),
+                    seq)
             expect = float(payload.sum(dtype=np.float64))
             scale = float(np.abs(payload).sum(dtype=np.float64)) + 1.0
             if abs(rc - expect) > 1e-3 * scale + 1e-5:
                 self.stats["corruptions"] += 1
-                raise CollectiveCorruption(
+                raise self._forensics(CollectiveCorruption(
                     f"{what}: control sum {rc} != payload sum {expect} "
                     f"(rank {self.get_rank()}) — transport corrupted the "
-                    "reduction payload")
+                    "reduction payload"), seq)
         else:
             if rh != h or -rc != h:
                 self.stats["desyncs"] += 1
-                raise CollectiveDesync(
+                raise self._forensics(CollectiveDesync(
                     f"{what}: rank {self.get_rank()} header hash mismatch "
                     f"(got [{rh}, {rc}], want [{h}, {-h}]); ranks disagree "
-                    "on the collective schedule")
+                    "on the collective schedule"), seq)
         return payload.reshape(arr.shape).astype(arr.dtype, copy=False)
 
     def allgather_objects(self, obj: Any) -> List[Any]:
@@ -333,23 +358,26 @@ class ResilientCommunicator(Communicator):
         for rank, slot in enumerate(slots):
             if not (isinstance(slot, tuple) and len(slot) == 3):
                 self.stats["desyncs"] += 1
-                raise CollectiveDesync(
+                raise self._forensics(CollectiveDesync(
                     f"{what}: rank {rank} contributed an unwrapped payload "
-                    "— it is not running the same resilient protocol")
+                    "— it is not running the same resilient protocol"),
+                    seq, peer=rank)
             rhead, rcrc, robj = slot
             if tuple(rhead) != header:
                 self.stats["desyncs"] += 1
-                raise CollectiveDesync(
+                raise self._forensics(CollectiveDesync(
                     f"{what}: rank {rank} header {rhead} != local {header} "
-                    "— ranks disagree on the collective schedule")
+                    "— ranks disagree on the collective schedule"),
+                    seq, peer=rank)
             if rcrc is not None:
                 from . import wire
 
                 if zlib.crc32(wire.encode(robj)) != rcrc:
                     self.stats["corruptions"] += 1
-                    raise CollectiveCorruption(
+                    raise self._forensics(CollectiveCorruption(
                         f"{what}: rank {rank} payload CRC mismatch — "
-                        "transport corrupted the gathered object")
+                        "transport corrupted the gathered object"),
+                        seq, peer=rank)
             out.append(robj)
         return out
 

@@ -68,7 +68,28 @@ class PipelineFaultPlan:
         self._fired = True
         if self.corrupt_newest_snapshot and pipeline is not None:
             self._corrupt_newest_snapshot(pipeline)
-        raise KilledByChaos(stage, epoch)
+        err = KilledByChaos(stage, epoch)
+        err.bundle = self._write_black_box(stage, epoch, pipeline, err)
+        raise err
+
+    def _write_black_box(self, stage: str, epoch: int, pipeline,
+                         err) -> Optional[str]:
+        """Every kill leaves a readable postmortem: the bundle is written
+        HERE, at the kill instant, because :class:`KilledByChaos` is a
+        ``BaseException`` the harness catches — it never reaches the
+        process excepthook the armed black box watches. Returns the
+        bundle path (also attached to the exception as ``.bundle``), or
+        ``None`` when neither the pipeline nor the global box exists."""
+        box = getattr(pipeline, "blackbox", None)
+        if box is None:
+            from ..obs import flight
+
+            box = flight.armed()
+        if box is None:
+            return None
+        return box.write(f"chaos-kill:{stage}", exc=err,
+                         extra={"stage": stage, "epoch": epoch,
+                                "kill_round": self.kill_round})
 
     def _corrupt_newest_snapshot(self, pipeline) -> None:
         """Truncate the newest snapshot DATA file while keeping its

@@ -100,6 +100,12 @@ class Pipeline:
         self._dm = None          # live training matrix (pages 0.._next_page-1)
         self._next_page = 0      # first page NOT yet absorbed into _dm
         self._last_promotion_ms: Optional[float] = None
+        # crash forensics: any chaos kill (or caller-routed failure)
+        # leaves a CRC-sidecar postmortem bundle under the workdir —
+        # construction is free, I/O happens only on write
+        from ..obs.flight import BlackBox
+
+        self.blackbox = BlackBox(os.path.join(config.workdir, "blackbox"))
         get_registry().register(Pipeline._collect_obs, owner=self)
 
     def _collect_obs(self) -> List[Family]:

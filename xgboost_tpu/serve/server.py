@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from ..logging_utils import logger
+from ..obs import memory as _mem
 from ..obs import trace as _trace
 from ..obs.metrics import Family, Sample, get_registry
 from .batcher import MicroBatcher, PredictRequest
@@ -281,6 +282,7 @@ class Server:
                     values.append(v[:size])
                     margins.append(m[:size])
                     off += size
+            _mem.sample("serve/batch")   # batch boundary; free when off
             value = np.concatenate(values) if len(values) > 1 else values[0]
             margin = (np.concatenate(margins) if len(margins) > 1
                       else margins[0])

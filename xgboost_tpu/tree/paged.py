@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..context import shard_map as _shard_map
+from ..obs import memory as _mem
 from ..obs import trace as _trace
 from ..ops.histogram import build_hist
 from ..ops.partition import advance_positions_level, update_positions
@@ -1676,6 +1677,10 @@ class PagedGrower(TreeGrower):
                         jnp.int32(lo), jnp.int32(n_level))
                     _trace.sync(stash)
             stashes.append(stash)
+            # level boundary: HBM watermark sample (free when the
+            # memory monitor is off — the page cache + ring buffers peak
+            # here, between the level's last upload and its eval)
+            _mem.sample("paged/level")
             # ONE-BEHIND early stop: the previous level's eval finished
             # long before this level's page passes were even dispatched, so
             # this tiny pull costs one RTT that overlaps the device's
@@ -1953,6 +1958,7 @@ class PagedMultiTargetGrower(MultiTargetGrower):
                                             has_missing=self.has_missing)
                 res = fetch_struct(res)  # ONE packed pull of decisions
 
+            _mem.sample("paged/level")   # level boundary; free when off
             res_gain = np.asarray(res.gain)[:n_level]
             can_split = (active[lo:lo + n_level]
                          & (res_gain > max(param.gamma, _EPS))
