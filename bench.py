@@ -559,6 +559,62 @@ def _timed_write(box, i):
     return time.perf_counter() - t0
 
 
+def bench_insight():
+    """xtpuinsight keys (BENCH_OBS): whole-run cost of armed per-round
+    telemetry on the resident hot path (bar: <= 1.0% — the scalars ride
+    the round program as extra outputs, one fetch per round), the
+    speedup of a train-with-eval-set run when the eval fold rides the
+    round carry instead of the host predict+metric path, and the cost
+    of one full ``Booster.inspect()`` model report."""
+    import jax
+
+    import xgboost_tpu as xgb
+    from xgboost_tpu.obs import insight
+
+    rows = min(ROWS, int(os.environ.get("BENCH_INSIGHT_ROWS", 400_000)))
+    X, y = make_data(rows, COLS, seed=11)
+    Xv, yv = make_data(max(rows // 4, 10_000), COLS, seed=12)
+    dm = xgb.DMatrix(X, label=y)
+    dv = xgb.DMatrix(Xv, label=yv)
+    params = {**PARAMS, "eval_metric": "logloss"}
+    rounds = 10
+
+    def run(armed, with_eval):
+        if armed:
+            insight.enable(eval=True)
+        try:
+            t0 = time.perf_counter()
+            kw = {"evals": [(dv, "val")]} if with_eval else {}
+            bst = xgb.train(params, dm, rounds, verbose_eval=False, **kw)
+            for st in bst._caches.values():
+                jax.block_until_ready(st["margin"])
+                float(np.asarray(st["margin"][0, 0]))
+            return time.perf_counter() - t0, bst
+        finally:
+            insight.disable()
+
+    out = {}
+    # compile both program variants before timing anything
+    run(False, False)
+    run(True, True)
+    base = min(run(False, False)[0] for _ in range(2))
+    armed = min(run(True, False)[0] for _ in range(2))
+    out["insight_overhead_pct"] = round(
+        max(0.0, (armed - base) / base * 100.0), 3)
+    host_eval = min(run(False, True)[0] for _ in range(2))
+    incarry_eval, bst = run(True, True)
+    incarry_eval = min(incarry_eval, run(True, True)[0])
+    out["eval_in_trace_speedup"] = round(host_eval / incarry_eval, 4)
+
+    t_best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        assert bst.inspect()["num_trees"] == rounds
+        t_best = min(t_best, time.perf_counter() - t0)
+    out["model_report_ms"] = round(t_best * 1e3, 3)
+    return out
+
+
 def main():
     X, y = make_data(ROWS, COLS)
     ours_rps, auc = bench_ours(X, y)
@@ -657,6 +713,9 @@ def main():
         # psum signal), straggler_skew_pct over a 4-rank virtual world,
         # the per-round HBM peak watermark, and the black-box write cost
         result.update(bench_flight())
+        # xtpuinsight keys: armed-telemetry round cost (bar <= 1.0%),
+        # in-carry vs host eval-set speedup, model-report latency
+        result.update(bench_insight())
     if os.environ.get("BENCH_SERVE", "1") != "0":
         # inference-serving SLOs (tools/bench_serve.py): open-loop mixed
         # 1/8/64/512-row workload through the micro-batcher; the four

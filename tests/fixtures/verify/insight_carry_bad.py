@@ -1,0 +1,40 @@
+"""Bad twin: insight carry — the telemetry anti-pattern the
+``resident.*.insight`` contracts exist to catch. Per-round training
+telemetry is smuggled as a THIRD dispatch (budget is two), and that
+stray program leaks the scalars through a per-round ``debug_callback``
+host round-trip instead of returning them as outputs of the round."""
+
+import jax
+import jax.numpy as jnp
+
+from tools.xtpuverify.contracts import ProgramContract
+from xgboost_tpu.programs import ProgramSpec, RoundPlan, _abstract
+
+CONTRACT = ProgramContract("fx.insight_carry", dispatch_budget=2)
+
+
+@jax.jit  # VERIFY[dispatch-budget]
+def round_step(margin, delta):
+    return margin + delta
+
+
+@jax.jit
+def guard(margin):
+    return jnp.sum(jnp.isnan(margin))
+
+
+@jax.jit  # VERIFY[dispatch-budget]
+def stray_telemetry(margin):
+    # the un-budgeted telemetry dispatch, with a host callback to boot
+    stats = jnp.stack([jnp.min(margin), jnp.max(margin), jnp.mean(margin)])
+    jax.debug.callback(lambda s: None, stats)
+    return stats
+
+
+def plan():
+    m = _abstract((512, 1), "float32")
+    return RoundPlan(handle="fx.insight_carry", unit="round", dispatches=[
+        ProgramSpec(name="round", fn=round_step, args=(m, m)),
+        ProgramSpec(name="guard", fn=guard, args=(m,)),
+        ProgramSpec(name="telemetry", fn=stray_telemetry, args=(m,)),
+    ])

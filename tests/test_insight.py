@@ -1,0 +1,299 @@
+"""xtpuinsight (obs/insight.py): in-trace training telemetry, in-carry
+eval sets, model inspection & diff.
+
+The load-bearing contracts:
+
+- arming telemetry + the eval fold must not move a single model byte
+  (the scalars are extra OUTPUTS of the unchanged round program — the
+  gpair recompute CSEs against the round's own; tools/validate_obs.py
+  re-checks this across tiers);
+- the in-carry eval scores must match the host predict+metric path,
+  so ``evals_result`` / ``EarlyStopping`` behave identically armed or
+  off (same best_iteration, same history);
+- the :class:`TrainingLog` rides snapshots: a crash+resume run logs
+  every round exactly once;
+- importance/dump surfaces agree with each other (``get_score`` x 5
+  types vs the dataframe derived from ``dump_json``).
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.obs import insight
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+          "max_bin": 64, "seed": 3}
+
+
+def _data(n=600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+@contextlib.contextmanager
+def armed(eval=True):
+    insight.enable(eval=eval)
+    try:
+        yield
+    finally:
+        insight.disable()
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _data()
+
+
+@pytest.fixture(scope="module")
+def val_data():
+    return _data(n=300, seed=1)
+
+
+def _train(data, val_data=None, params=PARAMS, rounds=5, **kw):
+    X, y = data
+    if val_data is not None:
+        kw["evals"] = [(xgb.DMatrix(*val_data[:1], label=val_data[1]),
+                        "val")]
+    return xgb.train(params, xgb.DMatrix(X, label=y), rounds,
+                     verbose_eval=False, **kw)
+
+
+# ------------------------------------------------------ in-trace telemetry
+
+def test_fused_telemetry_matches_grown_trees(data):
+    with armed():
+        bst = _train(data, rounds=5)
+    log = bst.training_log
+    assert log is not None and len(log.records) == 5
+    trees = bst.gbm.trees
+    for i, rec in enumerate(log.records):
+        assert rec["round"] == i
+        assert rec["leaf_count"] == trees[i].num_leaves()
+        assert rec["depth"] == trees[i].max_depth()
+        gains = np.asarray(trees[i].gain)[~np.asarray(trees[i].is_leaf)]
+        assert rec["gain_total"] == pytest.approx(float(gains.sum()),
+                                                  rel=1e-4)
+        assert rec["gain_max"] == pytest.approx(float(gains.max()),
+                                                rel=1e-4)
+        leaves = np.asarray(trees[i].leaf_value)[
+            np.asarray(trees[i].is_leaf)]
+        assert rec["leaf_value_min"] == pytest.approx(float(leaves.min()),
+                                                      rel=1e-4)
+        assert rec["leaf_value_max"] == pytest.approx(float(leaves.max()),
+                                                      rel=1e-4)
+        assert rec["grad_norm"] > 0.0 and rec["hess_norm"] > 0.0
+        assert rec["nan_guard_bad_rows"] == 0
+        assert all(np.isfinite(v) for v in rec.values()
+                   if np.ndim(v) == 0)
+    # per-level gain vector: one entry per grown level
+    assert len(log.records[0]["gain_per_level"]) == PARAMS["max_depth"]
+
+
+def test_host_tier_telemetry_lossguide(data):
+    p = {**PARAMS, "grow_policy": "lossguide", "max_leaves": 8,
+         "max_depth": 6}
+    with armed():
+        bst = _train(data, params=p, rounds=4)
+    log = bst.training_log
+    assert log is not None and len(log.records) == 4
+    trees = bst.gbm.trees
+    for i, rec in enumerate(log.records):
+        assert rec["round"] == i
+        assert rec["leaf_count"] == trees[i].num_leaves()
+        assert rec["depth"] == trees[i].max_depth()
+
+
+def test_armed_model_is_byte_identical(data, val_data):
+    plain = _train(data, val_data, rounds=5,
+                   params={**PARAMS, "eval_metric": "logloss"})
+    with armed():
+        hot = _train(data, val_data, rounds=5,
+                     params={**PARAMS, "eval_metric": "logloss"})
+    assert bytes(plain.save_raw("ubj")) == bytes(hot.save_raw("ubj"))
+
+
+# ------------------------------------------------------- in-carry eval set
+
+def test_in_carry_eval_matches_host_path(data, val_data):
+    p = {**PARAMS, "eval_metric": ["logloss", "error"]}
+    host, carry = {}, {}
+    _train(data, val_data, params=p, rounds=6, evals_result=host)
+    with armed():
+        bst = _train(data, val_data, params=p, rounds=6,
+                     evals_result=carry)
+    assert set(carry) == set(host) == {"val"}
+    assert set(carry["val"]) == set(host["val"])
+    for m in carry["val"]:
+        np.testing.assert_allclose(carry["val"][m], host["val"][m],
+                                   rtol=1e-5, atol=1e-7)
+    # the log IS the evals_result mapping (TrainingLog is the history)
+    assert bst.training_log["val"]["logloss"] == carry["val"]["logloss"]
+
+
+def test_early_stopping_parity_armed_vs_off(data):
+    # validation labels decorrelated from train: stops well before 40
+    X, y = data
+    Xv = X[:200] + 0.1
+    rng = np.random.RandomState(9)
+    yv = (y[:200] + (rng.rand(200) < 0.3)) % 2
+    p = {**PARAMS, "eval_metric": "logloss"}
+    kw = dict(evals=[(xgb.DMatrix(Xv, label=yv.astype(np.float32)),
+                      "val")], early_stopping_rounds=3)
+
+    off = xgb.train(p, xgb.DMatrix(X, label=y), 40, verbose_eval=False,
+                    **kw)
+    with armed():
+        hot = xgb.train(p, xgb.DMatrix(X, label=y), 40,
+                        verbose_eval=False, **kw)
+    assert off.best_iteration == hot.best_iteration
+    assert off.num_boosted_rounds() == hot.num_boosted_rounds()
+    assert off.num_boosted_rounds() < 40, "early stopping never fired"
+    assert float(off.attr("best_score")) == pytest.approx(
+        float(hot.attr("best_score")), rel=1e-5)
+
+
+def test_resume_restores_training_log(data, val_data, tmp_path):
+    """Crash at round 7, snapshot every 3: the resumed run must carry a
+    log with every round exactly once — restored rounds from the
+    snapshot, re-run rounds appended live."""
+    class DieAtRound(xgb.callback.TrainingCallback):
+        def __init__(self, round_):
+            self.round_ = round_
+
+        def after_iteration(self, model, epoch, evals_log):
+            if epoch == self.round_:
+                raise RuntimeError("injected crash")
+            return False
+
+    p = {**PARAMS, "eval_metric": "logloss"}
+    ck = xgb.CheckpointConfig(directory=str(tmp_path), every_n_rounds=3)
+    with armed():
+        with pytest.raises(RuntimeError, match="injected crash"):
+            _train(data, val_data, params=p, rounds=12, checkpoint=ck,
+                   callbacks=[DieAtRound(7)])
+        resumed = _train(data, val_data, params=p, rounds=12,
+                         checkpoint=ck)
+    log = resumed.training_log
+    assert [r["round"] for r in log.records] == list(range(12))
+    assert len(log["val"]["logloss"]) == 12
+    # and it matches a straight armed run
+    with armed():
+        straight = _train(data, val_data, params=p, rounds=12)
+    np.testing.assert_allclose(log["val"]["logloss"],
+                               straight.training_log["val"]["logloss"],
+                               rtol=1e-6)
+
+
+def test_training_log_serialization_roundtrip():
+    log = insight.TrainingLog()
+    log.log_eval("val", "logloss", 0.5)
+    log.log_eval("val", "logloss", 0.4)
+    log.log_round(0, {"leaf_count": 8, "gain_per_level": [1.0, 2.0]})
+    back = insight.TrainingLog.from_obj(log.to_obj())
+    assert back["val"]["logloss"] == [0.5, 0.4]
+    assert back.records == log.records
+
+
+# -------------------------------------------- importance & dump round-trip
+
+def test_get_score_five_types_agree_with_dump(data):
+    """Cross-surface parity: every importance type recomputed from the
+    dataframe (itself derived from ``dump_json``) must equal
+    ``get_score``'s walk over the node arrays."""
+    bst = _train(data, rounds=4)
+    df = bst.trees_to_dataframe()
+    splits = df[df["Feature"] != "Leaf"]
+    weight = splits.groupby("Feature").size().to_dict()
+    total_gain = splits.groupby("Feature")["Gain"].sum().to_dict()
+    total_cover = splits.groupby("Feature")["Cover"].sum().to_dict()
+
+    expected = {
+        "weight": {f: float(w) for f, w in weight.items()},
+        "total_gain": total_gain,
+        "total_cover": total_cover,
+        "gain": {f: total_gain[f] / weight[f] for f in weight},
+        "cover": {f: total_cover[f] / weight[f] for f in weight},
+    }
+    for kind, want in expected.items():
+        got = bst.get_score(importance_type=kind)
+        assert set(got) == set(want), kind
+        for f in want:
+            assert got[f] == pytest.approx(want[f], rel=1e-5), (kind, f)
+    assert bst.get_fscore() == bst.get_score(importance_type="weight")
+
+
+def test_trees_to_dataframe_matches_tree_arrays(data):
+    """The dataframe now derives from ``dump_json``; it must still agree
+    with the raw TreeModel arrays (the pre-round-trip semantics)."""
+    bst = _train(data, rounds=3)
+    df = bst.trees_to_dataframe()
+    trees = bst.gbm.trees
+    assert len(df) == sum(t.num_nodes() for t in trees)
+    for t_i, tree in enumerate(trees):
+        sub = df[df["Tree"] == t_i].set_index("Node")
+        assert list(sub.index) == sorted(sub.index)
+        assert (sub["Feature"] == "Leaf").sum() == tree.num_leaves()
+        for c in range(tree.num_nodes()):
+            row = sub.loc[c]
+            assert row["ID"] == f"{t_i}-{c}"
+            if tree.is_leaf[c]:
+                assert row["Feature"] == "Leaf"
+                assert row["Gain"] == pytest.approx(
+                    float(tree.leaf_value[c]), rel=1e-6)
+            else:
+                assert row["Feature"] == f"f{int(tree.split_feature[c])}"
+                assert row["Yes"] == f"{t_i}-{int(tree.left_child[c])}"
+                assert row["No"] == f"{t_i}-{int(tree.right_child[c])}"
+                assert row["Split"] == pytest.approx(
+                    float(tree.split_value[c]), rel=1e-6)
+                assert row["Gain"] == pytest.approx(float(tree.gain[c]),
+                                                    rel=1e-6)
+                assert row["Cover"] == pytest.approx(
+                    float(tree.sum_hess[c]), rel=1e-6)
+
+
+# ------------------------------------------------------ inspection & diff
+
+def test_model_inspect_structure(data):
+    bst = _train(data, rounds=4)
+    rep = bst.inspect()
+    assert rep["num_trees"] == 4
+    assert rep["num_features"] == 6
+    assert set(rep["importance"]) == {"weight", "gain", "cover",
+                                      "total_gain", "total_cover"}
+    shape = rep["tree_shape"]
+    trees = bst.gbm.trees
+    assert shape["trees"] == 4
+    assert shape["nodes_total"] == sum(t.num_nodes() for t in trees)
+    assert shape["leaves_total"] == sum(t.num_leaves() for t in trees)
+    assert sum(shape["depth_hist"].values()) == 4
+    import json
+    json.dumps(rep)          # the serve/manifest contract: JSON-clean
+
+
+def test_model_diff_self_is_quiet_and_cross_names_features(data):
+    X, y = data
+    dm = xgb.DMatrix(X, label=y)
+    a = _train(data, rounds=3)
+    b = _train(data, rounds=5,
+               params={**PARAMS, "eta": 0.6, "max_depth": 4})
+    same = insight.model_diff(a, a, dm=dm)
+    assert same["prediction_drift"] == 0.0
+    assert same["top_features"] == []
+    diff = insight.model_diff(a, b, dm=dm)
+    assert diff["num_trees"] == [3, 5]
+    assert diff["prediction_drift"] > 0.0
+    feats = [f["feature"] for f in diff["top_features"]]
+    assert feats and set(feats) <= {f"f{i}" for i in range(6)}
+    assert all(f["score"] > 0.0 for f in diff["top_features"])
+
+
+def test_insight_disarmed_records_nothing(data):
+    insight.disable()
+    bst = _train(data, rounds=3)
+    assert bst.training_log is None or not bst.training_log.records

@@ -56,7 +56,13 @@ def test_disabled_span_is_shared_and_allocation_free():
     s2 = tr.span("paged/hist", "train")
     assert s1 is s2  # the shared _NULL singleton, not a fresh object
     # zero allocations attributable to trace.py across many span sites —
-    # the per-round cost of XTPU_TRACE=0 on the hot path
+    # the per-round cost of XTPU_TRACE=0 on the hot path. Warm past
+    # CPython's lazy per-code-object caches (3.10 mallocs an opcache on
+    # a call-count threshold, attributed to the function's first line)
+    # so the measured window sees only true per-call allocations.
+    for _ in range(2000):
+        tr.span("round/fused")
+        tr.instant("collective/retry")
     flt = tracemalloc.Filter(True, tr.__file__)
     tracemalloc.start()
     try:

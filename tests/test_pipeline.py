@@ -206,6 +206,32 @@ def test_drift_gate_rejection_keeps_prior_serving(tmp_path):
     srv.close()
 
 
+def test_rejection_carries_model_diff_report(tmp_path):
+    """xtpuinsight forensics: a rejection with a live baseline attaches
+    a model-diff report (top drifted features) to the typed error AND to
+    the committed manifest event; promotions commit an inspect
+    snapshot."""
+    cfg = _config(tmp_path, gates=(GateRule("auc", min_value=0.55),))
+    pipe = Pipeline(cfg, holdout=HOLDOUT)
+    pipe.step(*_page(seed=0))                      # baseline promoted
+    active = pipe.manifest.active
+    assert active["inspect"]["num_trees"] == K
+    assert active["inspect"]["top_gain"], "promotion inspect is empty"
+    pipe.gates.rules[0].min_value = 1.1            # impossible floor
+    rep = pipe.step(*_page(seed=1))
+    assert rep[0]["action"] == "rejected"
+    report = rep[0]["error"].report
+    assert report is not None
+    assert report["num_trees"] == [K, 2 * K]
+    assert "prediction_drift" in report
+    feats = [f["feature"] for f in report["top_features"]]
+    assert feats, "rejection must name the drifted features"
+    assert set(feats) <= {f"f{i}" for i in range(5)}
+    # the identical forensic is durable in the manifest event
+    ev = [e for e in pipe.manifest.events() if e["type"] == "rejected"][-1]
+    assert ev["diff"]["top_features"] == report["top_features"]
+
+
 def test_corrupt_promoted_artifact_rejected_then_regenerated(tmp_path,
                                                              reference):
     srv = Server()

@@ -432,6 +432,37 @@ def test_http_frontend(data, booster):
         srv.close()
 
 
+def test_http_model_report(booster):
+    """GET /v1/model/<name>/report renders the xtpuinsight inspection of
+    the served version; unknown names map to 404 like predict does."""
+    import urllib.error
+    import urllib.request
+
+    from xgboost_tpu.serve.frontend import make_http_server
+
+    srv = _server(booster)
+    httpd = make_http_server(srv, 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        rep = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/model/m/report").read())
+        assert rep["name"] == "m"
+        assert rep["version"] == srv.registry.get("m").version
+        assert rep["num_trees"] == booster.num_boosted_rounds()
+        assert set(rep["importance"]) == {"weight", "gain", "cover",
+                                          "total_gain", "total_cover"}
+        assert rep["tree_shape"]["trees"] == rep["num_trees"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/model/absent/report")
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+
 def test_cli_serve_dispatch(tmp_path, data, booster, monkeypatch):
     """`python -m xgboost_tpu serve ...` routes through cli.main."""
     from xgboost_tpu.cli import main as cli_main

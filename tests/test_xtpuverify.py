@@ -126,6 +126,38 @@ def test_mega_budget_catches_a_third_dispatch():
     assert budget and "3 dispatches" in budget[0].message
 
 
+def test_insight_plan_is_contract_clean():
+    """The armed round (telemetry + in-carry eval as extra outputs) fits
+    the UNARMED budget — the xtpuinsight zero-extra-dispatch claim in
+    static form."""
+    from xgboost_tpu.programs import build_plan
+    findings, skipped = verify_pairs(
+        [(_contract("resident.fused.insight"),
+          build_plan("resident.fused.insight"))], root=REPO)
+    assert not skipped
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_insight_budget_catches_a_telemetry_dispatch():
+    """Moving the armed round's telemetry into its own per-round program
+    must fail the ``resident.*.insight`` contract statically (the ISSUE-14
+    mutation: telemetry may only ride the round as extra outputs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from xgboost_tpu.programs import ProgramSpec, _abstract, build_plan
+
+    plan = build_plan("resident.fused.insight")
+    telem = jax.jit(lambda m: jnp.stack([jnp.min(m), jnp.max(m)]))
+    plan.dispatches.append(ProgramSpec(
+        name="stray_telemetry", fn=telem,
+        args=(_abstract((512, 1), "float32"),)))
+    findings, _ = verify_pairs(
+        [(_contract("resident.fused.insight"), plan)], root=REPO)
+    budget = [f for f in findings if f.checker == "dispatch-budget"]
+    assert budget and "3 dispatches" in budget[0].message
+
+
 def test_paged_uploads_contract_catches_regression():
     """Flipping the paged plan's declared uploads_per_level to 1 (a pager
     refactor re-introducing per-level page uploads) must fail."""

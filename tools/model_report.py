@@ -1,0 +1,102 @@
+"""xtpuinsight model report CLI — inspect one model, or diff two.
+
+The offline face of ``Booster.inspect()`` / ``obs.insight.model_diff``
+(the pipeline commits the same snapshot per epoch and serve renders it
+on ``GET /v1/model/<name>/report``), so an artifact on disk can be
+interrogated without standing up either:
+
+    python tools/model_report.py model.ubj                # human summary
+    python tools/model_report.py model.ubj --json         # full report
+    python tools/model_report.py old.ubj --diff new.ubj   # drift forensic
+
+``--diff`` treats the positional model as the BASELINE and the ``--diff``
+argument as the candidate (the pipeline's rejection convention: "what
+changed between what serves and what was refused"). Runs on CPU; no
+device work — inspection walks host-side model arrays only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load(path: str):
+    from xgboost_tpu import Booster
+
+    return Booster(model_file=path)
+
+
+def _fmt_importance(imp, top):
+    ranked = sorted(imp.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
+    return ", ".join(f"{k}={v:.4g}" for k, v in ranked) or "(none)"
+
+
+def _print_inspect(report, path):
+    print(f"model: {path}")
+    print(f"  trees={report['num_trees']} features={report['num_features']}"
+          + (f" best_iteration={report['best_iteration']}"
+             if "best_iteration" in report else ""))
+    shape = report.get("tree_shape")
+    if shape:
+        print(f"  nodes={shape['nodes_total']} leaves={shape['leaves_total']}"
+              f" depth_hist={shape['depth_hist']}")
+    for kind in ("gain", "total_gain", "weight", "cover", "total_cover"):
+        print(f"  {kind:<12} {_fmt_importance(report['importance'][kind], 5)}")
+
+
+def _print_diff(diff):
+    a, b = diff["num_trees"]
+    print(f"diff: baseline {a} trees -> candidate {b} trees")
+    if "prediction_drift" in diff:
+        print(f"  prediction_drift={diff['prediction_drift']:.6g}")
+    if not diff["top_features"]:
+        print("  no drifted features")
+        return
+    print("  top drifted features:")
+    for f in diff["top_features"]:
+        print(f"    {f['feature']:<16} score={f['score']:.6g} "
+              f"importance_delta={f['importance_delta']:+.6g}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="model_report",
+        description="inspect a saved model, or diff two (xtpuinsight)")
+    ap.add_argument("model", help="model artifact (baseline when --diff)")
+    ap.add_argument("--diff", metavar="OTHER",
+                    help="candidate model to diff against the baseline")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report object instead of a summary")
+    args = ap.parse_args(argv)
+
+    from xgboost_tpu.obs.insight import model_diff, model_inspect
+
+    bst = _load(args.model)
+    if args.diff is None:
+        report = model_inspect(bst)
+        if args.json:
+            json.dump(report, sys.stdout, indent=1)
+            print()
+        else:
+            _print_inspect(report, args.model)
+        return 0
+
+    other = _load(args.diff)
+    diff = model_diff(bst, other)
+    if args.json:
+        json.dump(diff, sys.stdout, indent=1)
+        print()
+    else:
+        _print_diff(diff)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

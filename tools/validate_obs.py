@@ -15,7 +15,11 @@ Each traced cell must also actually RECORD the spans it claims to (an
 empty ring would make byte-equality vacuous). Two extra cells re-run
 resident and paged with the FULL xtpuflight stack armed (memory
 monitor, rank identity, black box) and additionally require a round of
-memory samples plus a CRC-valid postmortem bundle.
+memory samples plus a CRC-valid postmortem bundle. Four more cells
+re-run resident (with an eval set), mega, paged and mesh with
+xtpuinsight armed (``XTPU_INSIGHT=1`` + in-carry eval): per-round
+telemetry and the eval fold must leave the model bytes untouched while
+actually recording a :class:`~xgboost_tpu.obs.insight.TrainingLog`.
 
 The second half lints the one-registry Prometheus exposition
 (``obs.metrics.get_registry().render_prometheus()``) after exercising
@@ -78,7 +82,13 @@ def _cell_lossguide(X, y, rounds):
                      verbose_eval=False).save_raw()
 
 
-def _cell_paged(X, y, rounds):
+def _cell_mega(X, y, rounds):
+    p = {**BASE, "max_depth": 4, "hist_method": "mega"}
+    return xgb.train(p, xgb.DMatrix(X, label=y), rounds,
+                     verbose_eval=False).save_raw()
+
+
+def _train_paged(X, y, rounds):
     """Genuinely streamed paged training: iterator + cache prefix, page
     cache off, collapse off — the driver whose stage spans + sync
     barriers perf_report times is exactly the one under test here."""
@@ -115,7 +125,7 @@ def _cell_paged(X, y, rounds):
         it.cache_prefix = os.path.join(tmp.name, "pc")
         dm = xgb.QuantileDMatrix(it, max_bin=BASE["max_bin"])
         p = {**BASE, "max_depth": 4}
-        return xgb.train(p, dm, rounds, verbose_eval=False).save_raw()
+        return xgb.train(p, dm, rounds, verbose_eval=False)
     finally:
         for k, v in keep.items():
             if v is None:
@@ -125,10 +135,18 @@ def _cell_paged(X, y, rounds):
         tmp.cleanup()
 
 
-def _cell_mesh(X, y, rounds):
+def _cell_paged(X, y, rounds):
+    return _train_paged(X, y, rounds).save_raw()
+
+
+def _train_mesh(X, y, rounds):
     p = {**BASE, "max_depth": 4, "mesh": xgb.make_data_mesh()}
     return xgb.train(p, xgb.DMatrix(X, label=y), rounds,
-                     verbose_eval=False).save_raw()
+                     verbose_eval=False)
+
+
+def _cell_mesh(X, y, rounds):
+    return _train_mesh(X, y, rounds).save_raw()
 
 
 # (name, trainer, span prefixes at least one of which must be recorded)
@@ -209,6 +227,57 @@ def run_flight_cells(rows: int, rounds: int):
             "covered": seen and sampled and bundle_ok,
             "ok": (raw_flight == raw_plain and seen and sampled
                    and bundle_ok),
+        })
+    return results
+
+
+def run_insight_cells(rows: int, rounds: int):
+    """Byte-equality with xtpuinsight armed: per-round telemetry (and,
+    on the resident tier, the in-carry eval fold) must not move a single
+    model byte — resident fused, mega, paged streamed and virtual-mesh
+    tiers. Coverage makes the equality non-vacuous: every armed run must
+    actually record per-round telemetry, and the resident cell must land
+    in-carry eval history for its eval set."""
+    from xgboost_tpu.obs import insight
+
+    X, y = _data(rows)
+    Xv, yv = _data(max(rows // 3, 120), seed=1)
+
+    def _resident_eval(armed_unused=None):
+        p = {**BASE, "max_depth": 4, "eval_metric": "logloss"}
+        return xgb.train(p, xgb.DMatrix(X, label=y), rounds,
+                         evals=[(xgb.DMatrix(Xv, label=yv), "val")],
+                         verbose_eval=False)
+
+    cells = [
+        ("resident+insight", _resident_eval),
+        ("mega+insight", lambda: xgb.train(
+            {**BASE, "max_depth": 4, "hist_method": "mega"},
+            xgb.DMatrix(X, label=y), rounds, verbose_eval=False)),
+        ("paged+insight", lambda: _train_paged(X, y, rounds)),
+        ("mesh+insight", lambda: _train_mesh(X, y, rounds)),
+    ]
+    results = []
+    for name, fn in cells:
+        insight.disable()
+        raw_plain = bytes(fn().save_raw())
+        insight.enable(eval=True)
+        try:
+            bst = fn()
+            raw_armed = bytes(bst.save_raw())
+        finally:
+            insight.disable()
+        log = bst.training_log
+        recorded = bool(log is not None and log.records)
+        covered = recorded
+        if name == "resident+insight":
+            covered = recorded and bool(log and log.get("val"))
+        results.append({
+            "cell": name,
+            "identical": raw_armed == raw_plain,
+            "spans": len(log.records) if log is not None else 0,
+            "covered": covered,
+            "ok": raw_armed == raw_plain and covered,
         })
     return results
 
@@ -337,7 +406,11 @@ def run_exposition_lint() -> List[str]:
     problems = lint_exposition(text)
     for needle in ("xtpu_serve_requests_total 5",
                    'xtpu_collective_events_total{kind="retry"} 2',
-                   "xtpu_serve_stage_latency_seconds_bucket"):
+                   "xtpu_serve_stage_latency_seconds_bucket",
+                   # left behind by run_insight_cells: armed runs stream
+                   # telemetry + eval gauges through the same registry
+                   "xtpu_insight_round",
+                   'xtpu_eval_score{data="val",metric="logloss"}'):
         if needle not in text:
             problems.append(f"expected exposition line missing: {needle}")
     del m, rc
@@ -352,6 +425,7 @@ def main() -> int:
 
     results = run_cells(args.rows, args.rounds)
     results += run_flight_cells(args.rows, args.rounds)
+    results += run_insight_cells(args.rows, args.rounds)
     wid = max(len(r["cell"]) for r in results)
     print(f"traced-vs-untraced byte equality ({args.rows} rows, "
           f"{args.rounds} rounds):")

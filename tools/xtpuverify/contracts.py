@@ -67,6 +67,15 @@ CONTRACTS: Tuple[ProgramContract, ...] = (
     ProgramContract("resident.fused", dispatch_budget=2, donated=True),
     ProgramContract("resident.scan", dispatch_budget=2, donated=True),
     ProgramContract("resident.mega", dispatch_budget=2, donated=True),
+    # xtpuinsight-armed rounds: telemetry + in-carry eval must ride the
+    # round program as extra OUTPUTS — the budget stays the unarmed 2,
+    # so an extra telemetry dispatch is a gate failure, not a regression
+    ProgramContract("resident.fused.insight", dispatch_budget=2,
+                    donated=True),
+    ProgramContract("resident.scan.insight", dispatch_budget=2,
+                    donated=True),
+    ProgramContract("resident.mega.insight", dispatch_budget=2,
+                    donated=True),
     # lossguide megakernel: the whole greedy tree is ONE program
     ProgramContract("lossguide.mega", dispatch_budget=1),
     # paged page-major fast path: one program per level boundary, zero

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .logging_utils import console
+from .obs.insight import TrainingLog
 
 EvalsLog = Dict[str, Dict[str, List[float]]]
 
@@ -41,7 +42,11 @@ class CallbackContainer:
                  output_margin: bool = True) -> None:
         self.callbacks = list(callbacks)
         self.metric = metric
-        self.history: EvalsLog = collections.OrderedDict()
+        # a TrainingLog IS an OrderedDict {data: {metric: [scores]}}, so
+        # every existing consumer (EarlyStopping, evals_result) reads it
+        # unchanged; insight producers additionally append per-round
+        # telemetry to .records (obs/insight.py)
+        self.history: EvalsLog = TrainingLog()
 
     def before_training(self, model):
         for cb in self.callbacks:
@@ -62,9 +67,14 @@ class CallbackContainer:
             msg = model.eval_set(evals, epoch, feval=self.metric)
             parsed = _parse_eval_str(msg)
             for data_name, metric_name, score in parsed:
-                self.history.setdefault(
-                    data_name, collections.OrderedDict()).setdefault(
-                        metric_name, []).append(score)
+                if isinstance(self.history, TrainingLog):
+                    # same setdefault-chain append, plus the armed-only
+                    # xtpu_eval_score gauge stream
+                    self.history.log_eval(data_name, metric_name, score)
+                else:
+                    self.history.setdefault(
+                        data_name, collections.OrderedDict()).setdefault(
+                            metric_name, []).append(score)
         return any(cb.after_iteration(model, epoch, self.history)
                    for cb in self.callbacks)
 

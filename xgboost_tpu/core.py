@@ -68,7 +68,8 @@ def _margin_bad_rows(margin, n_valid: int):
 
 
 def _check_margin_finite(margin, n_valid: int, objective: str,
-                         first_round: int, n_rounds: int = 1) -> None:
+                         first_round: int, n_rounds: int = 1,
+                         bad=None) -> None:
     """Post-round half of the NaN guard for the TRACED gradient paths
     (``objective.base.guard_gradient`` raises eagerly on the general path,
     but cannot raise from inside the fused programs). Called on the fused
@@ -80,7 +81,10 @@ def _check_margin_finite(margin, n_valid: int, objective: str,
 
     if _nan_policy() != "raise":
         return
-    bad = int(_margin_bad_rows(margin, n_valid))
+    # insight-armed rounds pass the guard scalar in (they pull it once and
+    # reuse it as the telemetry NaN-guard count — still exactly one guard
+    # dispatch per round)
+    bad = int(bad if bad is not None else _margin_bad_rows(margin, n_valid))
     if not bad:
         return
     where = (f"round {first_round}" if n_rounds == 1 else
@@ -189,6 +193,108 @@ def steady_round_dispatches():
 
 @_functools.partial(
     jax.jit,
+    # margin + eval margins: updated in place, caller rebinds
+    donate_argnums=(1, 11),
+    static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
+                     "hist_method", "has_missing", "nan_policy",
+                     "eval_specs", "eval_missing"))
+def _fused_round_insight_fn(bins, margin, labels, weights, n_real, seed,
+                            iteration, monotone, constraint_sets, cat,
+                            eval_bins, eval_margins, eval_labels,
+                            eval_weights, *,
+                            obj_cls, obj_params, param, max_nbins,
+                            hist_method, has_missing, nan_policy="raise",
+                            eval_specs=(), eval_missing=()):
+    """The insight-armed twin of ``_fused_round_fn``: the SAME round body
+    (shared verbatim, so the model-math subgraph is identical and the
+    committed trees stay byte-for-byte equal to the unarmed path), plus
+    learning-health telemetry and the eval-set update as EXTRA OUTPUTS of
+    the one program — never an extra dispatch. ``tools/xtpuverify`` pins
+    the ``resident.*.insight`` contracts to the unarmed budget.
+
+    ``eval_*``: parallel tuples, one entry per armed eval DMatrix —
+    train-cut bins [n_e, F] u8, carried margin [n_e, K] (donated), labels,
+    weights (or None). ``eval_specs``: static ((metric_name, param), ...)
+    driving the in-trace partial reductions; ``eval_missing``: static
+    per-eval-matrix missing-bin ids. The gradient is recomputed with the
+    round body's exact expression, so XLA CSEs it against the round's own.
+    """
+    from .obs import insight as _insight
+
+    new_margin, grown = _fused_round_body(
+        margin, seed, iteration, bins, labels, weights, n_real, monotone,
+        constraint_sets, cat, obj_cls=obj_cls, obj_params=obj_params,
+        param=param, max_nbins=max_nbins, hist_method=hist_method,
+        has_missing=has_missing)
+
+    import types
+
+    obj = obj_cls(dict(obj_params))
+    sinfo = types.SimpleNamespace(labels=labels, weights=weights)
+    gpair = obj.get_gradient(margin, sinfo, 0)
+    telem = _insight.grown_telemetry(grown, gpair,
+                                     max(param.max_depth, 1))
+
+    new_eval_margins = []
+    partials = []
+    for i, (ebins, emargin, elabels, eweights) in enumerate(
+            zip(eval_bins, eval_margins, eval_labels, eval_weights)):
+        delta = _insight.walk_leaf_delta(grown, ebins, eval_missing[i],
+                                         max(param.max_depth, 1))
+        nem = emargin + delta[:, None]
+        new_eval_margins.append(nem)
+        preds = obj.pred_transform(nem)[:, 0]
+        w = eweights if eweights is not None else \
+            jnp.ones_like(elabels, dtype=jnp.float32)
+        partials.append(tuple(
+            _insight.metric_partial(name, preds, elabels, w, mparam)
+            for name, mparam in eval_specs))
+    return (new_margin, grown, telem, tuple(new_eval_margins),
+            tuple(partials))
+
+
+def steady_round_dispatches_insight():
+    """``steady_round_dispatches``'s insight-armed twin: the programs one
+    steady ARMED resident round dispatches, in call order. Same length as
+    the unarmed list — telemetry and the in-carry eval ride the round
+    program as extra outputs; the guard reduction doubles as the
+    NaN-telemetry source. ``tools/xtpuverify`` pins the
+    ``resident.*.insight`` handles to the unarmed budget (contracts.py),
+    so smuggling a telemetry dispatch in here is a gate failure, not a
+    silent regression."""
+    return (_fused_round_insight_fn, _margin_bad_rows)
+
+
+@_functools.partial(
+    jax.jit,
+    static_argnames=("obj_cls", "obj_params", "specs", "rows"))
+def _eval_partials_fn(margins, labels, weights, *,
+                      obj_cls, obj_params, specs, rows):
+    """Every eval DMatrix x every metric as ONE compiled program: the old
+    eval_set host loop pulled the transformed predictions per DMatrix and
+    reduced per metric on the host — a host round-trip per (dm, metric)
+    pair per round. This returns the (weighted-loss-sum, weight-sum)
+    partials for all of them in a single dispatch; the host only finalizes
+    the ratios (through ``metric.base.global_mean``, so distributed
+    semantics are unchanged). ``rows`` is the static per-matrix valid-row
+    count (train margins arrive padded)."""
+    from .obs import insight as _insight
+
+    obj = obj_cls(dict(obj_params))
+    out = []
+    for i, (m, y, w) in enumerate(zip(margins, labels, weights)):
+        p = obj.pred_transform(m[:rows[i]])[:, 0]
+        yy = y[:rows[i]]
+        ww = w[:rows[i]] if w is not None else \
+            jnp.ones_like(yy, dtype=jnp.float32)
+        out.append(tuple(
+            _insight.metric_partial(name, p, yy, ww, mparam)
+            for name, mparam in specs))
+    return tuple(out)
+
+
+@_functools.partial(
+    jax.jit,
     donate_argnums=(1,),  # margin: updated in place, caller rebinds
     static_argnames=("obj_cls", "obj_params", "param", "max_nbins",
                      "hist_method", "has_missing", "nan_policy"))
@@ -257,6 +363,16 @@ class Booster:
         self._batch_blocked = False
         self._caches: Dict[int, Dict[str, Any]] = {}
         self._eval_metrics: List = []
+        # xtpuinsight (obs/insight.py): the TrainingLog this booster logs
+        # into (train() rebinds it to the callback container's history),
+        # the armed in-carry state (eval bins/margins riding the fused
+        # program), the round's finalized eval scores, the eval sets
+        # train() armed, and the insight-only fallback latch
+        self.training_log = None
+        self._insight_state: Optional[Dict[str, Any]] = None
+        self._insight_scores: Optional[Dict[str, Any]] = None
+        self._insight_evals: Optional[List[Tuple[DMatrix, str]]] = None
+        self._insight_blocked = False
         self._explicit_params: set = set()
         if params:
             self.set_param(params)
@@ -304,6 +420,7 @@ class Booster:
                 self.gbm._grower = None  # rebind with new params
             self._fused_round = None     # re-derive objective/tree config
             self._fused_blocked = False
+            self._insight_state = None   # eval carry binds per-config too
 
     # --------------------------------------------------------------- configure
     def _configure(self, dtrain: Optional[DMatrix]) -> None:
@@ -865,6 +982,7 @@ class Booster:
         if observer.enabled():
             observer.observe("gpair", gpair, iteration)
         key = self.ctx.make_key(iteration)
+        _prior_trees = len(getattr(self.gbm, "_trees", ()))
         with self._monitor.section("BoostOneIter"):
             delta = self.gbm.do_boost(state, gpair, iteration,
                                       jax.random.fold_in(key, iteration),
@@ -877,6 +995,7 @@ class Booster:
         if observer.enabled():
             observer.observe("margin", state["margin"], iteration)
         state["n_trees"] = self.gbm.version()
+        self._note_host_round(iteration, _prior_trees)
         if obs_memory.enabled():
             self._mem_round(state)
 
@@ -905,6 +1024,62 @@ class Booster:
         binned = state["binned"]
         gbm = self.gbm
         from .boosting.gbtree import _PendingTree
+        from .obs import insight as obs_insight
+
+        # xtpuinsight arm: same round, telemetry (+ optional in-carry eval)
+        # as extra outputs of the one dispatch. One module predicate when
+        # disarmed — the hot path stays free.
+        ins = None
+        if obs_insight.enabled() and not self._insight_blocked:
+            ins = self._insight_binding(state, obj_params)
+        if ins is not None:
+            try:
+                with obs_trace.span("round/fused"):
+                    (new_margin, grown, telem, new_ems,
+                     partials) = _fused_round_insight_fn(
+                        binned.bins, state["margin"], labels, weights,
+                        n_real, self.ctx.raw_seed(iteration),
+                        np.int32(iteration), grower.monotone,
+                        grower.constraint_sets, grower.cat,
+                        ins["bins"], ins["margins"], ins["labels"],
+                        ins["weights"],
+                        obj_cls=type(self.obj), obj_params=obj_params,
+                        param=grower.param, max_nbins=grower.max_nbins,
+                        hist_method=grower.hist_method,
+                        has_missing=grower.has_missing,
+                        nan_policy=_nan_policy(),
+                        eval_specs=ins["specs"],
+                        eval_missing=ins["missing"])
+            except Exception:
+                # insight-only failure: disarm and retry THIS round on the
+                # unarmed fused path — the model math is unaffected, so
+                # blocking fused entirely would punish the wrong tier
+                logger.warning("insight-armed fused round failed; "
+                               "disarming telemetry and retrying unarmed",
+                               exc_info=True)
+                self._insight_blocked = True
+                self._insight_state = None
+                self._recover_donated_margin(state)
+                return self._fused_step(state, iteration)
+            # the guard reduction doubles as the NaN-guard telemetry
+            # counter — still exactly the budgeted 2 dispatches per round
+            bad = _margin_bad_rows(new_margin, state["n_valid"])
+            _check_margin_finite(new_margin, state["n_valid"],
+                                 self.obj.name, iteration, bad=bad)
+            if isinstance(grown, dict):
+                for k in range(gbm.n_groups):
+                    gbm._trees.append(
+                        _PendingTree(None, grower, arrays=grown, index=k))
+                    gbm.tree_info.append(k)
+            else:
+                gbm._trees.append(_PendingTree(grown, grower))
+                gbm.tree_info.append(0)
+            gbm.iteration_indptr.append(len(gbm._trees))
+            state["margin"] = new_margin
+            state["n_trees"] = gbm.version()
+            self._note_insight_round(ins, iteration, telem, new_ems,
+                                     partials, bad)
+            return True
 
         try:
             # hot path: obs_trace.span returns a shared no-op when tracing
@@ -1022,6 +1197,124 @@ class Booster:
                  if info.weights is not None else None),
                 binned.n_real_bins())
         return self._fused_round[1:]
+
+    def _insight_binding(self, state: Dict[str, Any],
+                         obj_params) -> Dict[str, Any]:
+        """Arm (or cache-hit) the insight carry for one fused round:
+        telemetry always; the in-carry eval only when EVERY armed eval
+        DMatrix qualifies (binned against the train cuts, resident,
+        fully-addressable unpadded margin, labels present) and every
+        configured metric has an in-trace twin — otherwise eval stays on
+        the host path and only telemetry rides the carry. The eval margins
+        are COPIES of the version-cache margins (the round program donates
+        them), re-bound to the program's outputs every committed round."""
+        from .obs import insight as obs_insight
+
+        st = self._insight_state
+        if (st is not None and st["state"] is state
+                and st["version"] == self.gbm.version()):
+            return st
+        st = {"state": state, "version": self.gbm.version(),
+              "bins": (), "margins": (), "labels": (), "weights": (),
+              "missing": (), "specs": (), "names": (), "infos": ()}
+        self._insight_state = st
+        evals = self._insight_evals
+        if (not obs_insight.eval_enabled() or not evals
+                or self.n_groups != 1 or not self._eval_metrics):
+            return st
+        specs = obs_insight.metric_specs(self._eval_metrics)
+        if specs is None:
+            return st
+        bins, margins, labels, weights = [], [], [], []
+        missing, names, infos = [], [], []
+        for dm, name in evals:
+            est = self._state_of(dm, is_train=(dm is state.get("dm")))
+            eb = est.get("binned")
+            if (eb is None or getattr(eb, "is_paged", False)
+                    or not hasattr(eb, "missing_bin")):
+                return st
+            m0 = self._cached_margin(dm)
+            y = dm.info.labels
+            n = dm.num_row()
+            if (y is None or len(y) != n
+                    or getattr(eb.bins, "shape", (0,))[0] != n
+                    or getattr(m0, "shape", (0,))[0] != n
+                    or (isinstance(m0, jax.Array)
+                        and not m0.is_fully_addressable)):
+                return st
+            w = dm.info.weights
+            bins.append(eb.bins)
+            margins.append(jnp.array(m0, copy=True))  # donated per round
+            labels.append(jnp.asarray(y, jnp.float32))
+            weights.append(jnp.asarray(w, jnp.float32)
+                           if w is not None else None)
+            missing.append(int(eb.missing_bin))
+            names.append(name)
+            infos.append(dm.info)
+        st.update(bins=tuple(bins), margins=tuple(margins),
+                  labels=tuple(labels), weights=tuple(weights),
+                  missing=tuple(missing), specs=specs,
+                  names=tuple(names), infos=tuple(infos))
+        return st
+
+    def _note_insight_round(self, ins: Dict[str, Any], iteration: int,
+                            telem, new_ems, partials, bad) -> None:
+        """Land one armed round: ONE host fetch for the round's telemetry
+        scalars + eval partials (the per-round pull the unarmed raise-policy
+        guard already does), logged into the TrainingLog; the eval carry
+        re-binds to the program's output margins. ``eval_set`` then serves
+        this round's scores from ``_insight_scores`` without predicting."""
+        from .obs import insight as obs_insight
+
+        host_telem, host_partials, host_bad = jax.device_get(
+            (telem, partials, bad))
+        scalars = dict(host_telem)
+        scalars["nan_guard_bad_rows"] = int(host_bad)
+        log = self.training_log
+        if log is None:
+            log = self.training_log = obs_insight.TrainingLog()
+        log.log_round(iteration, scalars)
+        ins["margins"] = new_ems
+        ins["version"] = self.gbm.version()
+        if not ins["names"]:
+            self._insight_scores = None
+            return
+        scores: Dict[Tuple[str, str], float] = {}
+        for di, name in enumerate(ins["names"]):
+            info = ins["infos"][di]
+            for mi, metric in enumerate(self._eval_metrics):
+                num, den = host_partials[di][mi]
+                scores[(name, metric.full_name)] = \
+                    obs_insight.finalize_partial(ins["specs"][mi][0],
+                                                 num, den, info)
+        self._insight_scores = {"iteration": int(iteration),
+                                "names": tuple(ins["names"]),
+                                "scores": scores}
+
+    def _note_host_round(self, iteration: int, prior_trees: int) -> None:
+        """General/lossguide/paged/mesh telemetry twin of
+        ``_note_insight_round``: derive the round's learning-health scalars
+        host-side from the trees this round committed (obs/insight.py
+        ``round_telemetry_host`` — the node arrays were coming to the host
+        anyway, so this is zero extra dispatches on every tier). One module
+        predicate when disarmed."""
+        from .obs import insight as obs_insight
+
+        if not obs_insight.enabled():
+            return
+        entries = getattr(self.gbm, "_trees", None)
+        if entries is None or len(entries) <= prior_trees:
+            return
+        try:
+            scalars = obs_insight.round_telemetry_host(entries[prior_trees:])
+        except Exception:   # telemetry must never break training
+            logger.warning("host round telemetry failed", exc_info=True)
+            return
+        if scalars is None:
+            return
+        if self.training_log is None:
+            self.training_log = obs_insight.TrainingLog()
+        self.training_log.log_round(iteration, scalars)
 
     def update_batch(self, dtrain: DMatrix, iterations: Sequence[int]) -> bool:
         """Run ``len(iterations)`` fused boosting rounds as ONE device
@@ -1389,9 +1682,35 @@ class Booster:
                  feval: Optional[Callable] = None,
                  output_margin: bool = True) -> str:
         """Evaluate on a list of (DMatrix, name); returns the reference-format
-        line ``[i]\\tname-metric:value...`` (``src/learner.cc:1307-1342``)."""
+        line ``[i]\\tname-metric:value...`` (``src/learner.cc:1307-1342``).
+
+        Three tiers, cheapest first: (1) scores the insight-armed fused
+        round already computed IN-CARRY for this iteration (obs/insight.py
+        — zero predicts, zero dispatches); (2) one jitted partials program
+        covering every (DMatrix, metric) pair at once
+        (``_eval_partials_fn`` — the old path host-round-tripped per pair);
+        (3) the host loop, kept for custom/unsupported metrics, ``feval``,
+        vertical federated, and mesh-global margins."""
         self._configure(None)
         vfed = self._is_vertical_federated()
+        if feval is None and not vfed:
+            ins = self._insight_scores
+            if (ins is not None and ins["iteration"] == iteration
+                    and tuple(n for _, n in evals) == ins["names"]):
+                msg = f"[{iteration}]"
+                for _, name in evals:
+                    for metric in self._eval_metrics:
+                        score = ins["scores"][(name, metric.full_name)]
+                        msg += f"\t{name}-{metric.full_name}:{score:.6f}"
+                return msg
+            scores = self._batched_eval_scores(evals)
+            if scores is not None:
+                msg = f"[{iteration}]"
+                for _, name in evals:
+                    for metric in self._eval_metrics:
+                        score = scores[(name, metric.full_name)]
+                        msg += f"\t{name}-{metric.full_name}:{score:.6f}"
+                return msg
         msg = f"[{iteration}]"
         for dm, name in evals:
             margin = self._cached_margin(dm)
@@ -1430,6 +1749,72 @@ class Booster:
                 for mname, val in pairs:
                     msg += f"\t{name}-{mname}:{val:.6f}"
         return msg
+
+    def _batched_eval_scores(self, evals: Sequence[Tuple[DMatrix, str]]
+                             ) -> Optional[Dict[Tuple[str, str], float]]:
+        """Score every (DMatrix, metric) pair through ONE
+        ``_eval_partials_fn`` dispatch; None -> caller uses the host loop.
+        Labels/weights are device-cached on the DMatrix's cache entry so
+        steady rounds re-upload nothing."""
+        from .obs import insight as obs_insight
+
+        if self.n_groups != 1 or not self._eval_metrics or not evals:
+            return None
+        specs = obs_insight.metric_specs(self._eval_metrics)
+        if specs is None:
+            return None
+        scalars = {k: v for k, v in self.obj.params.items()
+                   if k != "eval_metric"}
+        if not all(isinstance(v, (int, float, str, bool))
+                   for v in scalars.values()):
+            return None
+        obj_params = tuple(sorted(scalars.items()))
+        margins, labels, weights, rows = [], [], [], []
+        for dm, _name in evals:
+            m = self._cached_margin(dm)
+            y = dm.info.labels
+            n = dm.num_row()
+            if (y is None or len(y) != n
+                    or getattr(m, "shape", (0,))[0] < n
+                    or (isinstance(m, jax.Array)
+                        and not m.is_fully_addressable)):
+                return None
+            st = self._caches.get(id(dm))
+            if st is None:
+                return None
+            ydev = st.get("eval_labels_dev")
+            if ydev is None or ydev.shape[0] != n:
+                ydev = st["eval_labels_dev"] = jnp.asarray(y, jnp.float32)
+            w = dm.info.weights
+            wdev = None
+            if w is not None:
+                if len(w) != n:
+                    return None
+                wdev = st.get("eval_weights_dev")
+                if wdev is None or wdev.shape[0] != n:
+                    wdev = st["eval_weights_dev"] = jnp.asarray(
+                        w, jnp.float32)
+            margins.append(m)
+            labels.append(ydev)
+            weights.append(wdev)
+            rows.append(int(n))
+        try:
+            parts = _eval_partials_fn(
+                tuple(margins), tuple(labels), tuple(weights),
+                obj_cls=type(self.obj), obj_params=obj_params,
+                specs=specs, rows=tuple(rows))
+        except Exception:
+            logger.warning("batched eval program failed; falling back to "
+                           "host metrics", exc_info=True)
+            return None
+        host = jax.device_get(parts)
+        out: Dict[Tuple[str, str], float] = {}
+        for di, (dm, name) in enumerate(evals):
+            for mi, metric in enumerate(self._eval_metrics):
+                num, den = host[di][mi]
+                out[(name, metric.full_name)] = obs_insight.finalize_partial(
+                    specs[mi][0], num, den, dm.info)
+        return out
 
     @staticmethod
     def _host_rows(arr, dm) -> np.ndarray:
@@ -1668,6 +2053,11 @@ class Booster:
                 "alg": str(alg), "keys": np.asarray(keys, np.int64),
                 "pos": int(pos), "has_gauss": int(has_gauss),
                 "cached": float(cached)}
+        # the TrainingLog rides the snapshot so eval histories (and the
+        # EarlyStopping patience window built on them) survive resume
+        tl = self.training_log
+        if tl is not None and (len(tl) or tl.records):
+            extra["training_log"] = tl.to_obj()
         return TrainingSnapshot(
             round=int(round_ if round_ is not None
                       else self.num_boosted_rounds()),
@@ -1694,6 +2084,11 @@ class Booster:
                             np.asarray(st["keys"]).astype(np.uint32),
                             int(st["pos"]), int(st["has_gauss"]),
                             float(st["cached"])))
+        tl = snap.extra.get("training_log") if snap.extra else None
+        if tl is not None:
+            from .obs import insight as obs_insight
+
+            self.training_log = obs_insight.TrainingLog.from_obj(tl)
         if snap.margin is None:
             return
         m = jnp.asarray(np.asarray(snap.margin, np.float32))
@@ -1839,6 +2234,16 @@ class Booster:
         an alias of weight importance; zero-importance features omitted)."""
         return self.get_score(fmap, importance_type="weight")
 
+    def inspect(self) -> Dict[str, Any]:
+        """Structural model report: every importance type, tree-shape
+        histograms, totals (obs/insight.py ``model_inspect``). The
+        pipeline records one per promoted/rejected epoch; serve renders it
+        on ``GET /v1/model/<name>/report``; ``tools/model_report.py`` is
+        the CLI."""
+        from .obs import insight as obs_insight
+
+        return obs_insight.model_inspect(self)
+
     def get_split_value_histogram(self, feature: str, fmap: str = "",
                                   bins: Optional[int] = None,
                                   as_pandas: bool = True):
@@ -1910,12 +2315,16 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
                            EvaluationMonitor)
     from .parallel import collective
 
+    from .obs import insight as obs_insight
+
     callbacks = list(callbacks) if callbacks else []
     # Round batching: valid when NOTHING consumes per-round output. Decided
     # on the USER-supplied callbacks — the EvaluationMonitor appended below
-    # is a no-op without evals, so it must not disable batching.
+    # is a no-op without evals, so it must not disable batching. Insight
+    # consumes per-round output by definition, so it disables batching too.
     batchable = (not callbacks and not evals and obj is None
-                 and custom_metric is None and feval is None)
+                 and custom_metric is None and feval is None
+                 and not obs_insight.enabled())
     if verbose_eval:
         period = 1 if verbose_eval is True else int(verbose_eval)
         callbacks.append(EvaluationMonitor(period=period))
@@ -1950,6 +2359,19 @@ def train(params: Dict[str, Any], dtrain: DMatrix,
         ck.ensure_fingerprint(dtrain)
     if resumed is not None:
         bst._prime_resume(dtrain, resumed)
+        if bst.training_log is not None:
+            # the snapshot's log becomes the container history, so
+            # evals_result and the EarlyStopping patience window continue
+            # from the interrupted round instead of restarting empty
+            container.history = bst.training_log
+    # the container's history IS the booster's TrainingLog: one object,
+    # written by callbacks (eval parsing) and insight (round telemetry)
+    bst.training_log = container.history
+    if (obs_insight.eval_enabled() and evals and metric_fn is None
+            and obj is None):
+        # arm the in-carry eval: _insight_binding folds these eval sets'
+        # margin update + metric partials into the fused round program
+        bst._insight_evals = list(evals)
 
     bst = container.before_training(bst)
     start = bst.num_boosted_rounds()

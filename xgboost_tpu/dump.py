@@ -128,42 +128,51 @@ def dump_dot(tree: TreeModel, feature_names: Optional[List[str]] = None,
 
 def trees_to_dataframe(trees: List[TreeModel],
                        feature_names: Optional[List[str]] = None):
-    """Booster.trees_to_dataframe (reference core.py) — one row per node."""
+    """Booster.trees_to_dataframe (reference core.py) — one row per node.
+
+    Derived from :func:`dump_json` (``with_stats=True``) rather than the
+    raw node arrays, so the two dump surfaces round-trip by construction:
+    a node the JSON dump renders is exactly the row the frame carries.
+    Rows come out in ascending node id per tree (the reference's
+    ordering)."""
     import pandas as pd
 
     rows = []
     for t_i, tree in enumerate(trees):
-        for c in range(tree.num_nodes()):
-            if tree.is_leaf[c]:
+        root = dump_json(tree, feature_names, with_stats=True)
+        if not root:
+            continue
+        nodes: List[dict] = []
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            stack.extend(n.get("children", ()))
+        for n in sorted(nodes, key=lambda d: d["nodeid"]):
+            c = int(n["nodeid"])
+            if "leaf" in n:
+                lv = n["leaf"]
                 rows.append({
                     "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
                     "Feature": "Leaf", "Split": np.nan, "Yes": np.nan,
                     "No": np.nan, "Missing": np.nan,
-                    "Gain": (float(tree.leaf_value[c])
-                             if getattr(tree.leaf_value[c], "ndim", 0) == 0
-                             else float(np.asarray(tree.leaf_value[c]).sum())),
-                    "Cover": float(tree.sum_hess[c]),
+                    "Gain": (float(np.sum(lv)) if isinstance(lv, list)
+                             else float(lv)),
+                    "Cover": float(n["cover"]),
                     "Category": np.nan,
                 })
             else:
-                yes, no = int(tree.left_child[c]), int(tree.right_child[c])
-                cat = np.nan
-                split = float(tree.split_value[c])
-                if tree.is_cat_split[c]:
-                    w = tree.cat_words[c]
-                    cat = [b for b in range(len(w) * 32)
-                           if (w[b // 32] >> (b % 32)) & 1]
-                    split = np.nan
+                cond = n["split_condition"]
+                is_cat = isinstance(cond, list)
                 rows.append({
                     "Tree": t_i, "Node": c, "ID": f"{t_i}-{c}",
-                    "Feature": _fname(feature_names,
-                                      int(tree.split_feature[c])),
-                    "Split": split, "Yes": f"{t_i}-{yes}",
-                    "No": f"{t_i}-{no}",
-                    "Missing": (f"{t_i}-{yes}" if tree.default_left[c]
-                                else f"{t_i}-{no}"),
-                    "Gain": float(tree.gain[c]),
-                    "Cover": float(tree.sum_hess[c]),
-                    "Category": cat,
+                    "Feature": n["split"],
+                    "Split": np.nan if is_cat else float(cond),
+                    "Yes": f"{t_i}-{int(n['yes'])}",
+                    "No": f"{t_i}-{int(n['no'])}",
+                    "Missing": f"{t_i}-{int(n['missing'])}",
+                    "Gain": float(n["gain"]),
+                    "Cover": float(n["cover"]),
+                    "Category": cond if is_cat else np.nan,
                 })
     return pd.DataFrame(rows)

@@ -19,6 +19,11 @@ Five instruments, one taxonomy:
 - :mod:`~xgboost_tpu.obs.memory` — stage-boundary HBM watermarks
   (``device.memory_stats()`` with explicit CPU bookings) behind
   ``XTPU_FLIGHT_MEM=1``.
+- :mod:`~xgboost_tpu.obs.insight` — learning-health telemetry: per-round
+  training scalars and eval metrics computed *inside* the round programs
+  (``XTPU_INSIGHT=1`` / ``XTPU_INSIGHT_EVAL=1``), the
+  :class:`TrainingLog`, and the model inspector / diff backing
+  ``tools/model_report.py`` and the pipeline's gate-rejection reports.
 
 ``tools/perf_report.py`` joins the measured spans against
 ``tools/roofline.py`` floors into the stage-drift table;
@@ -26,16 +31,17 @@ Five instruments, one taxonomy:
 exported rings.
 """
 
-from . import flight, memory, metrics, trace
+from . import flight, insight, memory, metrics, trace
 from .flight import BlackBox, FlightRecorder, StragglerWarning
+from .insight import TrainingLog
 from .metrics import Family, HistogramData, MetricsRegistry, Sample, \
     get_registry
 from .monitor import Monitor, Timer, annotate, profile
 from .trace import Span, Tracer, span
 
 __all__ = [
-    "trace", "metrics", "flight", "memory",
-    "Span", "Tracer", "span",
+    "trace", "metrics", "flight", "memory", "insight",
+    "Span", "Tracer", "span", "TrainingLog",
     "FlightRecorder", "BlackBox", "StragglerWarning",
     "MetricsRegistry", "Family", "Sample", "HistogramData", "get_registry",
     "Monitor", "Timer", "annotate", "profile",

@@ -319,6 +319,44 @@ class Pipeline:
                 "(truncated or corrupted write)", path=path)
         return raw
 
+    def _diff_report(self, bst) -> Optional[Dict[str, Any]]:
+        """xtpuinsight forensic for a rejection: diff the candidate
+        against the live baseline artifact on the fixed holdout. None
+        when no baseline is active; never raises (forensics are
+        best-effort, a broken explanation must not mask the decision)."""
+        active = self.manifest.active
+        if active is None or bst is None:
+            return None
+        try:
+            base = self._booster_from_bytes(
+                self._read_artifact(active["path"]))
+        except Exception:
+            return None
+        return self.gates.explain(base, bst, dm=self._holdout)
+
+    def _inspect_summary(self, bst) -> Optional[Dict[str, Any]]:
+        """Compact ``Booster.inspect()`` snapshot for the manifest entry:
+        shape totals plus the top-5 normalized total_gain features. A
+        deterministic function of the model bytes, so live runs and
+        replays commit byte-identical manifests; never raises."""
+        from ..obs import insight as _insight
+
+        try:
+            full = _insight.model_inspect(bst)
+            gain = _insight._normalized_importance(bst, "total_gain")
+        except Exception:
+            return None
+        out: Dict[str, Any] = {
+            "num_trees": full["num_trees"],
+            "num_features": full["num_features"],
+            "top_gain": dict(sorted(gain.items(),
+                                    key=lambda kv: (-kv[1], kv[0]))[:5])}
+        shape = full.get("tree_shape")
+        if shape:
+            out["nodes_total"] = shape["nodes_total"]
+            out["leaves_total"] = shape["leaves_total"]
+        return out
+
     def _decide(self, e: int, bst) -> Dict[str, Any]:
         """Gate -> artifact -> manifest commit -> serve swap -> canary.
         Everything before :meth:`PromotionManifest.record_promotion` is
@@ -333,9 +371,11 @@ class Pipeline:
         try:
             self.gates.check(scores, baseline, e)
         except DriftGateFailed as err:
-            self.manifest.record_rejection(e, str(err), scores)
+            diff = self._diff_report(bst)
+            err.report = diff
+            self.manifest.record_rejection(e, str(err), scores, diff=diff)
             return {"epoch": e, "action": "rejected", "reason": str(err),
-                    "scores": scores, "error": err}
+                    "scores": scores, "diff": diff, "error": err}
         self._fire("post_gate", e)
 
         version = self.manifest.last_version + 1
@@ -359,7 +399,7 @@ class Pipeline:
                 f"promoted artifact v{version} failed read-back "
                 f"verification: {err} — previous version keeps serving; "
                 "recovery will regenerate it", version=version, epoch=e,
-                path=path) from err
+                path=path, report=self._diff_report(bst)) from err
         try:
             self._booster_from_bytes(checked)
         except Exception as err:
@@ -367,10 +407,11 @@ class Pipeline:
                 f"promoted artifact v{version} failed read-back load: "
                 f"{err} — previous version keeps serving; recovery will "
                 "regenerate it", version=version, epoch=e,
-                path=path) from err
+                path=path, report=self._diff_report(bst)) from err
 
         self.manifest.record_promotion(e, version, path,
-                                       rounds=(e + 1) * k, scores=scores)
+                                       rounds=(e + 1) * k, scores=scores,
+                                       inspect=self._inspect_summary(bst))
         self._fire("post_manifest", e)
 
         t0 = time.perf_counter()

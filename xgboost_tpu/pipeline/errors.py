@@ -27,12 +27,17 @@ class DriftGateFailed(PipelineError):
     def __init__(self, message: str, *, metric: Optional[str] = None,
                  candidate: Optional[float] = None,
                  baseline: Optional[float] = None,
-                 epoch: Optional[int] = None) -> None:
+                 epoch: Optional[int] = None,
+                 report: Optional[Dict[str, Any]] = None) -> None:
         super().__init__(message)
         self.metric = metric
         self.candidate = candidate
         self.baseline = baseline
         self.epoch = epoch
+        #: xtpuinsight model-diff forensic (``obs.insight.model_diff``):
+        #: which features/trees moved between the live baseline and the
+        #: rejected candidate — the "why" behind the metric delta
+        self.report = report
 
 
 class PromotionRejected(PipelineError):
@@ -45,11 +50,16 @@ class PromotionRejected(PipelineError):
 
     def __init__(self, message: str, *, version: Optional[int] = None,
                  epoch: Optional[int] = None,
-                 path: Optional[str] = None) -> None:
+                 path: Optional[str] = None,
+                 report: Optional[Dict[str, Any]] = None) -> None:
         super().__init__(message)
         self.version = version
         self.epoch = epoch
         self.path = path
+        #: xtpuinsight model-diff forensic when a candidate existed at
+        #: rejection time (None when the failure precedes a candidate,
+        #: e.g. an unserveable active artifact found during reconcile)
+        self.report = report
 
 
 class CanaryRolledBack(PipelineError):

@@ -107,3 +107,20 @@ class DriftGates:
         for r in self.rules:
             r.check(candidate[r.metric],
                     (baseline or {}).get(r.metric), epoch)
+
+    @staticmethod
+    def explain(baseline_bst, candidate_bst, dm=None,
+                top: int = 5) -> Optional[dict]:
+        """Model-diff forensic for a gate decision: attribute the metric
+        delta between the live baseline and the candidate to the
+        features/trees that moved (``obs.insight.model_diff``). Returns
+        None when there is no baseline to diff against. Never raises —
+        an explanation must not turn a clean rejection into a crash."""
+        if baseline_bst is None or candidate_bst is None:
+            return None
+        from ..obs.insight import model_diff
+
+        try:
+            return model_diff(baseline_bst, candidate_bst, dm=dm, top=top)
+        except Exception:           # forensics are best-effort by design
+            return None

@@ -88,10 +88,16 @@ class PromotionManifest:
     # -- transitions (each one is a single durable commit) -------------------
     def record_promotion(self, epoch: int, version: int, path: str,
                          rounds: int,
-                         scores: Optional[Dict[str, float]] = None) -> None:
+                         scores: Optional[Dict[str, float]] = None,
+                         inspect: Optional[Dict[str, Any]] = None) -> None:
         entry = {"version": int(version), "epoch": int(epoch),
                  "path": path, "rounds": int(rounds),
                  "scores": dict(scores or {})}
+        if inspect is not None:
+            # xtpuinsight per-epoch model snapshot (deterministic function
+            # of the artifact bytes, so live runs and replays commit the
+            # byte-identical manifest)
+            entry["inspect"] = inspect
         st = self.state
         st["active"] = entry
         st["decided_epoch"] = max(self.decided_epoch, int(epoch))
@@ -101,12 +107,17 @@ class PromotionManifest:
         self.commit()
 
     def record_rejection(self, epoch: int, reason: str,
-                         scores: Optional[Dict[str, float]] = None) -> None:
+                         scores: Optional[Dict[str, float]] = None,
+                         diff: Optional[Dict[str, Any]] = None) -> None:
         st = self.state
+        event = {"type": "rejected", "epoch": int(epoch),
+                 "reason": reason, "scores": dict(scores or {})}
+        if diff is not None:
+            # the model-diff forensic behind the rejection: which features
+            # drifted between the live baseline and the failed candidate
+            event["diff"] = diff
         st["decided_epoch"] = max(self.decided_epoch, int(epoch))
-        st["events"].append({"type": "rejected", "epoch": int(epoch),
-                             "reason": reason,
-                             "scores": dict(scores or {})})
+        st["events"].append(event)
         self.commit()
 
     def record_rollback(self, epoch: int, version: int,

@@ -186,3 +186,64 @@ def _resident_scan() -> RoundPlan:
 @register_program("resident.mega")
 def _resident_mega() -> RoundPlan:
     return _resident_plan("mega")
+
+
+_RE = 64  # eval rows in the insight-armed abstract trace
+
+
+def _resident_insight_plan(hist_method: str) -> RoundPlan:
+    """The xtpuinsight-armed resident round (obs/insight.py): telemetry
+    scalars and ONE armed eval set (margin walk + metric partials) ride
+    the round program as extra outputs. Same dispatch list length as the
+    unarmed plan — the contract table pins the budget, so smuggling the
+    telemetry into its own dispatch is a gate failure."""
+    from . import core
+    from .registry import OBJECTIVES
+    from .tree.param import TrainParam
+
+    obj_cls = OBJECTIVES.get("binary:logistic")
+    round_fn, guard_fn = core.steady_round_dispatches_insight()
+    round_spec = ProgramSpec(
+        name="fused_round_insight",
+        fn=round_fn,
+        args=(_abstract((_R, _F), "uint8"),       # bins
+              _abstract((_R, 1), "float32"),      # margin (donated)
+              _abstract((_R,), "float32"),        # labels
+              None,                               # weights
+              _abstract((_F,), "int32"),          # n_real
+              _abstract((), "uint32"),            # seed
+              _abstract((), "int32"),             # iteration
+              None, None, None,                   # monotone/constraints/cat
+              (_abstract((_RE, _F), "uint8"),),   # eval bins
+              (_abstract((_RE, 1), "float32"),),  # eval margins (donated)
+              (_abstract((_RE,), "float32"),),    # eval labels
+              (None,)),                           # eval weights
+        kwargs=dict(obj_cls=obj_cls, obj_params=(),
+                    param=TrainParam(max_depth=3), max_nbins=_B,
+                    hist_method=hist_method, has_missing=True,
+                    nan_policy="raise",
+                    eval_specs=(("logloss", 0.0),),
+                    eval_missing=(_B - 1,)),
+        donate_argnums=(1, 11))
+    guard_spec = ProgramSpec(
+        name="margin_bad_rows",
+        fn=guard_fn,
+        args=(_abstract((_R, 1), "float32"),),
+        kwargs=dict(n_valid=_R))
+    return RoundPlan(handle=f"resident.{hist_method}.insight", unit="round",
+                     dispatches=[round_spec, guard_spec])
+
+
+@register_program("resident.fused.insight")
+def _resident_fused_insight() -> RoundPlan:
+    return _resident_insight_plan("fused")
+
+
+@register_program("resident.scan.insight")
+def _resident_scan_insight() -> RoundPlan:
+    return _resident_insight_plan("scan")
+
+
+@register_program("resident.mega.insight")
+def _resident_mega_insight() -> RoundPlan:
+    return _resident_insight_plan("mega")

@@ -28,6 +28,9 @@ the micro-batcher:
 
     POST /v1/predict   {"data": ..., "model":?, "output":?}
     GET  /v1/models    registry listing
+    GET  /v1/model/<name>/report
+                       xtpuinsight model report for the served version
+                       (importance, tree shape — obs.insight.model_inspect)
     GET  /v1/metrics   ServeMetrics snapshot (JSON)
     GET  /metrics      Prometheus text exposition from the process-wide
                        MetricsRegistry (serve + pipeline + collective
@@ -175,6 +178,23 @@ def make_http_server(server: Server, port: int,
                 self._send(200, server.metrics_snapshot())
             elif self.path == "/v1/models":
                 self._send(200, server.registry.describe())
+            elif self.path.startswith("/v1/model/") \
+                    and self.path.endswith("/report"):
+                # xtpuinsight model report: structure + importance of the
+                # served version, rendered on demand (inspection is pure
+                # host work — the scoring hot path is untouched)
+                name = self.path[len("/v1/model/"):-len("/report")]
+                from ..obs.insight import model_inspect
+
+                try:
+                    sm = server.registry.get(name or None)
+                except UnknownModel as exc:
+                    self._send(404, _error_obj(exc, None))
+                    return
+                report = model_inspect(sm.booster)
+                report["name"] = sm.name
+                report["version"] = sm.version
+                self._send(200, report)
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
