@@ -722,13 +722,22 @@ def main():
         # serve_* headline keys ride in the same scored JSON line
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
-        from bench_serve import run_bench as _serve_bench
+        import bench_serve as _bs
 
-        for k, v in _serve_bench(
-                n_requests=int(os.environ.get("BENCH_SERVE_REQS", 400)),
-                target_qps=float(os.environ.get("BENCH_SERVE_QPS", 200)),
-        ).items():
-            if k.startswith("serve_"):
+        serve_keys = _bs.run_bench(
+            n_requests=int(os.environ.get("BENCH_SERVE_REQS", 400)),
+            target_qps=float(os.environ.get("BENCH_SERVE_QPS", 200)))
+        # PR 15: fleet aggregate qps (the >=10k SLO cell), device-TreeSHAP
+        # contribs latency, and the packed-vs-chunked walk speedup
+        serve_keys.update(_bs.run_fleet_bench(
+            n_replicas=int(os.environ.get("BENCH_FLEET_REPLICAS", 4)),
+            n_requests=int(os.environ.get("BENCH_FLEET_REQS", 6000)),
+            target_qps=float(os.environ.get("BENCH_FLEET_QPS", 12_000))))
+        serve_keys.update(_bs.run_shap_bench(
+            n_requests=int(os.environ.get("BENCH_SHAP_REQS", 60))))
+        serve_keys.update(_bs.run_packed_speedup())
+        for k, v in serve_keys.items():
+            if k.startswith(("serve_", "packed_", "unpacked_")):
                 result[k] = v
     print(json.dumps(result))
     print(f"# auc={auc:.4f} baseline(sklearn-hist)={base_rps:.3f} rounds/s",

@@ -1,0 +1,421 @@
+"""Fleet serving (serve/fleet.py): consistent-hash placement, routing
+and failover, kill-one-replica with zero lost futures, atomic fan-out
+promotion, autoscaling on queue/p99 signals, replica-labeled metrics,
+client retry under shed, the ``--fleet`` frontend, and the pipeline
+driver's fleet-aware ``_sync_server`` branch."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.parallel.resilience import RetryPolicy
+from xgboost_tpu.serve import (DeadlineExceeded, FleetConfig, FleetRouter,
+                               ServeClient, ServeConfig, Server,
+                               ServerOverloaded, UnknownModel)
+from xgboost_tpu.serve.fleet import _HashRing
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(31)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    return xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                      "eta": 0.3}, xgb.DMatrix(X, label=y), 6,
+                     verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def booster2(data):
+    X, y = data
+    return xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                      "eta": 0.2, "seed": 9}, xgb.DMatrix(X, label=y), 4,
+                     verbose_eval=False)
+
+
+def _fleet(booster, n=2, replication=2, **cfg):
+    fl = FleetRouter(
+        models={"m": booster},
+        config=FleetConfig(replicas=n, min_replicas=1, max_replicas=4,
+                           replication=replication,
+                           serve=ServeConfig(max_batch=64,
+                                             max_delay_ms=1.0), **cfg))
+    fl.warmup()
+    return fl
+
+
+# ------------------------------------------------------------------- ring
+
+def test_hash_ring_determinism_and_churn():
+    keys = [f"k{i}" for i in range(200)]
+    ring = _HashRing(["a", "b", "c", "d"])
+    assert _HashRing(["d", "c", "b", "a"]).place("k1", 2) == \
+        ring.place("k1", 2)
+    before = {k: ring.place(k, 2) for k in keys}
+    assert all(len(set(v)) == 2 for v in before.values())
+    ring.add("e")
+    moved = sum(before[k] != ring.place(k, 2) for k in keys)
+    assert 0 < moved <= len(keys) // 2      # bounded churn, not a rehash
+    ring.remove("e")
+    assert all(ring.place(k, 2) == before[k] for k in keys)
+    # placement never returns more nodes than exist
+    assert len(ring.place("x", 10)) == 4
+
+
+def test_fleet_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("XTPU_FLEET_REPLICAS", "3")
+    monkeypatch.setenv("XTPU_FLEET_MIN", "2")
+    monkeypatch.setenv("XTPU_FLEET_MAX", "5")
+    monkeypatch.setenv("XTPU_FLEET_REPLICATION", "1")
+    cfg = FleetConfig()
+    assert (cfg.replicas, cfg.min_replicas, cfg.max_replicas,
+            cfg.replication) == (3, 2, 5, 1)
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=0)
+    with pytest.raises(ValueError):
+        FleetConfig(min_replicas=4, max_replicas=2)
+
+
+# ---------------------------------------------------------------- routing
+
+def test_fleet_predict_parity_and_routing(data, booster):
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X))
+    fl = _fleet(booster, n=3, replication=2)
+    try:
+        for n in (1, 7, 64, 300):
+            np.testing.assert_array_equal(
+                np.asarray(fl.predict(X[:n], "m")), oracle[:n])
+        r = fl.predict(X[:2], "m")
+        assert (r.model, r.version) == ("m", 1)
+        assert len(fl.placement("m")) == 2
+        assert fl.metrics_snapshot()["fleet"]["routed"] >= 4
+        with pytest.raises(UnknownModel):
+            fl.predict(X[:1], "absent")
+    finally:
+        fl.close()
+
+
+def test_fleet_failover_on_shed(data, booster):
+    """A shedding replica is skipped; the request lands on its peer."""
+    X, _ = data
+    fl = _fleet(booster, n=2, replication=2)
+    try:
+        victim = fl.placement("m")[0]
+        srv = dict(zip(fl.replica_names(), fl.replicas()))[victim]
+        orig = srv.submit
+
+        def shed(*a, **k):
+            raise ServerOverloaded("induced")
+
+        srv.submit = shed
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:5], "m")),
+            booster.predict(xgb.DMatrix(X[:5])))
+        assert fl.metrics_snapshot()["fleet"]["failovers"] >= 1
+        srv.submit = orig
+    finally:
+        fl.close()
+
+
+def test_kill_one_replica_zero_lost_futures(data, booster):
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X[:16]))
+    fl = _fleet(booster, n=3, replication=3)
+    try:
+        victim = fl.placement("m")[0]
+        futures = [fl.submit(X[:16], "m") for _ in range(30)]
+        t = threading.Thread(
+            target=lambda: fl.remove_replica(victim, drain=True))
+        t.start()
+        futures += [fl.submit(X[:16], "m") for _ in range(30)]
+        t.join()
+        for f in futures:
+            np.testing.assert_array_equal(
+                np.asarray(f.result(timeout=30)), oracle)
+        assert victim not in fl.replica_names()
+        assert fl.health_snapshot()["status"] == "ok"
+    finally:
+        fl.close()
+
+
+def test_add_replica_rebalances_and_warms(data, booster):
+    X, _ = data
+    fl = _fleet(booster, n=2, replication=1)
+    try:
+        name = fl.add_replica()
+        assert name in fl.replica_names() and fl.n_replicas == 3
+        assert fl.recompiles_after_warmup == 0
+        # every placed replica actually serves the model
+        placed = set(fl.placement("m"))
+        for r in fl.replicas():
+            has = any(m["name"] == "m"
+                      for m in r.health_snapshot()["models"])
+            assert has == (r.replica in placed)
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:4], "m")),
+            booster.predict(xgb.DMatrix(X[:4])))
+    finally:
+        fl.close()
+
+
+# -------------------------------------------------------------- promotion
+
+def test_fleet_swap_atomic_and_zero_recompiles(data, booster, booster2):
+    X, _ = data
+    p1 = booster.predict(xgb.DMatrix(X[:20]))
+    p2 = booster2.predict(xgb.DMatrix(X[:20]))
+    fl = _fleet(booster, n=3, replication=3)
+    try:
+        assert fl.served_versions("m") == {1}
+        np.testing.assert_array_equal(np.asarray(fl.predict(X[:20], "m")),
+                                      p1)
+        fl.swap_model("m", booster2, warm=True)
+        assert fl.served_versions("m") == {2}
+        np.testing.assert_array_equal(np.asarray(fl.predict(X[:20], "m")),
+                                      p2)
+        assert fl.recompiles_after_warmup == 0
+        assert fl.metrics_snapshot()["fleet"]["promotions"] >= 2
+        rb = fl.rollback_model("m")
+        assert rb.version == 1 and fl.served_versions("m") == {1}
+        np.testing.assert_array_equal(np.asarray(fl.predict(X[:20], "m")),
+                                      p1)
+    finally:
+        fl.close()
+
+
+def test_fleet_failed_swap_publishes_nothing(data, booster):
+    """Two-phase promotion: a prepare failure on ANY placed replica
+    aborts the fan-out before any replica publishes."""
+    X, _ = data
+    fl = _fleet(booster, n=2, replication=2)
+    try:
+        bad = object()                       # not a booster: prepare raises
+        with pytest.raises(Exception):
+            fl.swap_model("m", bad, warm=False)
+        assert fl.served_versions("m") == {1}
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:4], "m")),
+            booster.predict(xgb.DMatrix(X[:4])))
+    finally:
+        fl.close()
+
+
+# -------------------------------------------------------------- autoscale
+
+def test_autoscale_up_down(data, booster, monkeypatch):
+    fl = FleetRouter(
+        models={"m": booster},
+        config=FleetConfig(replicas=2, min_replicas=2, max_replicas=4,
+                           replication=2, scale_up_queue_rows=4,
+                           serve=ServeConfig(max_batch=64,
+                                             max_delay_ms=1.0)))
+    fl.warmup()
+    try:
+        # pin the queue-depth signal past the trigger (the decision
+        # logic is the unit under test, not batcher timing)
+        srv = fl.replicas()[0]
+        monkeypatch.setattr(srv.batcher, "queue_depth_rows", lambda: 99)
+        assert fl.autoscale_tick() == "up"
+        assert fl.n_replicas == 3
+        monkeypatch.setattr(srv.batcher, "queue_depth_rows", lambda: 0)
+        assert fl.autoscale_tick() == "down"      # idle again
+        assert fl.n_replicas == 2
+        assert fl.autoscale_tick() is None        # hysteresis: stay put
+        snap = fl.metrics_snapshot()["fleet"]
+        assert snap["scale_up_events"] == 1
+        assert snap["scale_down_events"] == 1
+    finally:
+        fl.close()
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_replica_labeled_metrics(data, booster):
+    from xgboost_tpu.obs.metrics import render_families
+
+    X, _ = data
+    fl = _fleet(booster, n=2)
+    try:
+        fl.predict(X[:3], "m")
+        fams = fl._collect_obs()
+        names = {f.name for f in fams}
+        assert {"xtpu_fleet_replicas", "xtpu_fleet_replica_up",
+                "xtpu_fleet_routed_total"} <= names
+        text = render_families(
+            [f for r in fl.replicas() for f in r._collect_obs()] +
+            list(fams))
+        assert 'replica="r0"' in text and 'replica="r1"' in text
+        assert "xtpu_fleet_replicas 2" in text
+    finally:
+        fl.close()
+
+
+def test_health_snapshot_aggregates(data, booster):
+    X, _ = data
+    fl = _fleet(booster, n=2)
+    try:
+        fl.predict(X[:3], "m")
+        h = fl.health_snapshot()
+        assert h["fleet"] is True and h["n_replicas"] == 2
+        assert set(h["replicas"]) == set(fl.replica_names())
+        assert h["requests"] == sum(
+            r["requests"] for r in h["replicas"].values())
+        assert any(m["name"] == "m" for m in h["models"])
+    finally:
+        fl.close()
+
+
+# ------------------------------------------------------------ client retry
+
+def test_client_retries_shed_until_capacity(data, booster):
+    """ServeClient + RetryPolicy turns transient sheds into a short wait
+    instead of an error."""
+    X, _ = data
+    srv = Server(models={"m": booster},
+                 config=ServeConfig(max_batch=16, max_delay_ms=1.0,
+                                    max_queue_rows=16))
+    srv.warmup()
+    try:
+        fails = {"n": 0}
+        orig = srv.submit
+
+        def flaky(*a, **k):
+            if fails["n"] < 2:
+                fails["n"] += 1
+                raise ServerOverloaded("transient")
+            return orig(*a, **k)
+
+        srv.submit = flaky
+        cli = ServeClient(srv, "m",
+                          retry=RetryPolicy(max_retries=3,
+                                            base_delay_s=0.001))
+        np.testing.assert_array_equal(
+            np.asarray(cli.predict(X[:4])),
+            booster.predict(xgb.DMatrix(X[:4])))
+        assert fails["n"] == 2
+        srv.submit = orig
+    finally:
+        srv.close()
+
+
+def test_client_retry_honors_deadline(data, booster):
+    """Backoff sleeps spend the caller's deadline; when the budget is
+    gone the client raises DeadlineExceeded instead of sleeping on."""
+    X, _ = data
+    srv = Server(models={"m": booster}, config=ServeConfig(max_batch=16))
+    srv.warmup()
+    try:
+        srv.submit = lambda *a, **k: (_ for _ in ()).throw(
+            ServerOverloaded("always"))
+        cli = ServeClient(srv, "m",
+                          retry=RetryPolicy(max_retries=50,
+                                            base_delay_s=0.05,
+                                            max_delay_s=0.05))
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            cli.predict(X[:2], timeout_ms=60)
+        assert time.perf_counter() - t0 < 1.0
+    finally:
+        srv.close()
+
+
+def test_client_without_policy_fails_fast(data, booster):
+    X, _ = data
+    srv = Server(models={"m": booster}, config=ServeConfig(max_batch=16))
+    srv.warmup()
+    try:
+        srv.submit = lambda *a, **k: (_ for _ in ()).throw(
+            ServerOverloaded("always"))
+        with pytest.raises(ServerOverloaded):
+            ServeClient(srv, "m").predict(X[:2])
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- frontend
+
+def test_build_server_fleet_and_http(data, booster, tmp_path):
+    import urllib.request
+
+    from xgboost_tpu.serve.frontend import build_server, make_http_server
+
+    X, _ = data
+    path = str(tmp_path / "m.ubj")
+    booster.save_model(path)
+    server, front = build_server(
+        ["--fleet", "2", f"model[m]={path}", "max_batch=32"])
+    try:
+        assert isinstance(server, FleetRouter) and server.n_replicas == 2
+        assert front == {}
+        np.testing.assert_array_equal(
+            np.asarray(server.predict(X[:4], "m")),
+            booster.predict(xgb.DMatrix(X[:4])))
+        httpd = make_http_server(server, 0)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            h = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz").read())
+            assert h["fleet"] is True and h["n_replicas"] == 2
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/predict",
+                data=json.dumps({"data": X[:3].tolist(),
+                                 "model": "m"}).encode())
+            resp = json.loads(urllib.request.urlopen(req).read())
+            np.testing.assert_allclose(
+                resp["predictions"],
+                booster.predict(xgb.DMatrix(X[:3])), rtol=0, atol=0)
+        finally:
+            httpd.shutdown()
+    finally:
+        server.close()
+
+
+# ------------------------------------------------------------------ driver
+
+def test_pipeline_sync_server_fleet(data, booster, tmp_path):
+    """The pipeline promotes INTO a fleet: _sync_server fans the
+    manifest's active version out to every placed replica."""
+    from xgboost_tpu.pipeline import Pipeline, PipelineConfig
+    from xgboost_tpu.pipeline.gates import GateRule
+
+    X, y = data
+    fl = FleetRouter(config=FleetConfig(
+        replicas=2, min_replicas=1, max_replicas=2, replication=2,
+        serve=ServeConfig(max_batch=64, max_delay_ms=1.0)))
+    try:
+        cfg = PipelineConfig(
+            workdir=str(tmp_path), rounds_per_epoch=2,
+            params={"objective": "binary:logistic", "max_depth": 3,
+                    "eta": 0.3},
+            gates=(GateRule("auc", max_regression=0.5),))
+        pipe = Pipeline(cfg, server=fl, holdout=(X[:100], y[:100]))
+        pipe.step(X, y)
+        assert fl.served_versions("model") == {1}
+        pipe.step(X, y)
+        assert fl.served_versions("model") == {2}
+        raw = open(pipe.manifest.active["path"], "rb").read()
+        oracle = xgb.Booster(model_file=bytearray(raw))
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:8], "model")),
+            oracle.predict(xgb.DMatrix(X[:8])))
+        # a half-promoted fleet (mixed versions) is re-fanned on sync
+        one = fl.replicas()[0]
+        one.registry.publish(one.registry.prepare(
+            "model", pipe._final_booster(0), version=77))
+        assert len(fl.served_versions("model")) == 2
+        pipe._sync_server()
+        assert fl.served_versions("model") == {2}
+    finally:
+        fl.close()

@@ -1,0 +1,228 @@
+"""Packed forest layout (serve/packed.py + ops/walk.py): byte-stable
+pack→unpack→pack round trips, bit-exact walk parity with
+Booster.predict across bucket sizes / padding / multiclass / NaN
+default routing / categorical splits, field-width validation (the
+mutation test narrows a width and watches the SAME forest get
+rejected), and the opt-in Pallas walk in interpret mode."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.serve.packed import PackedForest, PackError
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(11)
+    X = rng.randn(400, 9).astype(np.float32)
+    X[rng.rand(400, 9) < 0.12] = np.nan  # exercise default directions
+    y = (np.nan_to_num(X[:, 0]) - np.nan_to_num(X[:, 2]) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    return xgb.train({"objective": "binary:logistic", "max_depth": 5,
+                      "eta": 0.3}, xgb.DMatrix(X, label=y), 10,
+                     verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def booster_multi(data):
+    X, _ = data
+    rng = np.random.RandomState(12)
+    y3 = rng.randint(0, 3, size=X.shape[0])
+    return xgb.train({"objective": "multi:softprob", "num_class": 3,
+                      "max_depth": 4, "eta": 0.3},
+                     xgb.DMatrix(X, label=y3), 5, verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def booster_cat():
+    rng = np.random.RandomState(13)
+    n = 300
+    Xc = rng.randint(0, 8, size=(n, 2)).astype(np.float32)
+    Xn = rng.randn(n, 3).astype(np.float32)
+    X = np.concatenate([Xc, Xn], axis=1)
+    y = ((Xc[:, 0] % 3 == 0) ^ (Xn[:, 0] > 0)).astype(np.float32)
+    dm = xgb.DMatrix(X, label=y, enable_categorical=True,
+                     feature_types=["c", "c", "q", "q", "q"])
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "eta": 0.3}, dm, 6, verbose_eval=False)
+    return bst, X
+
+
+def _margin(pf, X, bst):
+    return np.asarray(pf.margin(X, bst._base_np()))
+
+
+# ------------------------------------------------------------- round trip
+
+def test_pack_unpack_repack_byte_stable(booster):
+    """pack(unpack(pack(forest))) must reproduce every buffer byte for
+    byte — the layout has one canonical form."""
+    pf = PackedForest.from_booster(booster)
+    pf2 = pf.repack()
+    for attr in ("words", "values", "hess", "cat_words", "tree_offsets",
+                 "n_nodes", "tree_weight", "group_onehot", "tree_info"):
+        a, b = getattr(pf, attr), getattr(pf2, attr)
+        assert a.dtype == b.dtype and a.shape == b.shape, attr
+        assert a.tobytes() == b.tobytes(), f"{attr} not byte-stable"
+    assert (pf.max_depth, pf.n_trees, pf.has_cat) == \
+           (pf2.max_depth, pf2.n_trees, pf2.has_cat)
+
+
+def test_unpack_matches_source_trees(booster):
+    """The decoded SoA must agree with the original TreeModel hosts
+    (modulo the adjacent-sibling renumbering, which to_trees keeps)."""
+    pf = PackedForest.from_booster(booster)
+    trees, _, _ = booster.gbm.forest_slice()
+    for src, dec in zip(trees, pf.to_trees()):
+        assert dec.num_nodes() == src.num_nodes()
+        assert int(dec.is_leaf.sum()) == int(src.is_leaf.sum())
+        np.testing.assert_array_equal(
+            np.sort(dec.leaf_value[dec.is_leaf]),
+            np.sort(src.leaf_value[src.is_leaf]))
+        # right child adjacent to left everywhere
+        internal = ~dec.is_leaf
+        np.testing.assert_array_equal(dec.right_child[internal],
+                                      dec.left_child[internal] + 1)
+
+
+# ----------------------------------------------------------- walk parity
+
+def test_walk_parity_bit_exact(data, booster):
+    """Packed walk == Booster.predict margins BITWISE, at sizes that pad
+    and sizes that chunk."""
+    X, _ = data
+    pf = PackedForest.from_booster(booster)
+    oracle = booster.predict(xgb.DMatrix(X), output_margin=True)
+    for n in (1, 2, 3, 5, 17, 64, 65, 200, 400):
+        got = _margin(pf, X[:n], booster)
+        np.testing.assert_array_equal(got.ravel(), oracle[:n])
+
+
+def test_walk_parity_multiclass_and_nan(data, booster_multi):
+    X, _ = data
+    pf = PackedForest.from_booster(booster_multi)
+    oracle = booster_multi.predict(xgb.DMatrix(X), output_margin=True)
+    got = _margin(pf, X, booster_multi)
+    assert got.shape == oracle.shape == (X.shape[0], 3)
+    np.testing.assert_array_equal(got, oracle)
+    # all-NaN rows take the default direction at every split
+    Xnan = np.full((4, X.shape[1]), np.nan, np.float32)
+    np.testing.assert_array_equal(
+        _margin(pf, Xnan, booster_multi),
+        booster_multi.predict(xgb.DMatrix(Xnan), output_margin=True))
+
+
+def test_walk_parity_categorical(booster_cat):
+    bst, X = booster_cat
+    pf = PackedForest.from_booster(bst)
+    assert pf.has_cat
+    oracle = bst.predict(
+        xgb.DMatrix(X, enable_categorical=True,
+                    feature_types=["c", "c", "q", "q", "q"]),
+        output_margin=True)
+    np.testing.assert_array_equal(_margin(pf, X, bst).ravel(), oracle)
+
+
+def test_registry_pins_packed_and_env_gate(data, booster, monkeypatch):
+    """The serve registry uses the packed walk by default and the
+    XTPU_PACKED_WALK=0 escape hatch falls back bit-identically."""
+    from xgboost_tpu.serve import ServeConfig, Server
+
+    X, _ = data
+    oracle = booster.predict(xgb.DMatrix(X[:32]))
+    srv = Server(models={"m": booster},
+                 config=ServeConfig(max_batch=32, max_delay_ms=1.0))
+    try:
+        assert srv.registry.get("m").packed is not None
+        np.testing.assert_array_equal(
+            np.asarray(srv.predict(X[:32])), oracle)
+    finally:
+        srv.close()
+    monkeypatch.setenv("XTPU_PACKED_WALK", "0")
+    srv = Server(models={"m": booster},
+                 config=ServeConfig(max_batch=32, max_delay_ms=1.0))
+    try:
+        assert srv.registry.get("m").packed is None
+        np.testing.assert_array_equal(
+            np.asarray(srv.predict(X[:32])), oracle)
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------- field validation
+
+def test_mutation_narrow_offset_field_rejected(booster, monkeypatch):
+    """THE mutation test: shrink the offset field until the forest's
+    child deltas overflow it — the packer must REFUSE, not truncate.
+    A packer that drops this validation ships corrupt words; this test
+    is what fails in that regression."""
+    from xgboost_tpu.serve import packed as P
+
+    pf = PackedForest.from_booster(booster)    # sane widths: packs fine
+    deltas = pf.words[:int(pf.n_nodes.sum())] & np.uint32(0xFFFF)
+    need_bits = int(deltas.max()).bit_length()
+    assert need_bits >= 2, "fixture forest too small to mutate"
+    monkeypatch.setattr(P, "OFFSET_BITS", need_bits - 1)
+    with pytest.raises(PackError, match="offset.*overflows"):
+        PackedForest.from_booster(booster)
+
+
+def test_mutation_narrow_feature_field_rejected(booster, monkeypatch):
+    from xgboost_tpu.serve import packed as P
+
+    monkeypatch.setattr(P, "FEAT_BITS", 1)     # forest uses features > 1
+    with pytest.raises(PackError, match="feature.*overflows"):
+        PackedForest.from_booster(booster)
+
+
+def test_mutation_colliding_fields_rejected(monkeypatch):
+    """Widths that collide with the flag bits are a layout bug, caught
+    at _field_layout time before any word is written."""
+    from xgboost_tpu.serve import packed as P
+
+    monkeypatch.setattr(P, "OFFSET_BITS", 20)
+    monkeypatch.setattr(P, "FEAT_BITS", 13)
+    with pytest.raises(PackError, match="collide"):
+        P._field_layout()
+
+
+def test_pack_rejects_empty_forest():
+    with pytest.raises(PackError, match="empty"):
+        PackedForest.from_trees([], [], 1)
+
+
+# ------------------------------------------------------------ pallas walk
+
+def test_pallas_walk_interpret_parity(data, booster, booster_multi):
+    """The VMEM-resident Pallas walk (interpret mode on CPU) is bitwise
+    identical to the reference packed walk."""
+    from xgboost_tpu.ops.pallas.walk import walk_packed_pallas
+
+    X, _ = data
+    for bst in (booster, booster_multi):
+        pf = PackedForest.from_booster(bst)
+        ref = _margin(pf, X[:200], bst)
+        got = np.asarray(walk_packed_pallas(
+            pf, X[:200], bst._base_np(), interpret=True))
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_pallas_walk_refuses_cat_and_oversize(booster_cat, monkeypatch):
+    from xgboost_tpu.ops.pallas import walk as W
+
+    bst, X = booster_cat
+    pf = PackedForest.from_booster(bst)
+    with pytest.raises(ValueError, match="categorical"):
+        W.walk_packed_pallas(pf, X[:4], bst._base_np())
+    monkeypatch.setattr(W, "MAX_VMEM_NODES", 4)
+    pf2 = PackedForest.from_booster(bst)
+    pf2.has_cat = False                        # isolate the size check
+    with pytest.raises(ValueError, match="VMEM"):
+        W.walk_packed_pallas(pf2, X[:4], bst._base_np())

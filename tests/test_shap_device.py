@@ -1,0 +1,208 @@
+"""On-device TreeSHAP over the packed forest (ops/shap.py + the serve
+contribs path): host pred_contribs parity to rtol 1e-5, the efficiency
+axiom (rows sum to the margin), Server.contribs semantics (ladder
+chunking, identity, typed errors), contribs warmup absorbing every
+compile, and the HTTP POST /v1/model/<name>/contribs endpoint."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.serve import (DeadlineExceeded, ServeClient, ServeConfig,
+                               ServeError, Server, UnknownModel)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(21)
+    X = rng.randn(300, 7).astype(np.float32)
+    X[rng.rand(300, 7) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) * np.nan_to_num(X[:, 3]) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    return xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                      "eta": 0.3}, xgb.DMatrix(X, label=y), 8,
+                     verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def booster_multi(data):
+    X, _ = data
+    rng = np.random.RandomState(22)
+    y3 = rng.randint(0, 3, size=X.shape[0])
+    return xgb.train({"objective": "multi:softprob", "num_class": 3,
+                      "max_depth": 3, "eta": 0.3},
+                     xgb.DMatrix(X, label=y3), 4, verbose_eval=False)
+
+
+def _server(booster, **kw):
+    cfg = dict(max_batch=64, max_delay_ms=1.0, shap_max_batch=64)
+    cfg.update(kw)
+    srv = Server(models={"m": booster}, config=ServeConfig(**cfg))
+    srv.warmup()
+    return srv
+
+
+# ----------------------------------------------------------------- parity
+
+def test_device_contribs_match_host_binary(data, booster):
+    """Device TreeSHAP == host pred_contribs to rtol 1e-5, including the
+    bias column, on NaN-bearing rows."""
+    X, _ = data
+    host = booster.predict(xgb.DMatrix(X), pred_contribs=True)
+    srv = _server(booster)
+    try:
+        got = srv.contribs(X)
+        assert got.shape == host.shape == (X.shape[0], X.shape[1] + 1)
+        np.testing.assert_allclose(np.asarray(got), host,
+                                   rtol=1e-5, atol=1e-5)
+        assert (got.model, got.version) == ("m", 1)
+    finally:
+        srv.close()
+
+
+def test_device_contribs_match_host_multiclass(data, booster_multi):
+    X, _ = data
+    host = booster_multi.predict(xgb.DMatrix(X), pred_contribs=True)
+    srv = _server(booster_multi)
+    try:
+        got = np.asarray(srv.contribs(X))
+        assert got.shape == host.shape == (X.shape[0], 3, X.shape[1] + 1)
+        np.testing.assert_allclose(got, host, rtol=1e-5, atol=1e-5)
+    finally:
+        srv.close()
+
+
+def test_contribs_sum_to_margin(data, booster):
+    """Efficiency: per-row contribs (incl. bias) sum to the raw margin."""
+    X, _ = data
+    margin = booster.predict(xgb.DMatrix(X), output_margin=True)
+    srv = _server(booster)
+    try:
+        got = np.asarray(srv.contribs(X))
+        np.testing.assert_allclose(got.sum(axis=-1), margin,
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        srv.close()
+
+
+def test_contribs_chunking_parity(data, booster):
+    """Requests larger than the shap ladder top chunk across dispatches
+    with no seam artifacts."""
+    X, _ = data
+    srv = _server(booster, shap_max_batch=32)
+    try:
+        whole = np.asarray(srv.contribs(X[:100]))
+        parts = np.concatenate([np.asarray(srv.contribs(X[i:i + 25]))
+                                for i in range(0, 100, 25)])
+        np.testing.assert_array_equal(whole, parts)
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------- server API
+
+def test_contribs_warmup_and_zero_recompiles(data, booster):
+    srv = _server(booster)
+    try:
+        n = srv.warmup_contribs()
+        assert n == len(srv.shap_ladder.sizes)
+        for k in (1, 3, 31, 64, 200):
+            srv.contribs(data[0][:k])
+        assert srv.recompiles_after_warmup == 0
+        c = srv.metrics_snapshot()["counters"]
+        assert c["contrib_requests"] >= 5
+        assert c["contrib_rows"] >= 1 + 3 + 31 + 64 + 200
+    finally:
+        srv.close()
+
+
+def test_contribs_typed_errors(data, booster, monkeypatch):
+    X, _ = data
+    srv = _server(booster)
+    try:
+        with pytest.raises(UnknownModel):
+            srv.contribs(X[:2], "absent")
+        with pytest.raises(ValueError):
+            srv.contribs(X[:2, :, None])      # 3-D is never a batch
+        sm = srv.registry.get("m")
+        monkeypatch.setattr(sm, "packed", None)
+        with pytest.raises(ServeError, match="contribs"):
+            srv.contribs(X[:2])
+    finally:
+        srv.close()
+
+
+def test_contribs_deadline(data, booster, monkeypatch):
+    import time as _time
+
+    X, _ = data
+    srv = _server(booster, shap_max_batch=16)
+    try:
+        srv.warmup_contribs()
+        sm = srv.registry.get("m")
+        orig = sm.contribs_padded
+        monkeypatch.setattr(
+            sm, "contribs_padded",
+            lambda Xd: (_time.sleep(0.05), orig(Xd))[1])
+        with pytest.raises(DeadlineExceeded):
+            srv.contribs(X[:64], timeout_ms=20)  # 4 chunks x 50ms
+        assert srv.metrics_snapshot()["counters"]["deadline_exceeded"] >= 1
+    finally:
+        srv.close()
+
+
+def test_client_contribs(data, booster):
+    X, _ = data
+    srv = _server(booster)
+    try:
+        cli = ServeClient(srv, "m")
+        got = cli.contribs(X[:10])
+        np.testing.assert_allclose(
+            np.asarray(got),
+            booster.predict(xgb.DMatrix(X[:10]), pred_contribs=True),
+            rtol=1e-5, atol=1e-5)
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------------- http
+
+def test_http_contribs_endpoint(data, booster):
+    import urllib.error
+    import urllib.request
+
+    from xgboost_tpu.serve.frontend import make_http_server
+
+    X, _ = data
+    host = booster.predict(xgb.DMatrix(X[:6]), pred_contribs=True)
+    srv = _server(booster)
+    httpd = make_http_server(srv, 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/model/m/contribs",
+            data=json.dumps({"data": X[:6].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert resp["model"] == "m" and resp["version"] == 1
+        np.testing.assert_allclose(np.asarray(resp["contribs"]), host,
+                                   rtol=1e-5, atol=1e-5)
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/model/absent/contribs",
+            data=json.dumps({"data": X[:1].tolist()}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad)
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        srv.close()

@@ -13,11 +13,23 @@ driver-scored keys:
     serve_qps                            completed requests / wall s
     serve_recompiles_after_warmup        the zero-recompile SLO
 
+PR 15 adds three more scored keys:
+
+    serve_fleet_qps        completed qps across an N-replica FleetRouter
+                           under a multi-threaded open-loop load (the
+                           >=10k-qps aggregate SLO cell; fleet p99 and
+                           recompiles ride along as context)
+    serve_shap_p99_ms      p99 of the device-TreeSHAP /contribs path
+    packed_walk_speedup    packed one-program walk vs the per-chunk
+                           ForestPredictor walk on the same warm batch
+
 plus context keys (rows/s, shed/deadline counts, per-stage p99s).
 Runs on the CPU backend in-container; on the TPU the same script
 measures the real chip. Env knobs: BENCH_SERVE_REQS (default 400),
 BENCH_SERVE_QPS (target arrival rate, default 200), BENCH_SERVE_ROWS /
-BENCH_SERVE_COLS (train shape), BENCH_SERVE_MAX_BATCH (default 512).
+BENCH_SERVE_COLS (train shape), BENCH_SERVE_MAX_BATCH (default 512),
+BENCH_FLEET_REPLICAS (default 4), BENCH_FLEET_QPS (default 12000),
+BENCH_FLEET_REQS (default 6000), BENCH_SHAP_REQS (default 60).
 """
 
 from __future__ import annotations
@@ -25,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -34,19 +47,25 @@ import numpy as np  # noqa: E402
 MIX = (1, 8, 64, 512)  # request sizes, drawn uniformly
 
 
-def run_bench(n_requests: int = 400, target_qps: float = 200.0,
-              train_rows: int = 20_000, n_cols: int = 16,
-              max_batch: int = 512, seed: int = 0) -> dict:
+def _train_model(train_rows: int, n_cols: int, seed: int = 0,
+                 depth: int = 6, rounds: int = 20):
     import xgboost_tpu as xgb
-    from xgboost_tpu.serve import ServeConfig, Server
 
     rng = np.random.RandomState(seed)
     X = rng.randn(train_rows, n_cols).astype(np.float32)
     y = (X @ rng.randn(n_cols) > 0).astype(np.float32)
-    bst = xgb.train({"objective": "binary:logistic", "max_depth": 6,
-                     "eta": 0.3}, xgb.DMatrix(X, label=y), 20,
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": depth,
+                     "eta": 0.3}, xgb.DMatrix(X, label=y), rounds,
                     verbose_eval=False)
+    return bst, rng
 
+
+def run_bench(n_requests: int = 400, target_qps: float = 200.0,
+              train_rows: int = 20_000, n_cols: int = 16,
+              max_batch: int = 512, seed: int = 0) -> dict:
+    from xgboost_tpu.serve import ServeConfig, Server
+
+    bst, rng = _train_model(train_rows, n_cols, seed)
     pool = rng.randn(max(MIX), n_cols).astype(np.float32)
     sizes = rng.choice(MIX, size=n_requests)
     server = Server(models={"bench": bst},
@@ -103,6 +122,133 @@ def run_bench(n_requests: int = 400, target_qps: float = 200.0,
     }
 
 
+def run_fleet_bench(n_replicas: int = 4, n_requests: int = 6000,
+                    target_qps: float = 12_000.0, train_rows: int = 20_000,
+                    n_cols: int = 16, rows_per_req: int = 1,
+                    n_threads: int = 8, seed: int = 0) -> dict:
+    """Aggregate throughput of an N-replica fleet: an open-loop load
+    split across submitter threads (one Python thread cannot schedule
+    10k arrivals/s), every request routed through the consistent-hash
+    router. Scored: serve_fleet_qps; SLO context: fleet p99 and the
+    fleet-wide recompiles-after-warmup (must be 0)."""
+    from xgboost_tpu.serve import FleetConfig, FleetRouter, ServeConfig
+
+    bst, rng = _train_model(train_rows, n_cols, seed)
+    pool = rng.randn(64, n_cols).astype(np.float32)
+    fleet = FleetRouter(config=FleetConfig(
+        replicas=n_replicas, min_replicas=n_replicas,
+        max_replicas=n_replicas, replication=n_replicas,
+        serve=ServeConfig(max_batch=1024, max_delay_ms=2.0,
+                          max_queue_rows=1 << 17)))
+    fleet.load_model("bench", bst)
+    fleet.warmup()
+
+    per = n_requests // n_threads
+    thread_qps = target_qps / n_threads
+    done = [0] * n_threads
+    shed = [0] * n_threads
+    t0 = time.perf_counter()
+
+    def load(ti: int) -> None:
+        futures = []
+        for i in range(per):
+            due = t0 + i / thread_qps
+            now = time.perf_counter()
+            if due > now:
+                time.sleep(due - now)
+            try:
+                futures.append(fleet.submit(pool[:rows_per_req], "bench"))
+            except Exception:
+                shed[ti] += 1
+        for f in futures:
+            try:
+                f.result(timeout=120)
+                done[ti] += 1
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=load, args=(ti,))
+               for ti in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    p99 = fleet._merged_p99_ms()
+    recompiles = fleet.recompiles_after_warmup
+    fleet.close(drain=True)
+    return {
+        "serve_fleet_qps": round(sum(done) / wall, 1),
+        "serve_fleet_p99_ms": round(p99, 3),
+        "serve_fleet_replicas": n_replicas,
+        "serve_fleet_shed": sum(shed),
+        "serve_fleet_recompiles_after_warmup": recompiles,
+    }
+
+
+def run_shap_bench(n_requests: int = 60, rows_per_req: int = 64,
+                   train_rows: int = 20_000, n_cols: int = 16,
+                   seed: int = 0) -> dict:
+    """Latency of the device-TreeSHAP contribs path (its own bucket
+    ladder; warmup absorbs the compiles). Scored: serve_shap_p99_ms."""
+    from xgboost_tpu.serve import ServeConfig, Server
+
+    bst, rng = _train_model(train_rows, n_cols, seed)
+    pool = rng.randn(rows_per_req, n_cols).astype(np.float32)
+    server = Server(models={"bench": bst},
+                    config=ServeConfig(max_batch=512,
+                                       shap_max_batch=rows_per_req))
+    server.warmup()
+    server.warmup_contribs()
+    for _ in range(n_requests):
+        server.contribs(pool, "bench")
+    snap = server.metrics_snapshot()
+    shap = snap["stages"].get("shap", {})
+    server.close(drain=True)
+    return {
+        "serve_shap_p50_ms": shap.get("p50_ms"),
+        "serve_shap_p99_ms": shap.get("p99_ms"),
+        "serve_shap_rows_per_sec": round(
+            n_requests * rows_per_req * 1e3
+            / max(shap.get("count", 1) * shap.get("mean_ms", 1), 1e-9), 1),
+        "serve_shap_recompiles_after_warmup": snap[
+            "recompiles_after_warmup"],
+    }
+
+
+def run_packed_speedup(rows: int = 4096, train_rows: int = 20_000,
+                       n_cols: int = 16, reps: int = 30,
+                       seed: int = 0) -> dict:
+    """Warm-path wall-clock of the packed one-program walk vs the
+    per-chunk ForestPredictor walk on the same batch. Scored:
+    packed_walk_speedup (unpacked_ms / packed_ms)."""
+    import jax
+
+    from xgboost_tpu.serve.packed import PackedForest
+
+    bst, rng = _train_model(train_rows, n_cols, seed, depth=8, rounds=64)
+    X = rng.randn(rows, n_cols).astype(np.float32)
+    base = np.asarray(bst._base_np(), np.float32)
+    pf = PackedForest.from_booster(bst)
+    pred = bst.gbm._predictor(0, len(bst.gbm.trees))
+    Xd = jax.device_put(X)
+
+    def timed(fn) -> float:
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn())
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    packed_ms = timed(lambda: pf.margin(Xd, base))
+    unpacked_ms = timed(lambda: pred.margin(Xd, base)[0])
+    return {
+        "packed_walk_ms": round(packed_ms, 3),
+        "unpacked_walk_ms": round(unpacked_ms, 3),
+        "packed_walk_speedup": round(unpacked_ms / packed_ms, 3),
+    }
+
+
 def main() -> None:
     result = run_bench(
         n_requests=int(os.environ.get("BENCH_SERVE_REQS", 400)),
@@ -110,6 +256,13 @@ def main() -> None:
         train_rows=int(os.environ.get("BENCH_SERVE_ROWS", 20_000)),
         n_cols=int(os.environ.get("BENCH_SERVE_COLS", 16)),
         max_batch=int(os.environ.get("BENCH_SERVE_MAX_BATCH", 512)))
+    result.update(run_fleet_bench(
+        n_replicas=int(os.environ.get("BENCH_FLEET_REPLICAS", 4)),
+        n_requests=int(os.environ.get("BENCH_FLEET_REQS", 6000)),
+        target_qps=float(os.environ.get("BENCH_FLEET_QPS", 12_000))))
+    result.update(run_shap_bench(
+        n_requests=int(os.environ.get("BENCH_SHAP_REQS", 60))))
+    result.update(run_packed_speedup())
     print(json.dumps(result))
 
 

@@ -36,6 +36,10 @@ JAX_PLATFORMS=cpu python tools/validate_mega.py --smoke --scale 0.1 --seeds 1 ||
 echo "== validate_obs (traced-vs-untraced byte equality + exposition lint) =="
 JAX_PLATFORMS=cpu python tools/validate_obs.py || exit $?
 
+echo "== validate_fleet (kill-one-replica, atomic fan-out, ring churn) =="
+JAX_PLATFORMS=cpu VALIDATE_FLEET_REQS="${VALIDATE_FLEET_REQS:-60}" \
+    python tools/validate_fleet.py || exit $?
+
 echo "== perf_report smoke (--json path + budget gate wiring) =="
 # tiny shape: this checks the CI-wirable surface (json output parses,
 # budget comparison runs), not the drift numbers — CPU drift vs v5e

@@ -1,0 +1,252 @@
+"""Fleet resilience gate: the three invariants the router must hold.
+
+PR 15's FleetRouter earns its place in the serving stack only if the
+failure modes it claims to absorb are actually absorbed.  This gate
+drives a live in-process fleet through each of them:
+
+1. **Kill-one-replica, zero lost futures** — a steady submit stream is
+   in flight while one replica is drained out of the fleet; every
+   future issued BEFORE the kill must resolve (the MicroBatcher drain
+   contract) and every submit AFTER it must land on a surviving
+   replica.  Results stay bitwise equal to ``Booster.predict()``
+   throughout.
+2. **Atomic fan-out promotion** — ``swap_model`` across the placement
+   set is two-phase (prepare+warm everywhere, then publish under the
+   router lock): mid-stream, ``served_versions`` may only ever be
+   {v1} or {v2} — a mixed {v1, v2} snapshot means a request could see
+   different models depending on routing.  Predictions before the
+   swap match booster v1, after it match booster v2, and the fleet
+   reports ZERO recompiles after the warm fan-out.
+3. **Bounded placement churn** — the consistent-hash ring must move
+   at most ~(keys/N) placements when a node joins or leaves; a
+   modulo-style rehash (which moves ~all keys) fails this check.  Also
+   pins determinism: two rings built from the same membership place
+   every key identically.
+
+Run from the repo root: ``python tools/validate_fleet.py``
+(exit 0 = all invariants hold; any failure prints the offending check
+and exits 1).  VALIDATE_FLEET_REQS scales the mid-stream load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+
+CHECKS = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> bool:
+    CHECKS.append({"check": name, "ok": bool(ok), "detail": detail})
+    print(f"  [{'PASS' if ok else 'FAIL'}] {name}"
+          + (f" — {detail}" if detail else ""), flush=True)
+    return ok
+
+
+def _train(seed: int, rounds: int = 12, n: int = 3000, f: int = 10):
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X @ rng.randn(f) > 0).astype(np.float32)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 5,
+                     "eta": 0.3, "seed": seed},
+                    xgb.DMatrix(X, label=y), rounds, verbose_eval=False)
+    return bst, rng
+
+
+def run_kill_one_replica(n_requests: int) -> None:
+    """Invariant 1: drain a replica while a stream is in flight."""
+    from xgboost_tpu.serve import FleetConfig, FleetRouter
+
+    import xgboost_tpu as xgb
+
+    print("\n== kill-one-replica mid-stream ==")
+    bst, rng = _train(0)
+    X = rng.randn(64, 10).astype(np.float32)
+    host = bst.predict(xgb.DMatrix(X))
+
+    fleet = FleetRouter(
+        models={"m": bst},
+        config=FleetConfig(replicas=3, min_replicas=1, max_replicas=4,
+                           replication=3))
+    fleet.warmup()
+    names = fleet.replica_names()
+    victim = fleet.placement("m")[0]
+
+    futures, errs = [], []
+    kill_at = n_requests // 3
+    killed = threading.Event()
+
+    def killer() -> None:
+        fleet.remove_replica(victim, drain=True)
+        killed.set()
+
+    kt = None
+    for i in range(n_requests):
+        if i == kill_at:
+            kt = threading.Thread(target=killer)
+            kt.start()
+        try:
+            futures.append((i, fleet.submit(X, "m")))
+        except Exception as e:  # any shed/routing error is a failure here
+            errs.append((i, repr(e)))
+        if i % 16 == 0:
+            time.sleep(0.001)
+    kt.join()
+
+    lost, wrong = 0, 0
+    for i, f in futures:
+        try:
+            r = f.result(timeout=60)  # _ServedResult ndarray
+            if not np.array_equal(np.asarray(r).ravel(), host):
+                wrong += 1
+        except Exception:
+            lost += 1
+    check("zero lost futures across the kill",
+          lost == 0 and not errs,
+          f"{len(futures)} issued, {lost} lost, {len(errs)} submit errors")
+    check("results bitwise equal to Booster.predict throughout",
+          wrong == 0, f"{wrong} mismatched responses")
+    check("victim actually left the fleet",
+          killed.is_set() and victim not in fleet.replica_names(),
+          f"replicas {names} -> {fleet.replica_names()}")
+    snap = fleet.health_snapshot()
+    check("surviving fleet healthy and still serving",
+          snap["status"] == "ok"
+          and any(m["name"] == "m" for m in snap["models"])
+          and np.asarray(fleet.predict(X, "m")).shape == host.shape,
+          f"status={snap['status']}")
+    fleet.close(drain=True)
+
+
+def run_atomic_promotion(n_requests: int) -> None:
+    """Invariant 2: fan-out swap is two-phase — never a mixed fleet."""
+    from xgboost_tpu.serve import FleetConfig, FleetRouter
+
+    print("\n== atomic fan-out promotion ==")
+    import xgboost_tpu as xgb
+
+    bst1, rng = _train(1)
+    bst2, _ = _train(2)
+    X = rng.randn(32, 10).astype(np.float32)
+    m1 = bst1.predict(xgb.DMatrix(X), output_margin=True)
+    m2 = bst2.predict(xgb.DMatrix(X), output_margin=True)
+
+    fleet = FleetRouter(
+        models={"m": bst1},
+        config=FleetConfig(replicas=3, min_replicas=1, max_replicas=4,
+                           replication=3))
+    fleet.warmup()
+    v1 = fleet.served_versions("m")
+
+    mixed_seen = []
+    stop = threading.Event()
+
+    def watcher() -> None:
+        while not stop.is_set():
+            vs = fleet.served_versions("m")
+            if len(vs) > 1:
+                mixed_seen.append(set(vs))
+            time.sleep(0.0002)
+
+    wt = threading.Thread(target=watcher, daemon=True)
+    wt.start()
+    try:
+        pre = [np.asarray(fleet.predict(X, "m", output="margin")).ravel()
+               for _ in range(n_requests // 4)]
+        fleet.swap_model("m", bst2, warm=True)
+        post = [np.asarray(fleet.predict(X, "m", output="margin")).ravel()
+                for _ in range(n_requests // 4)]
+    finally:
+        stop.set()
+        wt.join()
+    v2 = fleet.served_versions("m")
+
+    check("served_versions never mixed mid-swap",
+          not mixed_seen, f"mixed snapshots: {mixed_seen[:3]}")
+    check("single version fleet-wide before and after",
+          len(v1) == 1 and len(v2) == 1 and v1 != v2,
+          f"{sorted(v1)} -> {sorted(v2)}")
+    check("pre-swap margins bitwise == booster v1",
+          all(np.array_equal(p, m1.ravel()) for p in pre))
+    check("post-swap margins bitwise == booster v2",
+          all(np.array_equal(p, m2.ravel()) for p in post))
+    check("zero recompiles after warm fan-out",
+          fleet.recompiles_after_warmup == 0,
+          f"recompiles={fleet.recompiles_after_warmup}")
+    rb = fleet.rollback_model("m")
+    rbm = np.asarray(fleet.predict(X, "m", output="margin")).ravel()
+    check("fleet-wide rollback restores v1 outputs",
+          rb.version in v1 and np.array_equal(rbm, m1.ravel()))
+    fleet.close(drain=True)
+
+
+def run_placement_stability() -> None:
+    """Invariant 3: consistent hashing moves ~K/N keys, not ~K."""
+    from xgboost_tpu.serve.fleet import _HashRing
+
+    print("\n== consistent-hash placement stability ==")
+    keys = [f"model-{i}" for i in range(400)]
+    nodes = [f"r{i}" for i in range(5)]
+    ring = _HashRing(nodes)
+    before = {k: ring.place(k, 2) for k in keys}
+
+    ring.add("r5")
+    after_add = {k: ring.place(k, 2) for k in keys}
+    moved_add = sum(before[k] != after_add[k] for k in keys)
+    # a k=2 placement changes when the new node claims either slot:
+    # expected ~k/6 of keys (~33%); a modulo rehash moves ~83%.  Half
+    # the keyspace cleanly separates the two.
+    bound = len(keys) // 2
+    check("node join moves a bounded key fraction",
+          0 < moved_add <= bound,
+          f"{moved_add}/{len(keys)} moved (bound {bound})")
+    check("every moved key gained the new node",
+          all("r5" in after_add[k] for k in keys
+              if before[k] != after_add[k]))
+
+    ring.remove("r5")
+    after_rm = {k: ring.place(k, 2) for k in keys}
+    check("join + leave is a round trip",
+          after_rm == before,
+          f"{sum(before[k] != after_rm[k] for k in keys)} keys differ")
+
+    ring2 = _HashRing(list(reversed(nodes)))
+    check("placement deterministic across ring builds",
+          all(ring2.place(k, 2) == before[k] for k in keys))
+
+    spread = {}
+    for k in keys:
+        spread[before[k][0]] = spread.get(before[k][0], 0) + 1
+    lo, hi = min(spread.values()), max(spread.values())
+    check("primary placements spread across nodes",
+          len(spread) == 5 and hi <= 4 * max(lo, 1),
+          f"per-node primaries {sorted(spread.values())}")
+
+
+def main() -> None:
+    n = int(os.environ.get("VALIDATE_FLEET_REQS", "120"))
+    run_kill_one_replica(n)
+    run_atomic_promotion(n)
+    run_placement_stability()
+    ok = all(c["ok"] for c in CHECKS)
+    print(f"\n{'PASS' if ok else 'FAIL'}: "
+          f"{sum(c['ok'] for c in CHECKS)}/{len(CHECKS)} fleet checks")
+    print(json.dumps({"checks": CHECKS, "ok": ok}))
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

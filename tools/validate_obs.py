@@ -398,8 +398,20 @@ def run_exposition_lint() -> List[str]:
     m.observe("e2e", 0.012)
     m.observe("compute", 0.004)
     m.hit_bucket(16, padded_rows=3)
+    # fleet mode: replica-labeled serve families (every sample of a
+    # labeled ServeMetrics carries its replica tag, including stage
+    # histograms and bucket hits) must lint and stay distinguishable
+    mr = ServeMetrics(labels=(("replica", "lint0"),))
+    mr.inc("requests", 2)
+    mr.observe("shap", 0.003)
+    mr.hit_bucket(8, padded_rows=1)
     rc = ResilientCommunicator(NoOpCommunicator())
     rc.stats["retry"] = 2
+    # fleet router collector: aggregate + per-replica families
+    from xgboost_tpu.serve.fleet import FleetConfig, FleetRouter
+
+    fleet = FleetRouter(config=FleetConfig(replicas=2, min_replicas=1,
+                                           max_replicas=2, replication=1))
     reg = get_registry()
     reg.inc("xtpu_validate_obs_runs_total", help="gate executions")
     text = reg.render_prometheus()
@@ -407,13 +419,23 @@ def run_exposition_lint() -> List[str]:
     for needle in ("xtpu_serve_requests_total 5",
                    'xtpu_collective_events_total{kind="retry"} 2',
                    "xtpu_serve_stage_latency_seconds_bucket",
+                   # fleet families + replica labels
+                   'xtpu_serve_requests_total{replica="lint0"} 2',
+                   'stage="shap"',
+                   'xtpu_serve_bucket_hits_total{replica="lint0",'
+                   'bucket="8"} 1',
+                   "xtpu_fleet_replicas 2",
+                   'xtpu_fleet_replica_up{replica="r0"} 1',
+                   'xtpu_fleet_replica_up{replica="r1"} 1',
+                   "xtpu_fleet_routed_total",
                    # left behind by run_insight_cells: armed runs stream
                    # telemetry + eval gauges through the same registry
                    "xtpu_insight_round",
                    'xtpu_eval_score{data="val",metric="logloss"}'):
         if needle not in text:
             problems.append(f"expected exposition line missing: {needle}")
-    del m, rc
+    fleet.close(drain=False)
+    del m, mr, rc, fleet
     return problems
 
 

@@ -88,6 +88,10 @@ CONTRACTS: Tuple[ProgramContract, ...] = (
     ProgramContract("mesh.col", dispatch_budget=1, mesh_axes=("data",)),
     # serve walk: one program per batch, no collectives
     ProgramContract("serve.walk", dispatch_budget=1),
+    # packed-forest twins (PR 15): the whole forest in ONE walk
+    # program, and the device TreeSHAP scan behind /contribs
+    ProgramContract("serve.walk_packed", dispatch_budget=1),
+    ProgramContract("serve.shap", dispatch_budget=1),
     # scan-histogram accumulator policy (XTPU_SCAN_ACC): bf16 may reach
     # accumulate primitives ONLY in the RMS-gated bf16 kernel
     ProgramContract("ops.hist_scan", dispatch_budget=1),

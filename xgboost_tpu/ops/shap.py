@@ -1,0 +1,322 @@
+"""On-device TreeSHAP over the packed forest (serve ``/contribs``).
+
+``boosting/shap.py`` walks every root→leaf branch recursively per row —
+exact, but host-bound and O(rows · nodes · depth²) python. This module
+is the batched per-leaf reformulation used by gputreeshap (the paper's
+layer-4 dependency): for each (tree, leaf) the root→leaf path is
+flattened AHEAD OF TIME into K consolidated unique-feature slots —
+duplicate occurrences of a feature multiply into one (zero, one)
+fraction pair, exactly what the reference's unwind-then-re-extend
+performs — and the only row-dependent quantity left is the ONE
+fraction: a 0/1 product of "did this row follow the path edge"
+indicators. Covers are model constants, so every zero fraction
+precomputes on the host (:func:`build_shap_pack`); the device kernel
+(:func:`shap_packed`) then runs Lundberg's extend/unwind recurrences as
+dense f32 tensor ops over [rows, trees, leaves, slots] and scatter-adds
+into φ — one jitted program per batch shape (``serve.shap`` contract).
+
+Two identities make the static shapes safe (numerically validated
+against the reference ``_extend``/``_unwound_sum``):
+
+- permutation invariance: the path polynomial is symmetric in its
+  features, so slot order is free;
+- null-feature padding: extending with (zero=1, one=1) leaves every
+  other feature's unwound sum unchanged, so short paths pad to K and
+  their phantom slots contribute ``usum · (1 − 1) = 0``.
+
+φ matches host ``pred_contribs`` to f32 tolerance (rtol 1e-5) and each
+row sums to prediction − base score (efficiency property), pinned by
+tests/test_shap_device.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..serve.packed import PackedForest
+
+
+class ShapPack:
+    """Host-side per-leaf path tables for one packed forest.
+
+    Axes: T real trees, L = max leaves/tree, D = max path length
+    (occurrences), K = max unique features on any path. Everything a
+    row does NOT change is baked here; the kernel only evaluates path
+    indicators and the recurrences.
+    """
+
+    def __init__(self, arrays: Dict[str, np.ndarray], n_features: int,
+                 n_groups: int, bias_means: np.ndarray,
+                 has_cat: bool) -> None:
+        self.arrays = arrays
+        self.n_features = int(n_features)
+        self.n_groups = int(n_groups)
+        self.bias_means = np.asarray(bias_means, np.float32)  # [G]
+        self.has_cat = bool(has_cat)
+        self._dev = None
+
+    def device_arrays(self):
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            self._dev = {k: jnp.asarray(v) for k, v in self.arrays.items()}
+        return self._dev
+
+    @property
+    def nbytes(self) -> int:
+        return sum(v.nbytes for v in self.arrays.values())
+
+
+def _tree_means(d: Dict[str, np.ndarray]) -> float:
+    """Cover-weighted mean leaf value (reference ``mean_value``) —
+    iterative reverse-id pass; packing renumbered children after
+    parents, so a reverse sweep sees children first."""
+    n = len(d["is_leaf"])
+    mean = np.zeros(n, np.float64)
+    sh = d["sum_hess"].astype(np.float64)
+    for nid in range(n - 1, -1, -1):
+        if d["is_leaf"][nid]:
+            mean[nid] = float(d["leaf_value"][nid])
+        else:
+            li, ri = int(d["left_child"][nid]), int(d["right_child"][nid])
+            h = sh[li] + sh[ri]
+            mean[nid] = ((sh[li] * mean[li] + sh[ri] * mean[ri]) / h
+                         if h > 0 else 0.0)
+    return float(mean[0])
+
+
+def build_shap_pack(pf: PackedForest, n_features: int) -> ShapPack:
+    """Flatten every (tree, leaf) path of a packed forest into the
+    static slot/occurrence tables the device kernel consumes."""
+    trees = pf.unpack()
+    T = pf.n_trees
+    G = pf.group_onehot.shape[1]
+    W = pf.cat_words.shape[1]
+
+    # pass 1: enumerate leaf paths, find static L / D / K
+    all_paths: List[List] = []          # per tree: [(leaf_nid, occs)]
+    for d in trees:
+        paths = []
+        stack = [(0, [])]               # (nid, occurrences root→nid)
+        while stack:
+            nid, occs = stack.pop()
+            if d["is_leaf"][nid]:
+                paths.append((nid, occs))
+                continue
+            li, ri = int(d["left_child"][nid]), int(d["right_child"][nid])
+            cover = float(d["sum_hess"][nid])
+            for child, hot_left in ((li, True), (ri, False)):
+                z = (float(d["sum_hess"][child]) / cover
+                     if cover > 0 else 0.0)
+                stack.append((child, occs + [(
+                    int(d["split_feature"][nid]),
+                    float(d["split_value"][nid]),
+                    bool(d["default_left"][nid]),
+                    bool(d["is_cat_split"][nid]),
+                    d["cat_words"][nid], hot_left, z)]))
+        all_paths.append(paths)
+
+    L = max(len(p) for p in all_paths)
+    D = max((len(o) for p in all_paths for _, o in p), default=1) or 1
+    K = 1
+    for p in all_paths:
+        for _, occs in p:
+            K = max(K, len({f for f, *_ in occs}))
+
+    z8 = np.zeros
+    occ_feat = z8((T, L, D), np.int32)
+    occ_sv = z8((T, L, D), np.float32)
+    occ_dl = z8((T, L, D), bool)
+    occ_cat = z8((T, L, D), bool)
+    occ_hot_left = z8((T, L, D), bool)
+    occ_slot = z8((T, L, D), np.int32)
+    occ_valid = z8((T, L, D), bool)
+    occ_cw = z8((T, L, D, W), np.uint32)
+    slot_z = np.ones((T, L, K), np.float32)     # null slots: zero = 1
+    slot_feat = z8((T, L, K), np.int32)
+    slot_valid = z8((T, L, K), bool)
+    leaf_value = z8((T, L), np.float32)
+    leaf_valid = z8((T, L), bool)
+
+    for t, paths in enumerate(all_paths):
+        for li, (leaf_nid, occs) in enumerate(paths):
+            leaf_value[t, li] = trees[t]["leaf_value"][leaf_nid]
+            leaf_valid[t, li] = True
+            slots: Dict[int, int] = {}
+            for oi, (f, sv, dl, cat, cw, hot_left, z) in enumerate(occs):
+                k = slots.setdefault(f, len(slots))
+                slot_z[t, li, k] *= np.float32(z)
+                slot_feat[t, li, k] = f
+                slot_valid[t, li, k] = True
+                occ_feat[t, li, oi] = f
+                occ_sv[t, li, oi] = sv
+                occ_dl[t, li, oi] = dl
+                occ_cat[t, li, oi] = cat
+                occ_cw[t, li, oi] = cw
+                occ_hot_left[t, li, oi] = hot_left
+                occ_slot[t, li, oi] = k
+                occ_valid[t, li, oi] = True
+
+    tw = pf.tree_weight[:T].astype(np.float64)
+    means = np.asarray([_tree_means(d) for d in trees], np.float64)
+    bias_means = np.zeros(G, np.float64)
+    np.add.at(bias_means, pf.tree_info[:T], means * tw)
+
+    arrays = dict(
+        occ_feat=occ_feat, occ_sv=occ_sv, occ_dl=occ_dl,
+        occ_hot_left=occ_hot_left, occ_slot=occ_slot, occ_valid=occ_valid,
+        slot_z=slot_z, slot_feat=slot_feat, slot_valid=slot_valid,
+        leaf_value=leaf_value, leaf_valid=leaf_valid,
+        tree_group=pf.tree_info[:T].astype(np.int32),
+        tree_weight=pf.tree_weight[:T].astype(np.float32))
+    if pf.has_cat:
+        arrays["occ_cat"] = occ_cat
+        arrays["occ_cw"] = occ_cw
+    return ShapPack(arrays, n_features, G, bias_means, pf.has_cat)
+
+
+def _follows(X, occ_feat, occ_sv, occ_dl, occ_hot_left, occ_valid,
+             occ_cat, occ_cw):
+    """[n, C, L, D] — does each row follow each path edge? Mirrors the
+    reference ``goes_left`` (NaN → default, categorical by left-set
+    bitmask with out-of-range codes going the default way, else
+    ``not (x > split)``)."""
+    import jax.numpy as jnp
+
+    x = X[:, occ_feat]                           # [n,C,L,D]
+    miss = jnp.isnan(x)
+    goes_left = jnp.where(miss, occ_dl[None], ~(x > occ_sv[None]))
+    if occ_cat is not None:
+        W = occ_cw.shape[-1]
+        code = jnp.where(miss, -1, x).astype(jnp.int32)
+        in_range = (code >= 0) & (code < W * 32)
+        widx = jnp.clip(code // 32, 0, W - 1)
+        word = jnp.take_along_axis(
+            jnp.broadcast_to(occ_cw[None], (x.shape[0],) + occ_cw.shape),
+            widx[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        bit = (word >> (code % 32).astype(jnp.uint32)) & jnp.uint32(1)
+        cat_left = jnp.where(in_range, bit == 1, occ_dl[None])
+        goes_left = jnp.where(occ_cat[None], cat_left, goes_left)
+    return (goes_left == occ_hot_left[None]) | ~occ_valid[None]
+
+
+def _leaf_phi(X, ch, n_groups: int, n_features: int):
+    """φ contributions of one tree chunk: [n, G·(F+1)+1] flat (last
+    column is the spill bin for invalid slots)."""
+    import jax.numpy as jnp
+
+    n = X.shape[0]
+    C, L, K = ch["slot_z"].shape
+    follow = _follows(X, ch["occ_feat"], ch["occ_sv"], ch["occ_dl"],
+                      ch["occ_hot_left"], ch["occ_valid"],
+                      ch.get("occ_cat"), ch.get("occ_cw"))
+    # per-slot ONE fraction: 1 iff the row follows EVERY occurrence
+    oh = ((ch["occ_slot"][..., None] == jnp.arange(K)[None, None, None])
+          & ch["occ_valid"][..., None]).astype(jnp.float32)  # [C,L,D,K]
+    bad = (~follow).astype(jnp.float32) * ch["occ_valid"][None].astype(
+        jnp.float32)                                          # [n,C,L,D]
+    badcount = jnp.einsum("ncld,cldk->nclk", bad, oh)
+    o = (badcount == 0).astype(jnp.float32)                   # [n,C,L,K]
+    z = jnp.broadcast_to(ch["slot_z"][None], o.shape)         # [n,C,L,K]
+
+    # extend: path polynomial weights pw[0..K] (root then K slots);
+    # null slots extend with (1, 1) — the padding-invariance identity
+    pw = jnp.zeros((n, C, L, K + 1), jnp.float32).at[..., 0].set(1.0)
+    kidx = jnp.arange(K + 1, dtype=jnp.float32)
+    for j in range(K):
+        d = j + 1
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(pw[..., :1]), pw[..., :-1]], axis=-1)
+        pw = (z[..., j:j + 1] * pw * (d - kidx) / (d + 1)
+              + o[..., j:j + 1] * shifted * kidx / (d + 1))
+
+    # unwound sum per slot (reference _unwound_sum, d = K), both
+    # branches on safe denominators then selected by o
+    o_safe = jnp.where(o == 0, 1.0, o)
+    z_safe = jnp.where(z == 0, 1.0, z)
+    nxt = jnp.broadcast_to(pw[..., K:K + 1], o.shape)
+    tot_hot = jnp.zeros_like(o)
+    tot_cold = jnp.zeros_like(o)
+    for i in range(K - 1, -1, -1):
+        t = nxt / ((i + 1) * o_safe)
+        tot_hot = tot_hot + t
+        nxt = pw[..., i:i + 1] - t * z * (K - i)
+        tot_cold = tot_cold + pw[..., i:i + 1] / (z_safe * (K - i))
+    usum = jnp.where(o != 0, tot_hot, tot_cold) * (K + 1)
+
+    valid = (ch["slot_valid"][None] & ch["leaf_valid"][None, ..., None])
+    contrib = jnp.where(
+        valid,
+        usum * (o - z) * ch["leaf_value"][None, ..., None]
+        * ch["tree_weight"][None, :, None, None], 0.0)
+    # scatter into [G·(F+1)] (+1 spill); group/feature are constants
+    idx = jnp.where(
+        ch["slot_valid"] & ch["leaf_valid"][..., None],
+        ch["tree_group"][:, None, None] * (n_features + 1)
+        + ch["slot_feat"], n_groups * (n_features + 1))
+    phi = jnp.zeros((n, n_groups * (n_features + 1) + 1), jnp.float32)
+    return phi.at[:, idx.reshape(-1)].add(contrib.reshape(n, -1))
+
+
+def shap_packed_fn(tree_chunk: int, n_groups: int, n_features: int):
+    """Build the jitted φ kernel for one (chunk, G, F) geometry. The
+    returned callable is cached per geometry by :func:`shap_packed`."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(X, bias, **arrays):
+        T = arrays["tree_weight"].shape[0]
+        C = min(tree_chunk, T)
+        NC = -(-T // C)
+        pad = NC * C - T
+
+        def prep(v):
+            if pad:
+                v = jnp.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1))
+            return v.reshape((NC, C) + v.shape[1:])
+
+        xs = {k: prep(v) for k, v in arrays.items()}
+
+        def step(phi, ch):
+            return phi + _leaf_phi(X, ch, n_groups, n_features), None
+
+        phi0 = jnp.zeros(
+            (X.shape[0], n_groups * (n_features + 1) + 1), jnp.float32)
+        phi, _ = jax.lax.scan(step, phi0, xs)
+        phi = phi[:, :-1].reshape(X.shape[0], n_groups, n_features + 1)
+        return phi.at[:, :, n_features].add(bias[None, :])
+
+    return jax.jit(fn)
+
+
+_KERNELS: Dict[tuple, object] = {}
+
+# chunk of trees per scan step: bounds the [n, C, L, D] indicator
+# tensors the same way TREE_CHUNK bounds the walk
+SHAP_TREE_CHUNK = 16
+
+
+def shap_packed(pack: ShapPack, X, base: np.ndarray,
+                tree_chunk: Optional[int] = None):
+    """φ [n, G, F+1] for a device batch; bias column = cover-weighted
+    forest mean + base score (so each row sums to its margin)."""
+    import jax.numpy as jnp
+
+    tc = tree_chunk or int(os.environ.get("XTPU_SHAP_TREE_CHUNK", 0)) \
+        or SHAP_TREE_CHUNK
+    key = (tc, pack.n_groups, pack.n_features)
+    if key not in _KERNELS:
+        _KERNELS[key] = shap_packed_fn(tc, pack.n_groups, pack.n_features)
+    bias = jnp.asarray(pack.bias_means
+                       + np.asarray(base, np.float32), jnp.float32)
+    return _KERNELS[key](jnp.asarray(X, jnp.float32), bias,
+                         **pack.device_arrays())
+
+
+def _shap_cache_size() -> int:
+    """RecompileCounter hook: total compiled-program count across the
+    per-geometry kernel cache."""
+    return sum(int(k._cache_size()) for k in _KERNELS.values())

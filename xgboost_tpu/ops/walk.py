@@ -1,0 +1,113 @@
+"""The packed-forest walk: ONE jitted program per batch shape.
+
+``boosting/predict._predict_margin`` walks six parallel ``[T, M]``
+arrays and dispatches once per 64-tree chunk; this kernel walks the
+``serve/packed.py`` layout — one uint32 word plus one f32 value per
+node, all trees flat — and folds the whole forest, every tree chunk's
+leaf matmul included, into a single compiled program (the batched-walk
+formulation of arxiv 1706.08359: positions advance level-synchronously,
+so the program is gather/memory-bound with zero divergence).
+
+Bit-identity with ``Booster.predict()`` is a hard contract
+(tests/test_packed.py): the routing comparisons are exact, and the leaf
+reduction replays ``ForestPredictor._walk_chunked`` shape-for-shape —
+per-chunk ``leaf * tree_weight`` then
+``dot(., group_onehot[chunk], precision=HIGHEST) + 0`` with a left-fold
+sum across chunks. ``Booster.predict`` runs that fold with a ZEROS base
+and adds the real base on the host afterwards; fusing the base into
+chunk 0 instead (the old ``ServedModel`` association) drifts 1 ulp on
+nonzero-base multi-chunk forests, so this kernel adds ``base`` strictly
+AFTER the fold. Identical operand shapes + identical summation order ⇒
+identical floats.
+
+``serve.walk_packed`` (serve/programs.py) pins this program's dispatch
+budget at 1 via xtpuverify.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..serve.packed import CAT_BIT, DL_BIT, LEAF_BIT, _field_layout
+
+
+def _unpack_word(w: jnp.ndarray, lay):
+    """Split a gathered word batch into its fields (all same shape)."""
+    leaf = (w >> jnp.uint32(LEAF_BIT)) & jnp.uint32(1) == 1
+    cat = (w >> jnp.uint32(CAT_BIT)) & jnp.uint32(1) == 1
+    dl = (w >> jnp.uint32(DL_BIT)) & jnp.uint32(1) == 1
+    feat = ((w >> lay["feat_shift"]) & lay["feat_mask"]).astype(jnp.int32)
+    delta = (w & lay["off_mask"]).astype(jnp.int32)
+    return leaf, cat, dl, feat, delta
+
+
+def _cat_is_left(code: jnp.ndarray, cat_words: jnp.ndarray,
+                 idx: jnp.ndarray, n_words: int) -> jnp.ndarray:
+    """Membership of category ``code`` in the node's packed left set —
+    the flat-index twin of ``predict._bit_is_left``."""
+    widx = jnp.clip(code // 32, 0, n_words - 1)
+    words = cat_words[idx]                             # [n,Tp,W]
+    word = jnp.take_along_axis(words, widx[..., None].astype(jnp.int32),
+                               axis=2)[..., 0]
+    bit = (word >> (code % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return bit == 1
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "tree_chunk"))
+def walk_packed(words: jnp.ndarray, values: jnp.ndarray,
+                tree_offsets: jnp.ndarray, tree_weight: jnp.ndarray,
+                group_onehot: jnp.ndarray, X: jnp.ndarray,
+                base: jnp.ndarray,
+                cat_words: Optional[jnp.ndarray] = None, *,
+                max_depth: int, tree_chunk: int) -> jnp.ndarray:
+    """-> margin [n, G]; bit-identical to the unpacked chunked walk.
+
+    ``idx`` holds every (row, tree) pair's FLAT node index; a step is
+    two flat gathers (word + value) against the walk arrays instead of
+    six ``[T, M]`` gathers. Children are adjacent by packing, so the
+    branch is ``idx + delta + go_right`` with no right-child plane.
+    """
+    n = X.shape[0]
+    Tp = tree_offsets.shape[0]
+    lay = _field_layout()
+    idx = jnp.zeros((n, Tp), jnp.int32) + tree_offsets[None, :]
+    if cat_words is not None:
+        n_words = cat_words.shape[-1]
+        n_cats = n_words * 32
+
+    for _ in range(max_depth):
+        w = words[idx]
+        leaf, cat_node, dl, feat, delta = _unpack_word(w, lay)
+        x = jnp.take_along_axis(X, feat, axis=1)
+        go_right = x > values[idx]
+        missing = jnp.isnan(x)
+        if cat_words is not None:
+            code = jnp.where(missing, -1, x).astype(jnp.int32)
+            in_range = (code >= 0) & (code < n_cats)
+            left = _cat_is_left(jnp.maximum(code, 0), cat_words, idx,
+                                n_words)
+            go_right = jnp.where(cat_node, ~left, go_right)
+            missing = missing | (cat_node & ~in_range)
+        go_right = jnp.where(missing, ~dl, go_right)
+        nxt = idx + delta + go_right.astype(jnp.int32)
+        idx = jnp.where(leaf, idx, nxt)
+
+    leaf_v = values[idx] * tree_weight[None, :]        # [n, Tp]
+    zero = jnp.zeros_like(base)
+    m_total = None
+    for lo in range(0, Tp, tree_chunk):
+        hi = min(lo + tree_chunk, Tp)
+        m = jnp.dot(leaf_v[:, lo:hi], group_onehot[lo:hi],
+                    precision=jax.lax.Precision.HIGHEST) + zero[None, :]
+        # materialize each chunk's partial: left alone, XLA fuses the
+        # chunk dots into one reduction loop whose accumulation order
+        # differs from the reference per-chunk programs by 1 ulp —
+        # the barrier is what makes "identical shapes + identical
+        # summation order" actually hold through compilation
+        m = jax.lax.optimization_barrier(m)
+        m_total = m if m_total is None else m_total + m
+    return m_total + base[None, :]

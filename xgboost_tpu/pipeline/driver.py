@@ -445,10 +445,19 @@ class Pipeline:
         name = self.config.model_name
         from ..serve.registry import ModelLoadError, UnknownModel
 
-        try:
-            served_version = self.server.registry.get(name).version
-        except UnknownModel:
-            served_version = None
+        if hasattr(self.server, "served_versions"):
+            # fleet target: reconcile against the SET of versions live
+            # across replicas — a mixed set (interrupted fan-out) must
+            # re-fan even if some replica already serves the active
+            # version, so the whole fleet converges
+            versions = self.server.served_versions(name)
+            served_version = (versions.pop() if len(versions) == 1
+                              else None if not versions else -1)
+        else:
+            try:
+                served_version = self.server.registry.get(name).version
+            except UnknownModel:
+                served_version = None
         if served_version == active["version"]:
             return
         try:

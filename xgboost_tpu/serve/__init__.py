@@ -13,6 +13,14 @@ metrics. See docs/serving.md for the architecture and tuning guide.
         srv.warmup()
         preds = srv.predict(X)          # == booster.predict(DMatrix(X))
 
+PR 15 (xtpufleet) adds the packed-forest fast path
+(:class:`PackedForest` + ``ops/walk.py`` — one walk program for the
+whole forest, bit-identical to ``Booster.predict``), on-device TreeSHAP
+serving (``Server.contribs`` / ``POST /v1/model/<name>/contribs``), and
+fleet mode (:class:`FleetRouter` — N shared-nothing replicas behind
+consistent-hash placement with autoscaling and fleet-wide zero-downtime
+promotion; CLI: ``python -m xgboost_tpu serve --fleet N``).
+
 Frontends: ``python -m xgboost_tpu serve model=... [http_port=...]``
 (``serve.frontend``) and the in-process :class:`ServeClient`.
 """
@@ -21,12 +29,16 @@ from .buckets import BucketLadder, RecompileCounter
 from .client import ServeClient
 from .errors import (DeadlineExceeded, ModelLoadError, ServeError,
                      ServerClosed, ServerOverloaded, UnknownModel)
+from .fleet import FleetConfig, FleetRouter
 from .metrics import LatencyHistogram, ServeMetrics
+from .packed import PackedForest, PackError
 from .registry import ModelRegistry, ServedModel
 from .server import ServeConfig, Server
 
 __all__ = [
     "Server", "ServeConfig", "ServeClient",
+    "FleetRouter", "FleetConfig",
+    "PackedForest", "PackError",
     "BucketLadder", "RecompileCounter",
     "ModelRegistry", "ServedModel",
     "ServeMetrics", "LatencyHistogram",

@@ -103,10 +103,19 @@ class RecompileCounter:
 
     @classmethod
     def for_forest_predictor(cls) -> "RecompileCounter":
-        """Counter over the stock ForestPredictor walk programs."""
-        from ..boosting import predict as _p
+        """Counter over every serving walk program: the stock
+        ForestPredictor twins, the packed-forest walk, and the device
+        TreeSHAP kernel cache (all four feed the serve hot paths)."""
+        import types
 
-        return cls([_p._predict_margin, _p._predict_margin_binned])
+        from ..boosting import predict as _p
+        from ..ops import shap as _shap
+        from ..ops import walk as _walk
+
+        shap_cache = types.SimpleNamespace(
+            _cache_size=_shap._shap_cache_size)
+        return cls([_p._predict_margin, _p._predict_margin_binned,
+                    _walk.walk_packed, shap_cache])
 
     def register(self, fn) -> None:
         if not hasattr(fn, "_cache_size"):

@@ -7,8 +7,13 @@ Config mirrors the CLI's key=value convention (``cli.py``):
 
 Keys: ``model`` / ``model[NAME]`` (repeatable — multi-model registry),
 ``max_batch``, ``max_delay_ms``, ``max_queue_rows``, ``timeout_ms``,
-``buckets`` (comma list, e.g. ``1,8,64,512``), ``output``
-(value|margin), ``log_every_s``, ``http_port``, ``silent``.
+``buckets`` (comma list, e.g. ``1,8,64,512``), ``shap_max_batch``,
+``shap_buckets``, ``output`` (value|margin), ``log_every_s``,
+``http_port``, ``silent``, ``warm_contribs`` (pre-compile the TreeSHAP
+ladder), and ``fleet`` — also spellable as ``--fleet N`` — which runs
+N in-process replicas behind the consistent-hash
+:class:`~.fleet.FleetRouter` instead of a single Server
+(docs/serving.md "Fleet mode").
 
 Without ``http_port`` the process scores a **jsonl loop**: one request
 object per stdin line —
@@ -27,6 +32,10 @@ across clients needs the HTTP frontend, whose handler threads share
 the micro-batcher:
 
     POST /v1/predict   {"data": ..., "model":?, "output":?}
+    POST /v1/model/<name>/contribs
+                       {"data": ...} -> per-feature SHAP attributions
+                       from the on-device TreeSHAP kernel (last column
+                       is the bias; rows sum to the margin)
     GET  /v1/models    registry listing
     GET  /v1/model/<name>/report
                        xtpuinsight model report for the served version
@@ -42,6 +51,7 @@ the micro-batcher:
 from __future__ import annotations
 
 import json
+import re
 import sys
 from typing import Dict, List, Tuple
 
@@ -50,8 +60,26 @@ from .server import ServeConfig, Server
 
 
 def _parse_kv(argv: List[str]) -> List[Tuple[str, str]]:
+    # --fleet N / --fleet=N sugar for fleet=N (the one flag-style arg,
+    # matching the README quickstart)
+    norm: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--fleet":
+            if i + 1 >= len(argv):
+                raise ValueError("--fleet needs a replica count")
+            norm.append(f"fleet={argv[i + 1]}")
+            i += 2
+            continue
+        if a.startswith("--fleet="):
+            norm.append("fleet=" + a.split("=", 1)[1])
+            i += 1
+            continue
+        norm.append(a)
+        i += 1
     pairs = []
-    for a in argv:
+    for a in norm:
         if "=" not in a:
             raise ValueError(f"expected key=value argument, got {a!r}")
         k, v = a.split("=", 1)
@@ -60,35 +88,47 @@ def _parse_kv(argv: List[str]) -> List[Tuple[str, str]]:
 
 
 def build_server(argv: List[str]) -> Tuple[Server, Dict[str, str]]:
-    """Parse key=value args, construct + warm a Server. Returns
-    (server, leftover config dict for the frontend loop)."""
-    import re
-
+    """Parse key=value args, construct + warm a Server (or, with
+    ``fleet=N`` / ``--fleet N``, a FleetRouter over N replicas).
+    Returns (server, leftover config dict for the frontend loop)."""
     models: Dict[str, str] = {}
     cfg_kw: Dict[str, object] = {}
     front: Dict[str, str] = {}
+    fleet_n = 0
     for k, v in _parse_kv(argv):
         m = re.match(r"^model\[(.+)\]$", k)
         if m:
             models[m.group(1)] = v
         elif k == "model":
             models["default"] = v
-        elif k in ("max_batch", "max_queue_rows"):
+        elif k in ("max_batch", "max_queue_rows", "shap_max_batch"):
             cfg_kw[k] = int(v)
         elif k in ("max_delay_ms", "timeout_ms", "log_every_s"):
             cfg_kw[k] = float(v)
-        elif k == "buckets":
-            cfg_kw["buckets"] = [int(x) for x in v.split(",") if x]
-        elif k in ("http_port", "silent", "output"):
+        elif k in ("buckets", "shap_buckets"):
+            cfg_kw[k] = [int(x) for x in v.split(",") if x]
+        elif k == "fleet":
+            fleet_n = int(v)
+        elif k in ("http_port", "silent", "output", "warm_contribs"):
             front[k] = v
         else:
             raise ValueError(f"unknown serve key: {k!r}")
     if not models:
         raise ValueError("serve needs at least one model= / model[NAME]=")
-    server = Server(config=ServeConfig(**cfg_kw))
+    if fleet_n > 0:
+        from .fleet import FleetConfig, FleetRouter
+
+        server = FleetRouter(config=FleetConfig(
+            replicas=fleet_n, serve=ServeConfig(**cfg_kw)))
+    else:
+        server = Server(config=ServeConfig(**cfg_kw))
     for name, path in models.items():
         server.load_model(name, path)
     server.warmup()
+    if front.get("warm_contribs", "0") in ("1", "true"):
+        server.warmup_contribs()
+    if fleet_n > 0:
+        server.start_autoscaler()
     return server, front
 
 
@@ -111,6 +151,21 @@ def _score_obj(server: Server, obj: Dict[str, object],
             "version": getattr(preds, "version", None),
             "predictions": [float(x) for x in preds.reshape(-1)]
             if preds.ndim == 1 else preds.tolist()}
+
+
+def _contribs_obj(server, name: str, obj: Dict[str, object]
+                  ) -> Dict[str, object]:
+    rid = obj.get("id")
+    kw: Dict[str, object] = {}
+    if "timeout_ms" in obj:
+        kw["timeout_ms"] = obj["timeout_ms"]
+    try:
+        phi = server.contribs(obj["data"], name or None, **kw)
+    except (ServeError, ValueError, KeyError, TypeError) as exc:
+        return _error_obj(exc, rid)
+    return {"id": rid, "model": getattr(phi, "model", None),
+            "version": getattr(phi, "version", None),
+            "contribs": phi.tolist()}
 
 
 def jsonl_loop(server: Server, instream, outstream,
@@ -199,7 +254,8 @@ def make_http_server(server: Server, port: int,
                 self._send(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self) -> None:  # noqa: N802
-            if self.path != "/v1/predict":
+            m = re.match(r"^/v1/model/(.+)/contribs$", self.path)
+            if self.path != "/v1/predict" and m is None:
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return
             try:
@@ -208,7 +264,10 @@ def make_http_server(server: Server, port: int,
             except (ValueError, json.JSONDecodeError) as exc:
                 self._send(400, _error_obj(exc, None))
                 return
-            out = _score_obj(server, obj, default_output)
+            if m is not None:
+                out = _contribs_obj(server, m.group(1), obj)
+            else:
+                out = _score_obj(server, obj, default_output)
             if "error" in out:
                 code = {"ServerOverloaded": 429, "DeadlineExceeded": 504,
                         "ServerClosed": 503, "UnknownModel": 404}.get(

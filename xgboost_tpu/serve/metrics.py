@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..obs.metrics import Family, HistogramData, Sample, get_registry
 
-STAGES = ("queue", "pad", "h2d", "compute", "d2h", "e2e")
+STAGES = ("queue", "pad", "h2d", "compute", "d2h", "e2e", "shap")
 
 # always exposed (at 0 before the first increment): pre-declared series
 # let rate()/increase() see the first real increment, and give scrape
@@ -100,13 +100,18 @@ class ServeMetrics:
     bucket size so ladder tuning is data-driven (docs/serving.md).
     """
 
-    def __init__(self, register: bool = True) -> None:
+    def __init__(self, register: bool = True,
+                 labels: Sequence = ()) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {}
         self.bucket_hits: Dict[int, int] = {}
         self.hists: Dict[str, LatencyHistogram] = {
             s: LatencyHistogram() for s in STAGES}
         self.started_at = time.time()
+        # constant label set stamped onto every emitted sample — fleet
+        # mode passes (("replica", "r0"),) so per-replica families stay
+        # distinguishable after the process-wide registry merges them
+        self.labels = tuple(tuple(kv) for kv in labels)
         if register:
             # weakref registration: exposition follows live instances and
             # a GC'd server's metrics drop out of /metrics on their own
@@ -189,20 +194,21 @@ class ServeMetrics:
             hist_rows = [(s, list(h.counts), h.total, h.n, h._lo, h._ratio)
                          for s, h in self.hists.items() if h.n]
             uptime = time.time() - self.started_at
+        lab = self.labels
         fams = [
             Family("xtpu_serve_uptime_seconds", "gauge",
                    "seconds since ServeMetrics construction",
-                   [Sample(round(uptime, 3))]),
+                   [Sample(round(uptime, 3), lab)]),
         ]
         for name, v in sorted(counters.items()):
             fams.append(Family(f"xtpu_serve_{name}_total", "counter",
                                f"serve counter {name!r} (docs/serving.md)",
-                               [Sample(v)]))
+                               [Sample(v, lab)]))
         if hits:
             fams.append(Family(
                 "xtpu_serve_bucket_hits_total", "counter",
                 "device batches per ladder bucket size",
-                [Sample(v, (("bucket", str(k)),))
+                [Sample(v, lab + (("bucket", str(k)),))
                  for k, v in sorted(hits.items())]))
         samples = []
         for stage, counts, total, n, lo, ratio in hist_rows:
@@ -213,7 +219,7 @@ class ServeMetrics:
                 buckets.append((lo * ratio ** i, cum))
             buckets.append((math.inf, cum + counts[-1]))
             samples.append(Sample(HistogramData(buckets, total, n),
-                                  (("stage", stage),)))
+                                  lab + (("stage", stage),)))
         if samples:
             fams.append(Family(
                 "xtpu_serve_stage_latency_seconds", "histogram",

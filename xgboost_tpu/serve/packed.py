@@ -1,0 +1,348 @@
+"""Packed forest layout: the serving engine's structure-of-arrays form.
+
+``ForestPredictor`` walks six parallel ``[T, M]`` gather arrays per
+level — six HBM streams per node visit. The packed layout follows
+"Booster: An Accelerator for Gradient Boosting Decision Trees"
+(arxiv 2011.02022): every node of every tree collapses into ONE 32-bit
+**node word** (left-child offset + feature id + default-left + cat +
+leaf flag) plus one f32 **value plane** (split threshold at internal
+nodes, leaf value at leaves — the classic ``RegTree::Node`` union), all
+trees concatenated **forest-major** into flat arrays addressed through
+``tree_offsets``. A node visit is then two loads — one word, one float
+— and the walk kernel (``ops/walk.py``) covers all trees of all models
+in one jitted program per batch shape, memory-bound rather than
+branch-bound (arxiv 1706.08359).
+
+Node words are packed with children ADJACENT (``right = left + 1``);
+the packer renumbers each tree into that order, which preserves the
+BFS parent-before-child invariant. The tree axis is padded to the same
+power-of-two geometry ``ForestPredictor`` uses (inert zero-weight pad
+trees), and the leaf reduction replays the exact ``TREE_CHUNK``
+left-fold sum — so the packed walk is **bit-identical** to
+``Booster.predict()`` (tests/test_packed.py pins it).
+
+Field widths are module constants and the packer VALIDATES against
+them: a forest whose feature ids or child offsets overflow a field
+raises ``PackError`` instead of silently corrupting words
+(tests/test_packed.py's mutation test narrows a width and watches the
+same forest get rejected).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# ------------------------------------------------------------ word layout
+#
+#   bits  0..15  left-child offset, relative to the node's own flat index
+#                (right child = left + 1); 0 at leaves
+#   bits 16..28  split feature id; 0 at leaves
+#   bit   29     default-left (missing values go left)
+#   bit   30     categorical split (route by cat_words bitmask)
+#   bit   31     leaf flag (value plane holds the leaf value)
+
+OFFSET_BITS = 16
+FEAT_BITS = 13
+DL_BIT = 29
+CAT_BIT = 30
+LEAF_BIT = 31
+
+
+class PackError(ValueError):
+    """The forest does not fit the packed word's field widths."""
+
+
+def _field_layout():
+    """Shifts/masks derived from the width constants at call time, so a
+    (test-)mutated width changes validation and packing together."""
+    if OFFSET_BITS + FEAT_BITS > DL_BIT:
+        raise PackError(
+            f"packed-word fields overflow: offset({OFFSET_BITS}) + "
+            f"feat({FEAT_BITS}) bits collide with flag bit {DL_BIT}")
+    return {
+        "off_mask": np.uint32((1 << OFFSET_BITS) - 1),
+        "feat_shift": np.uint32(OFFSET_BITS),
+        "feat_mask": np.uint32((1 << FEAT_BITS) - 1),
+        "dl_bit": np.uint32(1 << DL_BIT),
+        "cat_bit": np.uint32(1 << CAT_BIT),
+        "leaf_bit": np.uint32(1 << LEAF_BIT),
+    }
+
+
+def _adjacent_order(tree) -> np.ndarray:
+    """BFS node order in which siblings are numbered consecutively
+    (left, then right) — maps new id -> old compact id."""
+    order: List[int] = []
+    queue = [0]
+    while queue:
+        nid = queue.pop(0)
+        order.append(nid)
+        if not tree.is_leaf[nid]:
+            queue.append(int(tree.left_child[nid]))
+            queue.append(int(tree.right_child[nid]))
+    return np.asarray(order, np.int64)
+
+
+class PackedForest:
+    """Forest-major packed node arrays plus the walk-side metadata.
+
+    Host arrays (all little views of a few flat buffers):
+
+    - ``words``   [N] uint32 — packed node words (layout above)
+    - ``values``  [N] f32    — split threshold / leaf value union
+    - ``hess``    [N] f32    — node cover (TreeSHAP path weights)
+    - ``cat_words`` [N, W] uint32 — left-set bitmasks (all-zero w/o cats)
+    - ``tree_offsets`` [Tp] int32 — root flat index per tree; pad trees
+      all point at one shared inert leaf
+    - ``tree_weight`` [Tp] f32, ``group_onehot`` [Tp, G] f32 — identical
+      geometry to ``ForestPredictor`` so the chunked leaf reduction is
+      bit-identical
+    """
+
+    def __init__(self, words, values, hess, cat_words, tree_offsets,
+                 n_nodes, tree_weight, group_onehot, tree_info,
+                 max_depth: int, n_trees: int, has_cat: bool) -> None:
+        self.words = np.ascontiguousarray(words, np.uint32)
+        self.values = np.ascontiguousarray(values, np.float32)
+        self.hess = np.ascontiguousarray(hess, np.float32)
+        self.cat_words = np.ascontiguousarray(cat_words, np.uint32)
+        self.tree_offsets = np.ascontiguousarray(tree_offsets, np.int32)
+        self.n_nodes = np.ascontiguousarray(n_nodes, np.int32)  # [T] real
+        self.tree_weight = np.ascontiguousarray(tree_weight, np.float32)
+        self.group_onehot = np.ascontiguousarray(group_onehot, np.float32)
+        self.tree_info = np.ascontiguousarray(tree_info, np.int32)
+        self.max_depth = int(max_depth)
+        self.n_trees = int(n_trees)
+        self.has_cat = bool(has_cat)
+        self._dev = None           # lazy one-time device upload
+
+    # ------------------------------------------------------------- packing
+    @classmethod
+    def from_trees(cls, trees, tree_info, n_groups: int,
+                   tree_weights: Optional[np.ndarray] = None
+                   ) -> "PackedForest":
+        if not trees:
+            raise PackError("cannot pack an empty forest")
+        lay = _field_layout()
+        T = len(trees)
+        has_cat = any(t.is_cat_split.any() for t in trees)
+        W = max(t.cat_words.shape[1] for t in trees) if has_cat else 1
+        n_nodes = np.asarray([t.num_nodes() for t in trees], np.int32)
+        total = int(n_nodes.sum()) + 1          # +1 shared pad-tree leaf
+        words = np.zeros(total, np.uint32)
+        values = np.zeros(total, np.float32)
+        hess = np.zeros(total, np.float32)
+        cat = np.zeros((total, W), np.uint32)
+        offsets = np.zeros(T, np.int64)
+
+        off = 0
+        for t_i, tree in enumerate(trees):
+            order = _adjacent_order(tree)
+            n = len(order)
+            if n != tree.num_nodes():
+                raise PackError(
+                    f"tree {t_i}: {tree.num_nodes() - n} nodes unreachable "
+                    "from the root; refusing to pack a disconnected tree")
+            inv = np.empty(n, np.int64)         # old compact id -> new id
+            inv[order] = np.arange(n)
+            leaf = tree.is_leaf[order]
+            feat = np.where(leaf, 0, tree.split_feature[order])
+            # children were renumbered adjacently: right == left + 1
+            left_new = np.where(leaf, 0,
+                                inv[np.maximum(tree.left_child[order], 0)])
+            delta = np.where(leaf, 0, left_new - np.arange(n))
+            if (~leaf).any():
+                if int(feat.max(initial=0)) > int(lay["feat_mask"]):
+                    raise PackError(
+                        f"tree {t_i}: feature id {int(feat.max())} "
+                        f"overflows the {FEAT_BITS}-bit field "
+                        f"(max {int(lay['feat_mask'])})")
+                d_int = delta[~leaf]
+                if d_int.min(initial=1) < 1 or \
+                        int(d_int.max(initial=1)) > int(lay["off_mask"]):
+                    raise PackError(
+                        f"tree {t_i}: left-child offset "
+                        f"{int(d_int.max(initial=1))} overflows the "
+                        f"{OFFSET_BITS}-bit field "
+                        f"(max {int(lay['off_mask'])})")
+            w = delta.astype(np.uint32) \
+                | (feat.astype(np.uint32) << lay["feat_shift"]) \
+                | np.where(tree.default_left[order],
+                           lay["dl_bit"], np.uint32(0)) \
+                | np.where(tree.is_cat_split[order],
+                           lay["cat_bit"], np.uint32(0)) \
+                | np.where(leaf, lay["leaf_bit"], np.uint32(0))
+            words[off:off + n] = w
+            values[off:off + n] = np.where(leaf, tree.leaf_value[order],
+                                           tree.split_value[order])
+            hess[off:off + n] = tree.sum_hess[order]
+            cat[off:off + n, :tree.cat_words.shape[1]] = \
+                tree.cat_words[order]
+            offsets[t_i] = off
+            off += n
+        # shared inert leaf for pow2 pad trees
+        words[off] = lay["leaf_bit"]
+
+        Tp = 1 << max(T - 1, 0).bit_length()
+        tree_offsets = np.full(Tp, off, np.int64)
+        tree_offsets[:T] = offsets
+        w_arr = (np.ones(T, np.float32) if tree_weights is None
+                 else np.asarray(tree_weights, np.float32))
+        tree_weight = np.zeros(Tp, np.float32)
+        tree_weight[:T] = w_arr
+        onehot = np.zeros((Tp, n_groups), np.float32)
+        onehot[np.arange(T), np.asarray(tree_info)] = 1.0
+        max_depth = max(t.max_depth() for t in trees)
+        return cls(words, values, hess, cat if has_cat
+                   else np.zeros((total, 1), np.uint32),
+                   tree_offsets, n_nodes, tree_weight, onehot,
+                   np.asarray(tree_info, np.int32), max_depth, T, has_cat)
+
+    @classmethod
+    def from_booster(cls, booster) -> Optional["PackedForest"]:
+        """Pack a Booster's forest; ``None`` when the model has no
+        packable trees (gblinear, multi-target vector leaves)."""
+        gbm = booster.gbm
+        trees = getattr(gbm, "trees", None)
+        if not trees or not hasattr(gbm, "forest_slice"):
+            return None
+        from ..tree.multi import MultiTargetTreeModel
+
+        if isinstance(trees[0], MultiTargetTreeModel):
+            return None
+        trees, tree_info, tree_weights = gbm.forest_slice()
+        return cls.from_trees(trees, tree_info, int(booster.n_groups),
+                              tree_weights)
+
+    # ---------------------------------------------------------- unpacking
+    def unpack(self) -> List[Dict[str, np.ndarray]]:
+        """Decode per-tree SoA dicts from the packed words (the exact
+        inverse of the word layout; ``tests/test_packed.py`` pins
+        pack → unpack → pack byte-stability)."""
+        lay = _field_layout()
+        out = []
+        for t in range(self.n_trees):
+            lo = int(self.tree_offsets[t])
+            n = int(self.n_nodes[t])
+            w = self.words[lo:lo + n]
+            leaf = (w >> LEAF_BIT) & 1 == 1
+            delta = (w & lay["off_mask"]).astype(np.int32)
+            nid = np.arange(n, dtype=np.int32)
+            out.append({
+                "is_leaf": leaf,
+                "split_feature": np.where(
+                    leaf, -1,
+                    ((w >> lay["feat_shift"]) & lay["feat_mask"])
+                    .astype(np.int32)),
+                "default_left": (w >> DL_BIT) & 1 == 1,
+                "is_cat_split": (w >> CAT_BIT) & 1 == 1,
+                "left_child": np.where(leaf, -1, nid + delta),
+                "right_child": np.where(leaf, -1, nid + delta + 1),
+                "split_value": np.where(leaf, 0.0,
+                                        self.values[lo:lo + n]
+                                        ).astype(np.float32),
+                "leaf_value": np.where(leaf, self.values[lo:lo + n],
+                                       0.0).astype(np.float32),
+                "sum_hess": self.hess[lo:lo + n].copy(),
+                "cat_words": self.cat_words[lo:lo + n].copy(),
+            })
+        return out
+
+    def to_trees(self):
+        """Rebuild ``TreeModel`` hosts from the packed form (split_bin /
+        gain are not part of the serving layout and come back zeroed)."""
+        from ..tree.tree import TreeModel
+
+        trees = []
+        for d in self.unpack():
+            n = len(d["is_leaf"])
+            parent = np.full(n, -1, np.int32)
+            internal = ~d["is_leaf"]
+            parent[d["left_child"][internal]] = np.nonzero(internal)[0]
+            parent[d["right_child"][internal]] = np.nonzero(internal)[0]
+            trees.append(TreeModel(
+                left_child=d["left_child"].astype(np.int32),
+                right_child=d["right_child"].astype(np.int32),
+                parent=parent,
+                split_feature=d["split_feature"].astype(np.int32),
+                split_bin=np.zeros(n, np.int32),
+                split_value=d["split_value"],
+                default_left=d["default_left"],
+                is_leaf=d["is_leaf"],
+                leaf_value=d["leaf_value"],
+                sum_hess=d["sum_hess"],
+                gain=np.zeros(n, np.float32),
+                is_cat_split=d["is_cat_split"],
+                cat_words=d["cat_words"]))
+        return trees
+
+    def repack(self) -> "PackedForest":
+        """pack(unpack(self)) — byte-stability is the round-trip test."""
+        return PackedForest.from_trees(
+            self.to_trees(), self.tree_info[:self.n_trees],
+            self.group_onehot.shape[1],
+            self.tree_weight[:self.n_trees])
+
+    # ------------------------------------------------------------ the walk
+    def _tree_step(self, n_rows: int) -> int:
+        """Same chunking policy as ``ForestPredictor._chunk_devs`` —
+        identical chunk boundaries are what make the left-fold leaf
+        reduction bit-identical to the unpacked walk."""
+        from ..boosting.predict import ForestPredictor
+
+        env = os.environ.get("XTPU_PREDICT_TREE_CHUNK")
+        if env:
+            return max(1, int(env))
+        budget = (1 << 24) // max(n_rows, 1)
+        return min(ForestPredictor.TREE_CHUNK,
+                   1 << max(budget, 1).bit_length() - 1)
+
+    def device_arrays(self):
+        """Pin the packed buffers on device (once)."""
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            self._dev = {
+                "words": jnp.asarray(self.words),
+                "values": jnp.asarray(self.values),
+                "tree_offsets": jnp.asarray(self.tree_offsets, jnp.int32),
+                "tree_weight": jnp.asarray(self.tree_weight),
+                "group_onehot": jnp.asarray(self.group_onehot),
+            }
+            if self.has_cat:
+                self._dev["cat_words"] = jnp.asarray(self.cat_words)
+        return self._dev
+
+    def margin(self, X, base):
+        """Margin [n, G] of a device batch through the single packed walk
+        program — the serve hot path (``ServedModel.margin_padded``)."""
+        import jax.numpy as jnp
+
+        from ..ops.walk import walk_packed
+
+        d = self.device_arrays()
+        Xd = jnp.asarray(X, jnp.float32)
+        return walk_packed(
+            d["words"], d["values"], d["tree_offsets"], d["tree_weight"],
+            d["group_onehot"], Xd, jnp.asarray(base, jnp.float32),
+            d.get("cat_words"),
+            max_depth=self.max_depth,
+            tree_chunk=self._tree_step(int(Xd.shape[0])))
+
+    # ----------------------------------------------------------- metadata
+    @property
+    def nbytes(self) -> int:
+        return (self.words.nbytes + self.values.nbytes + self.hess.nbytes
+                + (self.cat_words.nbytes if self.has_cat else 0)
+                + self.tree_offsets.nbytes + self.tree_weight.nbytes
+                + self.group_onehot.nbytes)
+
+    def describe(self) -> Dict[str, object]:
+        return {"n_trees": self.n_trees,
+                "n_nodes": int(self.n_nodes.sum()),
+                "max_depth": self.max_depth,
+                "has_cat": self.has_cat,
+                "nbytes": self.nbytes}

@@ -1,10 +1,16 @@
-"""Serve-tier program handle: the level-synchronous forest walk.
+"""Serve-tier program handles: forest walks + device TreeSHAP.
 
 One batch of raw-feature prediction is ONE dispatch of
 ``boosting.predict._predict_margin`` (the serve registry's
 ``margin_padded`` hot path routes every request through it); the handle
 traces it at the padded chunk geometry ``ForestPredictor`` compiles
 (pow2 node slots, ``TREE_CHUNK`` trees).
+
+PR 15 adds the packed-forest twins: ``serve.walk_packed`` (the
+structure-of-arrays walk ``ops/walk.py`` runs as ONE program over the
+whole forest) and ``serve.shap`` (the device TreeSHAP kernel behind
+``/v1/model/<name>/contribs``), each pinned to a 1-dispatch budget in
+tools/xtpuverify/contracts.py.
 """
 
 from __future__ import annotations
@@ -35,4 +41,61 @@ def _serve_walk() -> RoundPlan:
               _abstract((1,), "float32")),      # base margin
         kwargs=dict(max_depth=_DEPTH))
     return RoundPlan(handle="serve.walk", unit="batch",
+                     dispatches=[spec])
+
+
+@register_program("serve.walk_packed")
+def _serve_walk_packed() -> RoundPlan:
+    """The packed-forest walk: ONE program covers every tree of the
+    model (forest-major node pool, shared dummy-leaf padding) — the
+    serve registry's default ``margin_padded`` path."""
+    from ..ops.walk import walk_packed
+
+    T = _TREES                       # pow2 tree slots
+    N = T * ((1 << (_DEPTH + 1)) - 1) + 1   # dense pool + shared dummy
+    spec = ProgramSpec(
+        name="walk_packed",
+        fn=walk_packed,
+        args=(_abstract((N,), "uint32"),        # packed node words
+              _abstract((N,), "float32"),       # split/leaf value plane
+              _abstract((T,), "int32"),         # tree root offsets
+              _abstract((T,), "float32"),       # tree weights
+              _abstract((T, 1), "float32"),     # group one-hot
+              _abstract((_ROWS, _FEATS), "float32"),   # X
+              _abstract((1,), "float32")),      # base margin
+        kwargs=dict(max_depth=_DEPTH, tree_chunk=T))
+    return RoundPlan(handle="serve.walk_packed", unit="batch",
+                     dispatches=[spec])
+
+
+@register_program("serve.shap")
+def _serve_shap() -> RoundPlan:
+    """Device TreeSHAP over the packed forest: one scan program per
+    batch shape. The kernel is fetched through the SAME per-geometry
+    cache ``ops.shap.shap_packed`` serves from, so the verified program
+    is the served one."""
+    from ..ops import shap as _shap
+
+    T, L, D, K, G, F = _TREES, 32, _DEPTH, 4, 1, _FEATS
+    tc = _shap.SHAP_TREE_CHUNK
+    kern = _shap._KERNELS.setdefault(
+        (tc, G, F), _shap.shap_packed_fn(tc, G, F))
+    a = _abstract
+    spec = ProgramSpec(
+        name="shap_packed",
+        fn=kern,
+        args=(a((_ROWS, F), "float32"),         # X
+              a((G,), "float32")),              # bias (means + base)
+        kwargs=dict(
+            occ_feat=a((T, L, D), "int32"), occ_sv=a((T, L, D), "float32"),
+            occ_dl=a((T, L, D), "bool_"),
+            occ_hot_left=a((T, L, D), "bool_"),
+            occ_slot=a((T, L, D), "int32"),
+            occ_valid=a((T, L, D), "bool_"),
+            slot_z=a((T, L, K), "float32"),
+            slot_feat=a((T, L, K), "int32"),
+            slot_valid=a((T, L, K), "bool_"),
+            leaf_value=a((T, L), "float32"), leaf_valid=a((T, L), "bool_"),
+            tree_group=a((T,), "int32"), tree_weight=a((T,), "float32")))
+    return RoundPlan(handle="serve.shap", unit="batch",
                      dispatches=[spec])

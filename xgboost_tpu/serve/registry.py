@@ -21,12 +21,14 @@ the native loader rejects it).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..logging_utils import logger
 from .errors import ModelLoadError, UnknownModel
 
 
@@ -81,6 +83,24 @@ class ServedModel:
         # a plain matmul with nothing to pin)
         self._predictor = (gbm._predictor(0, len(gbm.trees))
                            if hasattr(gbm, "_predictor") else None)
+        # packed-forest fast path (serve/packed.py): one walk program per
+        # batch shape instead of one per 64-tree chunk; bit-identical to
+        # Booster.predict, so it is the default — XTPU_PACKED_WALK=0
+        # falls back to the per-chunk ForestPredictor walk
+        self.packed = None
+        if os.environ.get("XTPU_PACKED_WALK", "1") != "0" \
+                and self._predictor is not None:
+            from .packed import PackedForest, PackError
+
+            try:
+                self.packed = PackedForest.from_booster(booster)
+            except PackError as e:
+                # a forest the word layout cannot hold (feature id or
+                # child offset overflow) still serves on the slow path
+                logger.warning("serve: model %s not packable (%s); "
+                               "using unpacked walk", name, e)
+        self._shap_pack = None
+        self._shap_lock = threading.Lock()
 
     def key(self) -> str:
         return f"{self.name}@v{self.version}"
@@ -90,11 +110,45 @@ class ServedModel:
         independent through the whole walk + leaf matmul, so pad rows
         never influence real rows (tests/test_serve.py pins this
         bit-exactly against ``Booster.predict``)."""
+        if self.packed is not None:
+            return self.packed.margin(X_dev, self.base)
         if self._predictor is not None:
             m, _ = self._predictor.margin(X_dev, self.base)
             return m
         m, _, _ = self._gbm.predict_margin(X_dev, self.base)
         return jnp.asarray(m)
+
+    # ------------------------------------------------------------- contribs
+    @property
+    def supports_contribs(self) -> bool:
+        return self.packed is not None
+
+    def shap_pack(self):
+        """The per-leaf path tables for device TreeSHAP, built on first
+        use (host work proportional to total leaves) and cached for the
+        model's lifetime."""
+        if self._shap_pack is None:
+            if self.packed is None:
+                raise ModelLoadError(
+                    f"model {self.key()} has no packed forest; device "
+                    "contribs needs the packed walk (XTPU_PACKED_WALK)")
+            with self._shap_lock:
+                if self._shap_pack is None:
+                    from ..ops.shap import build_shap_pack
+
+                    self._shap_pack = build_shap_pack(
+                        self.packed, self.n_features)
+        return self._shap_pack
+
+    def contribs_padded(self, X_dev) -> jnp.ndarray:
+        """SHAP φ [R, n_groups, n_features+1] of a bucket-padded device
+        batch (rows independent, like the walk). Matches the host
+        ``pred_contribs`` within f32 tolerance; the bias column carries
+        the cover-weighted forest mean + base score, so every row sums
+        to its margin."""
+        from ..ops.shap import shap_packed
+
+        return shap_packed(self.shap_pack(), X_dev, self.base)
 
     def transform(self, margin: jnp.ndarray) -> jnp.ndarray:
         """Objective prediction transform (sigmoid/softmax/identity) —

@@ -33,7 +33,7 @@ from ..obs import trace as _trace
 from ..obs.metrics import Family, Sample, get_registry
 from .batcher import MicroBatcher, PredictRequest
 from .buckets import BucketLadder, RecompileCounter
-from .errors import ServeError, ServerOverloaded
+from .errors import DeadlineExceeded, ServeError, ServerOverloaded
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, ServedModel
 
@@ -51,6 +51,10 @@ class ServeConfig:
     pad_value:       fill for pad rows (results never see it).
     log_every_s:     >0 emits a periodic metrics line via the
                      xgboost_tpu logger.
+    shap_max_batch:  top bucket of the contribs ladder (device TreeSHAP
+                     is ~leaves×depth heavier per row than the walk, so
+                     it gets a smaller default top).
+    shap_buckets:    explicit contribs ladder sizes.
     """
 
     max_batch: int = 512
@@ -60,6 +64,8 @@ class ServeConfig:
     buckets: Optional[Sequence[int]] = None
     pad_value: float = 0.0
     log_every_s: float = 0.0
+    shap_max_batch: Optional[int] = None
+    shap_buckets: Optional[Sequence[int]] = None
 
     def ladder(self) -> BucketLadder:
         if self.buckets is not None:
@@ -69,6 +75,14 @@ class ServeConfig:
             return lad
         return BucketLadder.pow2(self.max_batch)
 
+    def shap_ladder(self) -> BucketLadder:
+        """The contribs endpoint's own bucket ladder (smaller top by
+        default; same zero-recompile warmup discipline)."""
+        if self.shap_buckets is not None:
+            return BucketLadder(self.shap_buckets)
+        return BucketLadder.pow2(self.shap_max_batch
+                                 or min(128, self.max_batch))
+
 
 _UNSET = object()
 
@@ -77,14 +91,20 @@ class Server:
     """In-process inference server over a multi-model registry."""
 
     def __init__(self, models: Optional[Dict[str, object]] = None,
-                 config: Optional[ServeConfig] = None, **cfg_kw) -> None:
+                 config: Optional[ServeConfig] = None,
+                 replica: Optional[str] = None, **cfg_kw) -> None:
         if config is None:
             config = ServeConfig(**cfg_kw)
         elif cfg_kw:
             config = dataclasses.replace(config, **cfg_kw)
         self.config = config
         self.ladder = config.ladder()
-        self.metrics = ServeMetrics()
+        self.shap_ladder = config.shap_ladder()
+        # fleet mode names each replica so the shared obs registry can
+        # tell their otherwise-identical metric families apart
+        self.replica = replica
+        self.metrics = ServeMetrics(
+            labels=(("replica", replica),) if replica else ())
         self.registry = ModelRegistry()
         self.recompile_counter = RecompileCounter.for_forest_predictor()
         self._device = jax.devices()[0]
@@ -107,14 +127,15 @@ class Server:
     def _collect_obs(self):
         """Registry collector for state that lives outside ServeMetrics:
         the recompile SLO gauge and the live queue depth."""
+        lab = self.metrics.labels
         return [
             Family("xtpu_serve_recompiles_after_warmup", "gauge",
                    "executable-cache misses since warmup (SLO: 0)",
                    [Sample(self.recompiles_after_warmup
-                           if self._warmed else 0)]),
+                           if self._warmed else 0, lab)]),
             Family("xtpu_serve_queue_rows", "gauge",
                    "rows currently queued in the micro-batcher",
-                   [Sample(self.batcher.queue_depth_rows())]),
+                   [Sample(self.batcher.queue_depth_rows(), lab)]),
         ]
 
     # ------------------------------------------------------- model lifecycle
@@ -224,6 +245,105 @@ class Server:
         return self.submit(data, model, output=output,
                            timeout_ms=timeout_ms).result()
 
+    # ------------------------------------------------------------- contribs
+    def contribs(self, data, model: Optional[str] = None, *,
+                 timeout_ms: object = _UNSET) -> np.ndarray:
+        """On-device TreeSHAP: per-feature attributions ``[rows, F+1]``
+        (``[rows, groups, F+1]`` multiclass), last column = bias. Matches
+        host ``Booster.predict(pred_contribs=True)`` within f32 tolerance
+        and each row sums to its margin.
+
+        Synchronous (no micro-batching): contribs traffic is sparse,
+        forensic, and ~leaves×depth heavier per row than the walk, so it
+        runs on the caller's thread over its OWN bucket ladder
+        (``ServeConfig.shap_buckets``) — it never competes with the
+        predict hot path for batch slots, only for the device.
+        """
+        t_start = time.perf_counter()
+        X = np.ascontiguousarray(np.asarray(data, np.float32))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"expected [rows, features] with rows >= 1, "
+                             f"got shape {X.shape}")
+        sm = self.registry.get(model)
+        if not sm.supports_contribs:
+            raise ServeError(
+                f"model {sm.key()} has no packed forest; device contribs "
+                "requires the packed walk (XTPU_PACKED_WALK)")
+        t_ms = (self.config.timeout_ms if timeout_ms is _UNSET
+                else timeout_ms)
+        deadline = (t_start + float(t_ms) / 1e3
+                    if t_ms is not None else None)
+        self.metrics.inc("contrib_requests")
+        self.metrics.inc("contrib_rows", X.shape[0])
+        n = X.shape[0]
+        try:
+            outs = []
+            off = 0
+            with _trace.span("serve/contribs", args={"rows": n}):
+                for size in self.shap_ladder.chunks(n):
+                    if deadline is not None \
+                            and time.perf_counter() > deadline:
+                        self.metrics.inc("deadline_exceeded")
+                        raise DeadlineExceeded(
+                            f"contribs deadline of {t_ms}ms exceeded "
+                            f"after {off}/{n} rows")
+                    bucket = self.shap_ladder.bucket_for(size)
+                    outs.append(self._run_contribs_padded(
+                        sm, X[off:off + size], bucket)[:size])
+                    off += size
+        except BaseException:
+            self.metrics.inc("errors")
+            raise
+        phi = np.concatenate(outs) if len(outs) > 1 else outs[0]
+        if phi.ndim == 3 and phi.shape[1] == 1:
+            phi = phi[:, 0, :]  # match host pred_contribs binary shape
+        self.metrics.observe("shap", time.perf_counter() - t_start)
+        self.metrics.observe("e2e", time.perf_counter() - t_start)
+        return _ServedResult(phi, sm.name, sm.version)
+
+    def _run_contribs_padded(self, sm: ServedModel, X: np.ndarray,
+                             bucket: int, warm: bool = False) -> np.ndarray:
+        """pad -> H2D -> device TreeSHAP -> D2H on one shap bucket."""
+        t0 = time.perf_counter()
+        Xp = self.shap_ladder.pad(X, bucket, self.config.pad_value)
+        t1 = time.perf_counter()
+        xd = jax.block_until_ready(jax.device_put(Xp, self._device))
+        t2 = time.perf_counter()
+        phi_d = jax.block_until_ready(sm.contribs_padded(xd))
+        t3 = time.perf_counter()
+        phi = np.asarray(phi_d)
+        t4 = time.perf_counter()
+        if not warm:
+            self.metrics.observe("pad", t1 - t0)
+            self.metrics.observe("h2d", t2 - t1)
+            self.metrics.observe("compute", t3 - t2)
+            self.metrics.observe("d2h", t4 - t3)
+        return phi
+
+    def warmup_contribs(self, model: Optional[str] = None) -> int:
+        """Compile every (shap bucket, model) TreeSHAP executable up
+        front — the contribs twin of :meth:`warmup`. Skips models without
+        a packed forest. Post-warmup calls absorb their compiles so the
+        zero-recompile SLO stays about unplanned misses."""
+        targets = ([self.registry.get(model)] if model is not None
+                   else self.registry.models())
+        c0 = self.recompile_counter.compiles()
+        n = 0
+        for sm in targets:
+            if not sm.supports_contribs or sm.n_features <= 0:
+                continue
+            for size in self.shap_ladder.sizes:
+                self._run_contribs_padded(sm, sm.warm_batch(size), size,
+                                          warm=True)
+                self.metrics.inc("warmup_batches")
+                n += 1
+        if self._warmed:
+            self.recompile_counter.absorb(
+                self.recompile_counter.compiles() - c0)
+        return n
+
     # ------------------------------------------------------------- pipeline
     def _run_padded(self, sm: ServedModel, X: np.ndarray, bucket: int,
                     warm: bool = False):
@@ -330,6 +450,7 @@ class Server:
                                    "errors", "swaps", "rollbacks"))
         return {
             "status": "closed" if self._closed else "ok",
+            "replica": self.replica,
             "warmed": self._warmed,
             "models": [{"name": m.name, "version": m.version}
                        for m in self.registry.models()],
